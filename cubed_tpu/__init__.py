@@ -1,0 +1,53 @@
+"""cubed_tpu: a TPU-native, bounded-memory, distributed N-dimensional array
+framework implementing the Python Array API standard on a lazy whole-operation
+DAG with exactly two primitives (blockwise, rechunk), plan-time per-task memory
+guarantees, Zarr persistent storage at plan boundaries, and pluggable
+executors — including a JAX executor that keeps intermediates resident in HBM,
+shards chunk grids over a ``jax.sharding.Mesh``, lowers rechunk to in-HBM
+resharding (XLA all-to-all) and reductions to collective trees.
+
+Capability parity target: rsignell/cubed (see SURVEY.md).
+"""
+
+__version__ = "0.1.0"
+
+from .spec import Spec  # noqa: F401
+from .runtime.types import Callback, TaskEndEvent  # noqa: F401
+from .core.array import (  # noqa: F401
+    compute,
+    measure_reserved_mem,
+    visualize,
+)
+from .core.ops import (  # noqa: F401
+    from_array,
+    from_zarr,
+    map_blocks,
+    rechunk,
+    store,
+    to_zarr,
+)
+from .core.gufunc import apply_gufunc  # noqa: F401
+from .nan_functions import nanmean, nansum  # noqa: F401
+
+from . import array_api  # noqa: F401
+from . import random  # noqa: F401
+
+__all__ = [
+    "Spec",
+    "Callback",
+    "TaskEndEvent",
+    "compute",
+    "measure_reserved_mem",
+    "visualize",
+    "from_array",
+    "from_zarr",
+    "map_blocks",
+    "rechunk",
+    "store",
+    "to_zarr",
+    "apply_gufunc",
+    "nanmean",
+    "nansum",
+    "array_api",
+    "random",
+]

@@ -1,0 +1,156 @@
+"""The Array API namespace (v2022.12 standard surface plus extensions).
+
+Reference parity: cubed/array_api/__init__.py:1-254.
+"""
+
+__array_api_version__ = "2022.12"
+
+from .array_object import Array  # noqa: F401
+
+from .constants import e, inf, nan, newaxis, pi  # noqa: F401
+
+from .creation_functions import (  # noqa: F401
+    arange,
+    asarray,
+    empty,
+    empty_like,
+    empty_virtual_array,
+    eye,
+    full,
+    full_like,
+    linspace,
+    meshgrid,
+    ones,
+    ones_like,
+    tril,
+    triu,
+    zeros,
+    zeros_like,
+)
+
+from .data_type_functions import (  # noqa: F401
+    astype,
+    can_cast,
+    finfo,
+    iinfo,
+    isdtype,
+    result_type,
+)
+
+from .dtypes import (  # noqa: F401
+    bool,
+    complex64,
+    complex128,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+)
+
+from .elementwise_functions import (  # noqa: F401
+    abs,
+    acos,
+    acosh,
+    add,
+    asin,
+    asinh,
+    atan,
+    atan2,
+    atanh,
+    bitwise_and,
+    bitwise_invert,
+    bitwise_left_shift,
+    bitwise_or,
+    bitwise_right_shift,
+    bitwise_xor,
+    ceil,
+    conj,
+    cos,
+    cosh,
+    divide,
+    equal,
+    exp,
+    expm1,
+    floor,
+    floor_divide,
+    greater,
+    greater_equal,
+    imag,
+    isfinite,
+    isinf,
+    isnan,
+    less,
+    less_equal,
+    log,
+    log1p,
+    log2,
+    log10,
+    logaddexp,
+    logical_and,
+    logical_not,
+    logical_or,
+    logical_xor,
+    multiply,
+    negative,
+    not_equal,
+    positive,
+    pow,
+    real,
+    remainder,
+    round,
+    sign,
+    sin,
+    sinh,
+    sqrt,
+    square,
+    subtract,
+    tan,
+    tanh,
+    trunc,
+)
+
+from .indexing_functions import take  # noqa: F401
+
+from .linear_algebra_functions import (  # noqa: F401
+    matmul,
+    matrix_transpose,
+    outer,
+    tensordot,
+    vecdot,
+)
+
+from .manipulation_functions import (  # noqa: F401
+    broadcast_arrays,
+    broadcast_to,
+    concat,
+    expand_dims,
+    flatten,
+    flip,
+    moveaxis,
+    permute_dims,
+    repeat,
+    reshape,
+    roll,
+    squeeze,
+    stack,
+)
+
+from .searching_functions import argmax, argmin, where  # noqa: F401
+
+from .statistical_functions import (  # noqa: F401
+    max,
+    mean,
+    min,
+    prod,
+    std,
+    sum,
+    var,
+)
+
+from .utility_functions import all, any  # noqa: F401

@@ -1,0 +1,7 @@
+import numpy as np
+
+e = np.e
+inf = np.inf
+nan = np.nan
+newaxis = None
+pi = np.pi

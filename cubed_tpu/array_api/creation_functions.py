@@ -1,0 +1,274 @@
+"""Array-API creation functions. Creation of constant arrays is free (virtual
+arrays); generated arrays (arange/linspace/eye) are per-block affine
+computations keyed by ``block_id``. Reference parity:
+cubed/array_api/creation_functions.py (322 LoC)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..backend_array_api import nxp
+from ..chunks import normalize_chunks
+from ..core.array import CoreArray
+from ..core.ops import (
+    blockwise,
+    elemwise,
+    from_array,
+    map_blocks,
+    new_array,
+)
+from ..core.plan import Plan, gensym
+from ..spec import spec_from_config
+from ..storage.virtual import (
+    virtual_empty,
+    virtual_full,
+    virtual_in_memory,
+    virtual_offsets,
+)
+from ..utils import to_chunksize
+
+
+def _finalize_spec(spec):
+    return spec_from_config(spec)
+
+
+def arange(
+    start, /, stop=None, step=1, *, dtype=None, device=None, chunks="auto", spec=None
+):
+    if stop is None:
+        start, stop = 0, start
+    num = int(max(np.ceil((stop - start) / step), 0))
+    if dtype is None:
+        dtype = np.arange(start, stop, step * num if num else step).dtype
+    chunks = normalize_chunks(chunks, (num,), dtype=dtype)
+    chunksize = chunks[0][0] if chunks[0] else 1
+
+    def _arange_chunk(chunk, block_id=None):
+        bstart = start + block_id[0] * chunksize * step
+        blen = chunk.shape[0]
+        return nxp.asarray(
+            bstart + step * nxp.arange(blen), dtype=dtype
+        )
+
+    return map_blocks(
+        _arange_chunk,
+        empty((num,), dtype=dtype, chunks=chunks, spec=spec),
+        dtype=dtype,
+    )
+
+
+def asarray(obj, /, *, dtype=None, device=None, copy=None, chunks="auto", spec=None):
+    if isinstance(obj, CoreArray):
+        if dtype is not None and obj.dtype != np.dtype(dtype):
+            from .data_type_functions import astype
+
+            return astype(obj, dtype)
+        return obj
+    a = np.asarray(obj, dtype=dtype)
+    if a.dtype == np.float16:
+        raise NotImplementedError("float16 is not supported")
+    spec = _finalize_spec(spec)
+    outchunks = normalize_chunks(chunks, a.shape, dtype=a.dtype)
+    target = virtual_in_memory(a, to_chunksize(outchunks) if a.shape else ())
+    name = gensym("array")
+    plan = Plan._new(name, "asarray", target)
+    return new_array(name, target, spec, plan)
+
+
+def empty(shape, *, dtype=None, device=None, chunks="auto", spec=None):
+    if dtype is None:
+        dtype = np.dtype(np.float64)
+    return empty_virtual_array(shape, dtype=dtype, chunks=chunks, spec=spec, hidden=False)
+
+
+def empty_like(x, /, *, dtype=None, device=None, chunks=None, spec=None):
+    return empty(**_like_args(x, dtype, chunks, spec))
+
+
+def empty_virtual_array(shape, *, dtype=None, device=None, chunks="auto", spec=None, hidden=True):
+    if dtype is None:
+        dtype = np.dtype(np.float64)
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    spec = _finalize_spec(spec)
+    outchunks = normalize_chunks(chunks, shape, dtype=dtype)
+    target = virtual_empty(shape, dtype=dtype, chunks=to_chunksize(outchunks) if shape else ())
+    name = gensym("empty")
+    plan = Plan._new(name, "empty", target, None, hidden)
+    return new_array(name, target, spec, plan)
+
+
+def eye(n_rows, n_cols=None, /, *, k=0, dtype=None, device=None, chunks="auto", spec=None):
+    if n_cols is None:
+        n_cols = n_rows
+    if dtype is None:
+        dtype = np.dtype(np.float64)
+    shape = (n_rows, n_cols)
+    chunks = normalize_chunks(chunks, shape, dtype=dtype)
+    chunksize = to_chunksize(chunks)
+
+    def _eye_chunk(chunk, block_id=None):
+        i0 = block_id[0] * chunksize[0]
+        j0 = block_id[1] * chunksize[1]
+        m, n = chunk.shape
+        ii = nxp.arange(i0, i0 + m)[:, None]
+        jj = nxp.arange(j0, j0 + n)[None, :]
+        return nxp.asarray(jj - ii == k, dtype=dtype)
+
+    return map_blocks(_eye_chunk, empty(shape, dtype=dtype, chunks=chunks, spec=spec), dtype=dtype)
+
+
+def full(shape, fill_value, *, dtype=None, device=None, chunks="auto", spec=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = np.dtype(np.bool_)
+        elif isinstance(fill_value, int):
+            dtype = np.dtype(np.int64)
+        elif isinstance(fill_value, float):
+            dtype = np.dtype(np.float64)
+        else:
+            raise TypeError(f"Invalid input to full: {fill_value!r}")
+    dtype = np.dtype(dtype)
+    spec = _finalize_spec(spec)
+    outchunks = normalize_chunks(chunks, shape, dtype=dtype)
+    target = virtual_full(
+        shape, fill_value, dtype=dtype, chunks=to_chunksize(outchunks) if shape else ()
+    )
+    name = gensym("full")
+    plan = Plan._new(name, "full", target)
+    return new_array(name, target, spec, plan)
+
+
+def full_like(x, /, fill_value, *, dtype=None, device=None, chunks=None, spec=None):
+    return full(fill_value=fill_value, **_like_args(x, dtype, chunks, spec))
+
+
+def linspace(
+    start, stop, /, num=50, *, dtype=None, device=None, endpoint=True,
+    chunks="auto", spec=None,
+):
+    div = (num - 1) if endpoint else num
+    div = div if div > 0 else 1
+    step = float(stop - start) / div
+    if dtype is None:
+        dtype = np.dtype(np.float64)
+    chunks = normalize_chunks(chunks, (num,), dtype=dtype)
+    chunksize = chunks[0][0] if chunks[0] else 1
+
+    def _linspace_chunk(chunk, block_id=None):
+        bstart = start + block_id[0] * chunksize * step
+        blen = chunk.shape[0]
+        return nxp.asarray(
+            bstart + step * nxp.arange(blen), dtype=dtype
+        )
+
+    return map_blocks(
+        _linspace_chunk,
+        empty((num,), dtype=dtype, chunks=chunks, spec=spec),
+        dtype=dtype,
+    )
+
+
+def meshgrid(*arrays, indexing="xy"):
+    if len({a.dtype for a in arrays}) > 1:
+        raise ValueError("meshgrid inputs must all have the same dtype")
+    from .manipulation_functions import broadcast_arrays, expand_dims
+
+    if indexing == "xy" and len(arrays) > 1:
+        arrays = (arrays[1], arrays[0]) + tuple(arrays[2:])
+    n = len(arrays)
+    grids = []
+    for i, a in enumerate(arrays):
+        g = a
+        for j in range(0, i):
+            g = expand_dims(g, axis=0)
+        for j in range(i + 1, n):
+            g = expand_dims(g, axis=g.ndim)
+        grids.append(g)
+    grids = list(broadcast_arrays(*grids))
+    if indexing == "xy" and len(arrays) > 1:
+        grids[0], grids[1] = grids[1], grids[0]
+    return grids
+
+
+def ones(shape, *, dtype=None, device=None, chunks="auto", spec=None):
+    if dtype is None:
+        dtype = np.dtype(np.float64)
+    return full(shape, 1, dtype=dtype, chunks=chunks, spec=spec)
+
+
+def ones_like(x, /, *, dtype=None, device=None, chunks=None, spec=None):
+    return ones(**_like_args(x, dtype, chunks, spec))
+
+
+def tril(x, /, *, k=0):
+    from .dtypes import _numeric_dtypes
+
+    if x.ndim < 2:
+        raise ValueError("x must be at least 2-dimensional for tril")
+    mask = _tri_mask(x, k)
+    from .searching_functions import where
+
+    return where(mask, x, zeros_like(x))
+
+
+def triu(x, /, *, k=0):
+    if x.ndim < 2:
+        raise ValueError("x must be at least 2-dimensional for triu")
+    mask = _tri_mask(x, k - 1)
+    from .searching_functions import where
+
+    return where(mask, zeros_like(x), x)
+
+
+def _tri_mask(x, k):
+    """Boolean mask (rows >= cols - k) matching x's trailing 2 dims & chunks."""
+    m, n = x.shape[-2], x.shape[-1]
+    cm = x.chunks[-2]
+    cn = x.chunks[-1]
+
+    def _mask_chunk(chunk, block_id=None):
+        i0 = sum(cm[: block_id[0]])
+        j0 = sum(cn[: block_id[1]])
+        mm, nn = chunk.shape
+        ii = nxp.arange(i0, i0 + mm)[:, None]
+        jj = nxp.arange(j0, j0 + nn)[None, :]
+        return ii >= (jj - k)
+
+    mask2d = map_blocks(
+        _mask_chunk,
+        empty((m, n), dtype=np.bool_, chunks=(cm, cn), spec=x.spec),
+        dtype=np.dtype(np.bool_),
+    )
+    return mask2d
+
+
+def zeros(shape, *, dtype=None, device=None, chunks="auto", spec=None):
+    if dtype is None:
+        dtype = np.dtype(np.float64)
+    return full(shape, 0, dtype=dtype, chunks=chunks, spec=spec)
+
+
+def zeros_like(x, /, *, dtype=None, device=None, chunks=None, spec=None):
+    return zeros(**_like_args(x, dtype, chunks, spec))
+
+
+def offsets_virtual_array(numblocks, spec=None):
+    """Hidden array feeding ``block_id`` to map_blocks tasks."""
+    spec = _finalize_spec(spec)
+    target = virtual_offsets(tuple(numblocks))
+    name = gensym("block-ids")
+    plan = Plan._new(name, "block_ids", target, None, True)
+    return new_array(name, target, spec, plan)
+
+
+def _like_args(x, dtype=None, chunks=None, spec=None):
+    if dtype is None:
+        dtype = x.dtype
+    if chunks is None:
+        chunks = x.chunks
+    if spec is None:
+        spec = x.spec
+    return dict(shape=x.shape, dtype=dtype, chunks=chunks, spec=spec)

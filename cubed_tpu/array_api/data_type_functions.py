@@ -1,0 +1,116 @@
+"""Array-API data type functions. Reference parity:
+cubed/array_api/data_type_functions.py (147 LoC)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.ops import map_blocks
+from .dtypes import (
+    _all_dtypes,
+    _boolean_dtypes,
+    _complex_floating_dtypes,
+    _integer_dtypes,
+    _numeric_dtypes,
+    _real_floating_dtypes,
+    _signed_integer_dtypes,
+    _unsigned_integer_dtypes,
+    promote_types,
+)
+
+
+def astype(x, dtype, /, *, copy: bool = True):
+    dtype = np.dtype(dtype)
+    if not copy and dtype == x.dtype:
+        return x
+
+    def _astype(a, astype_dtype=None):
+        return a.astype(astype_dtype)
+
+    return map_blocks(_astype, x, dtype=dtype, astype_dtype=dtype)
+
+
+def can_cast(from_, to, /) -> bool:
+    if hasattr(from_, "dtype"):
+        from_ = from_.dtype
+    from_ = np.dtype(from_)
+    to = np.dtype(to)
+    try:
+        return promote_types(from_, to) == to
+    except TypeError:
+        return False
+
+
+@dataclass
+class finfo_object:
+    bits: int
+    eps: float
+    max: float
+    min: float
+    smallest_normal: float
+    dtype: np.dtype
+
+
+@dataclass
+class iinfo_object:
+    bits: int
+    max: int
+    min: int
+    dtype: np.dtype
+
+
+def finfo(type, /) -> finfo_object:
+    fi = np.finfo(np.dtype(type))
+    return finfo_object(
+        fi.bits, float(fi.eps), float(fi.max), float(fi.min),
+        float(fi.smallest_normal), fi.dtype,
+    )
+
+
+def iinfo(type, /) -> iinfo_object:
+    ii = np.iinfo(np.dtype(type))
+    return iinfo_object(ii.bits, int(ii.max), int(ii.min), np.dtype(type))
+
+
+def isdtype(dtype, kind) -> bool:
+    if isinstance(kind, tuple):
+        return any(isdtype(dtype, k) for k in kind)
+    dtype = np.dtype(dtype)
+    if isinstance(kind, str):
+        if kind == "bool":
+            return dtype in _boolean_dtypes
+        if kind == "signed integer":
+            return dtype in _signed_integer_dtypes
+        if kind == "unsigned integer":
+            return dtype in _unsigned_integer_dtypes
+        if kind == "integral":
+            return dtype in _integer_dtypes
+        if kind == "real floating":
+            return dtype in _real_floating_dtypes
+        if kind == "complex floating":
+            return dtype in _complex_floating_dtypes
+        if kind == "numeric":
+            return dtype in _numeric_dtypes
+        raise ValueError(f"Unrecognized data type kind: {kind!r}")
+    return dtype == np.dtype(kind)
+
+
+def result_type(*arrays_and_dtypes):
+    """Array-API type promotion (no value-based promotion)."""
+    dtypes = []
+    scalars = []
+    for a in arrays_and_dtypes:
+        if isinstance(a, (int, float, complex)) and not hasattr(a, "dtype"):
+            scalars.append(a)
+        elif hasattr(a, "dtype"):
+            dtypes.append(np.dtype(a.dtype))
+        else:
+            dtypes.append(np.dtype(a))
+    if not dtypes:
+        raise ValueError("at least one array or dtype is required")
+    t = dtypes[0]
+    for other in dtypes[1:]:
+        t = promote_types(t, other)
+    return t

@@ -1,0 +1,23 @@
+"""Device object for the Array API surface.
+
+The plan executes on whatever the Spec's executor targets (CPU oracle or the
+TPU mesh); the API-level device is a single logical placeholder, like the
+reference's ``device='cpu'`` (cubed/array_api/array_object.py).
+"""
+
+
+class Device:
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self) -> str:
+        return self.name
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Device) and other.name == self.name or other == self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+device = Device("cubed-tpu")

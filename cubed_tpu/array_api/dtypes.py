@@ -1,0 +1,108 @@
+"""Array-API dtype objects, categories, and the type-promotion lattice.
+
+Reference parity: cubed/array_api/dtypes.py (173 LoC).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+uint8 = np.dtype("uint8")
+uint16 = np.dtype("uint16")
+uint32 = np.dtype("uint32")
+uint64 = np.dtype("uint64")
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+bool = np.dtype("bool")  # noqa: A001
+
+#: TPU-native extension dtype (not in the 2022.12 standard)
+bfloat16 = np.dtype("float32")  # alias for promotion purposes on the API surface
+try:
+    import ml_dtypes
+
+    bfloat16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:
+    pass
+
+_all_dtypes = (
+    int8, int16, int32, int64,
+    uint8, uint16, uint32, uint64,
+    float32, float64, complex64, complex128, bool,
+)
+_boolean_dtypes = (bool,)
+_real_floating_dtypes = (float32, float64)
+_floating_dtypes = (float32, float64, complex64, complex128)
+_complex_floating_dtypes = (complex64, complex128)
+_integer_dtypes = (int8, int16, int32, int64, uint8, uint16, uint32, uint64)
+_signed_integer_dtypes = (int8, int16, int32, int64)
+_unsigned_integer_dtypes = (uint8, uint16, uint32, uint64)
+_integer_or_boolean_dtypes = _boolean_dtypes + _integer_dtypes
+_real_numeric_dtypes = _real_floating_dtypes + _integer_dtypes
+_numeric_dtypes = _floating_dtypes + _integer_dtypes
+
+_dtype_categories = {
+    "all": _all_dtypes,
+    "real numeric": _real_numeric_dtypes,
+    "numeric": _numeric_dtypes,
+    "integer": _integer_dtypes,
+    "integer or boolean": _integer_or_boolean_dtypes,
+    "boolean": _boolean_dtypes,
+    "real floating-point": _real_floating_dtypes,
+    "floating-point": _floating_dtypes,
+    "complex floating-point": _complex_floating_dtypes,
+}
+
+# promotion table (Array API spec); keys are (dtype, dtype) pairs
+_signed = [int8, int16, int32, int64]
+_unsigned = [uint8, uint16, uint32, uint64]
+_floats = [float32, float64]
+_complexes = [complex64, complex128]
+
+_promotion_table: dict = {}
+
+
+def _fill_table():
+    # same-kind: larger wins
+    for fam in (_signed, _unsigned, _floats, _complexes):
+        for i, a in enumerate(fam):
+            for j, b in enumerate(fam):
+                _promotion_table[(a, b)] = fam[max(i, j)]
+    # signed x unsigned
+    for i, u in enumerate(_unsigned):
+        if u is uint64:
+            continue  # uint64 x signed is undefined in the spec
+        for j, s in enumerate(_signed):
+            if u.itemsize < s.itemsize:
+                r = s
+            else:
+                r = _signed[[d.itemsize for d in _signed].index(u.itemsize * 2)]
+            _promotion_table[(u, s)] = r
+            _promotion_table[(s, u)] = r
+    # float x complex
+    _promotion_table[(float32, complex64)] = complex64
+    _promotion_table[(complex64, float32)] = complex64
+    _promotion_table[(float32, complex128)] = complex128
+    _promotion_table[(complex128, float32)] = complex128
+    _promotion_table[(float64, complex64)] = complex128
+    _promotion_table[(complex64, float64)] = complex128
+    _promotion_table[(float64, complex128)] = complex128
+    _promotion_table[(complex128, float64)] = complex128
+    # bool
+    _promotion_table[(bool, bool)] = bool
+
+
+_fill_table()
+
+
+def promote_types(t1, t2):
+    t1, t2 = np.dtype(t1), np.dtype(t2)
+    key = (t1, t2)
+    if key in _promotion_table:
+        return _promotion_table[key]
+    raise TypeError(f"{t1} and {t2} cannot be type promoted together")

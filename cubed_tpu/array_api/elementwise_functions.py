@@ -1,0 +1,332 @@
+"""All ~60 Array-API elementwise functions: dtype-category check, then
+``elemwise(nxp.<f>)``. Reference parity:
+cubed/array_api/elementwise_functions.py (393 LoC)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend_array_api import nxp
+from ..core.ops import elemwise
+from .dtypes import (
+    _boolean_dtypes,
+    _complex_floating_dtypes,
+    _floating_dtypes,
+    _integer_dtypes,
+    _integer_or_boolean_dtypes,
+    _numeric_dtypes,
+    _real_floating_dtypes,
+    _real_numeric_dtypes,
+    complex64,
+    complex128,
+    float32,
+    float64,
+    promote_types,
+)
+
+
+def _check(x, dtypes, fname):
+    if x.dtype not in dtypes:
+        raise TypeError(f"Unsupported dtype {x.dtype} in {fname}")
+
+
+def _unary(nxp_func, x, dtypes, fname, result_dtype=None):
+    _check(x, dtypes, fname)
+    return elemwise(nxp_func, x, dtype=result_dtype or x.dtype)
+
+
+def _promote_pair(x1, x2):
+    """Promote a Python scalar operand to a 0-d array of the other's kind."""
+    from ..core.array import CoreArray
+
+    if isinstance(x1, CoreArray) and not isinstance(x2, CoreArray):
+        x2 = x1._promote_scalar(x2)
+        if x2 is None:
+            raise TypeError("unsupported operand type")
+    elif isinstance(x2, CoreArray) and not isinstance(x1, CoreArray):
+        x1 = x2._promote_scalar(x1)
+        if x1 is None:
+            raise TypeError("unsupported operand type")
+    return x1, x2
+
+
+def _binary(nxp_func, x1, x2, dtypes, fname, result_dtype=None):
+    x1, x2 = _promote_pair(x1, x2)
+    _check(x1, dtypes, fname)
+    _check(x2, dtypes, fname)
+    dtype = result_dtype or promote_types(x1.dtype, x2.dtype)
+    return elemwise(nxp_func, x1, x2, dtype=dtype)
+
+
+def _float_of(dtype):
+    if dtype == complex64:
+        return float32
+    if dtype == complex128:
+        return float64
+    return dtype
+
+
+def abs(x, /):  # noqa: A001
+    _check(x, _numeric_dtypes, "abs")
+    return elemwise(nxp.abs, x, dtype=_float_of(x.dtype))
+
+
+def acos(x, /):
+    return _unary(nxp.acos, x, _floating_dtypes, "acos")
+
+
+def acosh(x, /):
+    return _unary(nxp.acosh, x, _floating_dtypes, "acosh")
+
+
+def add(x1, x2, /):
+    return _binary(nxp.add, x1, x2, _numeric_dtypes, "add")
+
+
+def asin(x, /):
+    return _unary(nxp.asin, x, _floating_dtypes, "asin")
+
+
+def asinh(x, /):
+    return _unary(nxp.asinh, x, _floating_dtypes, "asinh")
+
+
+def atan(x, /):
+    return _unary(nxp.atan, x, _floating_dtypes, "atan")
+
+
+def atan2(x1, x2, /):
+    return _binary(nxp.atan2, x1, x2, _real_floating_dtypes, "atan2")
+
+
+def atanh(x, /):
+    return _unary(nxp.atanh, x, _floating_dtypes, "atanh")
+
+
+def bitwise_and(x1, x2, /):
+    return _binary(nxp.bitwise_and, x1, x2, _integer_or_boolean_dtypes, "bitwise_and")
+
+
+def bitwise_invert(x, /):
+    return _unary(nxp.bitwise_invert, x, _integer_or_boolean_dtypes, "bitwise_invert")
+
+
+def bitwise_left_shift(x1, x2, /):
+    return _binary(nxp.bitwise_left_shift, x1, x2, _integer_dtypes, "bitwise_left_shift")
+
+
+def bitwise_or(x1, x2, /):
+    return _binary(nxp.bitwise_or, x1, x2, _integer_or_boolean_dtypes, "bitwise_or")
+
+
+def bitwise_right_shift(x1, x2, /):
+    return _binary(nxp.bitwise_right_shift, x1, x2, _integer_dtypes, "bitwise_right_shift")
+
+
+def bitwise_xor(x1, x2, /):
+    return _binary(nxp.bitwise_xor, x1, x2, _integer_or_boolean_dtypes, "bitwise_xor")
+
+
+def ceil(x, /):
+    _check(x, _real_numeric_dtypes, "ceil")
+    if x.dtype in _integer_dtypes:
+        return x
+    return elemwise(nxp.ceil, x, dtype=x.dtype)
+
+
+def conj(x, /):
+    return _unary(nxp.conj, x, _numeric_dtypes, "conj")
+
+
+def cos(x, /):
+    return _unary(nxp.cos, x, _floating_dtypes, "cos")
+
+
+def cosh(x, /):
+    return _unary(nxp.cosh, x, _floating_dtypes, "cosh")
+
+
+def divide(x1, x2, /):
+    return _binary(nxp.divide, x1, x2, _floating_dtypes, "divide")
+
+
+def equal(x1, x2, /):
+    x1, x2 = _promote_pair(x1, x2)
+    return elemwise(nxp.equal, x1, x2, dtype=np.dtype(np.bool_))
+
+
+def exp(x, /):
+    return _unary(nxp.exp, x, _floating_dtypes, "exp")
+
+
+def expm1(x, /):
+    return _unary(nxp.expm1, x, _floating_dtypes, "expm1")
+
+
+def floor(x, /):
+    _check(x, _real_numeric_dtypes, "floor")
+    if x.dtype in _integer_dtypes:
+        return x
+    return elemwise(nxp.floor, x, dtype=x.dtype)
+
+
+def floor_divide(x1, x2, /):
+    return _binary(nxp.floor_divide, x1, x2, _real_numeric_dtypes, "floor_divide")
+
+
+def greater(x1, x2, /):
+    return _binary(
+        nxp.greater, x1, x2, _real_numeric_dtypes, "greater", result_dtype=np.dtype(np.bool_)
+    )
+
+
+def greater_equal(x1, x2, /):
+    return _binary(
+        nxp.greater_equal, x1, x2, _real_numeric_dtypes, "greater_equal",
+        result_dtype=np.dtype(np.bool_),
+    )
+
+
+def imag(x, /):
+    _check(x, _complex_floating_dtypes, "imag")
+    return elemwise(nxp.imag, x, dtype=_float_of(x.dtype))
+
+
+def isfinite(x, /):
+    _check(x, _numeric_dtypes, "isfinite")
+    return elemwise(nxp.isfinite, x, dtype=np.dtype(np.bool_))
+
+
+def isinf(x, /):
+    _check(x, _numeric_dtypes, "isinf")
+    return elemwise(nxp.isinf, x, dtype=np.dtype(np.bool_))
+
+
+def isnan(x, /):
+    _check(x, _numeric_dtypes, "isnan")
+    return elemwise(nxp.isnan, x, dtype=np.dtype(np.bool_))
+
+
+def less(x1, x2, /):
+    return _binary(
+        nxp.less, x1, x2, _real_numeric_dtypes, "less", result_dtype=np.dtype(np.bool_)
+    )
+
+
+def less_equal(x1, x2, /):
+    return _binary(
+        nxp.less_equal, x1, x2, _real_numeric_dtypes, "less_equal",
+        result_dtype=np.dtype(np.bool_),
+    )
+
+
+def log(x, /):
+    return _unary(nxp.log, x, _floating_dtypes, "log")
+
+
+def log1p(x, /):
+    return _unary(nxp.log1p, x, _floating_dtypes, "log1p")
+
+
+def log2(x, /):
+    return _unary(nxp.log2, x, _floating_dtypes, "log2")
+
+
+def log10(x, /):
+    return _unary(nxp.log10, x, _floating_dtypes, "log10")
+
+
+def logaddexp(x1, x2, /):
+    return _binary(nxp.logaddexp, x1, x2, _real_floating_dtypes, "logaddexp")
+
+
+def logical_and(x1, x2, /):
+    return _binary(nxp.logical_and, x1, x2, _boolean_dtypes, "logical_and")
+
+
+def logical_not(x, /):
+    return _unary(nxp.logical_not, x, _boolean_dtypes, "logical_not")
+
+
+def logical_or(x1, x2, /):
+    return _binary(nxp.logical_or, x1, x2, _boolean_dtypes, "logical_or")
+
+
+def logical_xor(x1, x2, /):
+    return _binary(nxp.logical_xor, x1, x2, _boolean_dtypes, "logical_xor")
+
+
+def multiply(x1, x2, /):
+    return _binary(nxp.multiply, x1, x2, _numeric_dtypes, "multiply")
+
+
+def negative(x, /):
+    return _unary(nxp.negative, x, _numeric_dtypes, "negative")
+
+
+def not_equal(x1, x2, /):
+    x1, x2 = _promote_pair(x1, x2)
+    return elemwise(nxp.not_equal, x1, x2, dtype=np.dtype(np.bool_))
+
+
+def positive(x, /):
+    return _unary(nxp.positive, x, _numeric_dtypes, "positive")
+
+
+def pow(x1, x2, /):  # noqa: A001
+    return _binary(nxp.pow, x1, x2, _numeric_dtypes, "pow")
+
+
+def real(x, /):
+    _check(x, _complex_floating_dtypes, "real")
+    return elemwise(nxp.real, x, dtype=_float_of(x.dtype))
+
+
+def remainder(x1, x2, /):
+    return _binary(nxp.remainder, x1, x2, _real_numeric_dtypes, "remainder")
+
+
+def round(x, /):  # noqa: A001
+    _check(x, _numeric_dtypes, "round")
+    if x.dtype in _integer_dtypes:
+        return x
+    return elemwise(nxp.round, x, dtype=x.dtype)
+
+
+def sign(x, /):
+    return _unary(nxp.sign, x, _numeric_dtypes, "sign")
+
+
+def sin(x, /):
+    return _unary(nxp.sin, x, _floating_dtypes, "sin")
+
+
+def sinh(x, /):
+    return _unary(nxp.sinh, x, _floating_dtypes, "sinh")
+
+
+def sqrt(x, /):
+    return _unary(nxp.sqrt, x, _floating_dtypes, "sqrt")
+
+
+def square(x, /):
+    return _unary(nxp.square, x, _numeric_dtypes, "square")
+
+
+def subtract(x1, x2, /):
+    return _binary(nxp.subtract, x1, x2, _numeric_dtypes, "subtract")
+
+
+def tan(x, /):
+    return _unary(nxp.tan, x, _floating_dtypes, "tan")
+
+
+def tanh(x, /):
+    return _unary(nxp.tanh, x, _floating_dtypes, "tanh")
+
+
+def trunc(x, /):
+    _check(x, _real_numeric_dtypes, "trunc")
+    if x.dtype in _integer_dtypes:
+        return x
+    return elemwise(nxp.trunc, x, dtype=x.dtype)

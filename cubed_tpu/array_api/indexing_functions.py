@@ -1,0 +1,15 @@
+"""Array-API indexing functions. Reference parity:
+cubed/array_api/indexing_functions.py (4 LoC)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def take(x, indices, /, *, axis=None):
+    if axis is None:
+        if x.ndim != 1:
+            raise ValueError("axis must be specified for multi-dimensional take")
+        axis = 0
+    axis = axis % x.ndim
+    return x[(slice(None),) * axis + (indices,)]

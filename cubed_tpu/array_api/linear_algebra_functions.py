@@ -1,0 +1,201 @@
+"""Array-API linear algebra. matmul/tensordot are blockwise contractions that
+keep a size-1 contraction axis then sum over it — each per-block matmul is a
+single MXU-shaped ``nxp.matmul``. Reference parity:
+cubed/array_api/linear_algebra_functions.py (155 LoC)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend_array_api import nxp
+from ..core.ops import blockwise, reduction
+from .data_type_functions import result_type
+from .dtypes import _numeric_dtypes
+from .manipulation_functions import expand_dims, permute_dims
+
+
+def matmul(x1, x2, /):
+    if x1.dtype not in _numeric_dtypes or x2.dtype not in _numeric_dtypes:
+        raise TypeError("Only numeric dtypes are allowed in matmul")
+    if x1.ndim == 0 or x2.ndim == 0:
+        raise ValueError("matmul does not support 0-d arrays")
+
+    x1_is_1d = x1.ndim == 1
+    x2_is_1d = x2.ndim == 1
+    if x1_is_1d:
+        x1 = expand_dims(x1, axis=0)
+    if x2_is_1d:
+        x2 = expand_dims(x2, axis=x2.ndim)
+
+    if x1.shape[-1] != x2.shape[-2]:
+        raise ValueError("arrays must be aligned for matmul")
+
+    dtype = result_type(x1, x2)
+
+    out_ndim = max(x1.ndim, x2.ndim)
+    # batch dims broadcast; use symbols: batch..., i, j, k(contracted->size1)
+    nb = out_ndim - 2
+    batch1 = tuple(range(nb - (x1.ndim - 2), nb))
+    batch2 = tuple(range(nb - (x2.ndim - 2), nb))
+    i_sym, j_sym, k_sym = nb, nb + 1, nb + 2
+
+    x1_ind = batch1 + (i_sym, k_sym)
+    x2_ind = batch2 + (k_sym, j_sym)
+    out_ind = tuple(range(nb)) + (i_sym, k_sym, j_sym)  # keep k as size-1 axis
+
+    out = blockwise(
+        _matmul_block,
+        out_ind,
+        x1,
+        x1_ind,
+        x2,
+        x2_ind,
+        dtype=dtype,
+        adjust_chunks={k_sym: 1},
+    )
+    # sum over the contraction axis (the size-1-per-block k axis at position nb+1)
+    out = _sum_contraction(out, axis=nb + 1)
+
+    if x1_is_1d:
+        out = _squeeze_axis(out, out.ndim - 2)
+    if x2_is_1d:
+        out = _squeeze_axis(out, out.ndim - 1)
+    return out
+
+
+def _squeeze_axis(x, ax):
+    from .manipulation_functions import _squeeze_axes
+
+    return _squeeze_axes(x, (ax % x.ndim,))
+
+
+def _matmul_block(a, b):
+    # per-block result is batch+(i, j); insert the size-1 contraction axis
+    # between i and j to match out_ind = batch+(i, k, j)
+    return nxp.expand_dims(nxp.matmul(a, b), axis=-2)
+
+
+def _sum_contraction(x, axis):
+    return reduction(
+        x,
+        _sum_keep,
+        combine_func=_sum_keep,
+        axis=axis,
+        intermediate_dtype=x.dtype,
+        dtype=x.dtype,
+        keepdims=False,
+    )
+
+
+def _sum_keep(a, axis=None, keepdims=True, **kw):
+    return nxp.sum(a, axis=axis, keepdims=keepdims)
+
+
+def matrix_transpose(x, /):
+    if x.ndim < 2:
+        raise ValueError("x must be at least 2-dimensional")
+    axes = tuple(range(x.ndim - 2)) + (x.ndim - 1, x.ndim - 2)
+    return permute_dims(x, axes)
+
+
+def outer(x1, x2, /):
+    if x1.ndim != 1 or x2.ndim != 1:
+        raise ValueError("outer requires 1-d arrays")
+    dtype = result_type(x1, x2)
+    return blockwise(
+        _outer_block, (0, 1), x1, (0,), x2, (1,), dtype=dtype
+    )
+
+
+def _outer_block(a, b):
+    return nxp.multiply(a[:, None], b[None, :])
+
+
+def tensordot(x1, x2, /, *, axes=2):
+    if x1.dtype not in _numeric_dtypes or x2.dtype not in _numeric_dtypes:
+        raise TypeError("Only numeric dtypes are allowed in tensordot")
+    if isinstance(axes, (int, np.integer)):
+        axes = (tuple(range(x1.ndim - axes, x1.ndim)), tuple(range(axes)))
+    ax1, ax2 = axes
+    if isinstance(ax1, (int, np.integer)):
+        ax1 = (ax1,)
+    if isinstance(ax2, (int, np.integer)):
+        ax2 = (ax2,)
+    ax1 = tuple(a % x1.ndim for a in ax1)
+    ax2 = tuple(a % x2.ndim for a in ax2)
+    if len(ax1) != len(ax2):
+        raise ValueError("tensordot axes must have the same length")
+
+    dtype = result_type(x1, x2)
+
+    # symbols: free1..., free2..., contracted...
+    free1 = [d for d in range(x1.ndim) if d not in ax1]
+    free2 = [d for d in range(x2.ndim) if d not in ax2]
+    n_free1, n_free2, n_c = len(free1), len(free2), len(ax1)
+
+    sym = iter(range(x1.ndim + x2.ndim))
+    sym1 = {}
+    out_syms_1 = []
+    for d in free1:
+        s = next(sym)
+        sym1[d] = s
+        out_syms_1.append(s)
+    out_syms_2 = []
+    sym2 = {}
+    for d in free2:
+        s = next(sym)
+        sym2[d] = s
+        out_syms_2.append(s)
+    c_syms = []
+    for a1, a2 in zip(ax1, ax2):
+        s = next(sym)
+        sym1[a1] = s
+        sym2[a2] = s
+        c_syms.append(s)
+
+    x1_ind = tuple(sym1[d] for d in range(x1.ndim))
+    x2_ind = tuple(sym2[d] for d in range(x2.ndim))
+    # keep contracted axes as size-1 dims, then sum them away
+    out_ind = tuple(out_syms_1) + tuple(c_syms) + tuple(out_syms_2)
+
+    adjust = {s: 1 for s in c_syms}
+
+    out = blockwise(
+        _TensordotBlock(ax1, ax2, n_free1, n_c, n_free2),
+        out_ind,
+        x1,
+        x1_ind,
+        x2,
+        x2_ind,
+        dtype=dtype,
+        adjust_chunks=adjust,
+    )
+    for i in range(n_c):
+        out = _sum_contraction(out, axis=n_free1)
+    return out
+
+
+class _TensordotBlock:
+    __name__ = "tensordot_block"
+
+    def __init__(self, ax1, ax2, n_free1, n_c, n_free2):
+        self.ax1 = ax1
+        self.ax2 = ax2
+        self.n_free1 = n_free1
+        self.n_c = n_c
+        self.n_free2 = n_free2
+
+    def __call__(self, a, b):
+        out = nxp.tensordot(a, b, axes=(self.ax1, self.ax2))
+        # insert size-1 contraction axes between free1 and free2 dims
+        for i in range(self.n_c):
+            out = nxp.expand_dims(out, axis=self.n_free1)
+        return out
+
+
+def vecdot(x1, x2, /, *, axis=-1):
+    from .elementwise_functions import conj, multiply
+    from .statistical_functions import sum as _sum
+
+    return _sum(multiply(conj(x1) if np.dtype(x1.dtype).kind == "c" else x1, x2),
+                axis=axis)

@@ -1,0 +1,505 @@
+"""Array-API manipulation functions. Reference parity:
+cubed/array_api/manipulation_functions.py (311 LoC)."""
+
+from __future__ import annotations
+
+import itertools
+from bisect import bisect
+from math import prod
+from operator import mul
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..backend_array_api import nxp, numpy_array_to_backend_array
+from ..chunks import blockdims_from_blockshape, normalize_chunks, reshape_rechunk
+from ..core.array import CoreArray
+from ..core.ops import (
+    blockwise,
+    elemwise,
+    general_blockwise,
+    map_blocks,
+    map_direct,
+    rechunk,
+    unify_chunks,
+)
+from ..core.plan import gensym
+from ..utils import block_id_to_offset, chunk_memory, get_item, offset_to_block_id, to_chunksize
+
+
+def broadcast_arrays(*arrays):
+    shapes = [a.shape for a in arrays]
+    out_shape = np.broadcast_shapes(*shapes)
+    return tuple(broadcast_to(a, out_shape) for a in arrays)
+
+
+def broadcast_to(x, /, shape, *, chunks=None):
+    if x.shape == tuple(shape) and chunks is None:
+        return x
+    shape = tuple(shape)
+    ndim_new = len(shape) - x.ndim
+    if ndim_new < 0 or any(
+        new != old and old != 1
+        for new, old in zip(shape[ndim_new:], x.shape)
+    ):
+        raise ValueError(f"cannot broadcast shape {x.shape} to shape {shape}")
+
+    if chunks is None:
+        # leading new dims and broadcast dims get chunk size 1
+        chunks = tuple((1,) * s for s in shape[:ndim_new]) + tuple(
+            bd if old > 1 else ((1,) * new if new > 0 else (0,))
+            for bd, old, new in zip(x.chunks, x.shape, shape[ndim_new:])
+        )
+    else:
+        chunks = normalize_chunks(chunks, shape, dtype=x.dtype)
+        for bd_new, bd_old, old in zip(chunks[ndim_new:], x.chunks, x.shape):
+            if old > 1 and bd_new != bd_old:
+                raise ValueError(
+                    "cannot broadcast chunks: non-broadcast dimension chunks "
+                    f"must be unchanged, got {bd_new} expected {bd_old}"
+                )
+
+    num_new = ndim_new
+
+    def _bcast_chunk(chunk, template):
+        return nxp.broadcast_to(chunk, template.shape)
+
+    # blockwise against an empty template with the output grid
+    from .creation_functions import empty_virtual_array
+
+    template = empty_virtual_array(
+        shape, dtype=x.dtype, chunks=chunks, spec=x.spec, hidden=True
+    )
+
+    out_ind = tuple(range(len(shape)))
+    x_ind = tuple(out_ind[num_new + i] for i in range(x.ndim))
+
+    def _bcast(template_chunk, x_chunk):
+        return nxp.broadcast_to(x_chunk, template_chunk.shape)
+
+    return blockwise(
+        _bcast,
+        out_ind,
+        template,
+        out_ind,
+        x,
+        x_ind,
+        dtype=x.dtype,
+        align_arrays=False,
+    )
+
+
+def concat(arrays, /, *, axis=0):
+    """Concatenate arrays along an axis (map_direct with offset bookkeeping)."""
+    if not arrays:
+        raise ValueError("Need at least one array to concat")
+    arrays = list(arrays)
+    if axis is None:
+        from .manipulation_functions import flatten
+
+        arrays = [flatten(a) for a in arrays]
+        axis = 0
+    ndim = arrays[0].ndim
+    axis = axis % ndim
+    from .data_type_functions import result_type
+
+    dtype = result_type(*arrays)
+    arrays = [_astype_maybe(a, dtype) for a in arrays]
+
+    # align non-axis chunking
+    inds = []
+    for a in arrays:
+        ind = list(range(a.ndim))
+        ind[axis] = -1  # distinct symbol so axis chunks aren't unified
+        inds.append(tuple(ind))
+    pairs = list(itertools.chain(*zip(arrays, inds)))
+    _, arrays = unify_chunks(*pairs)
+
+    shape = list(arrays[0].shape)
+    shape[axis] = sum(a.shape[axis] for a in arrays)
+    shape = tuple(shape)
+
+    chunksize = arrays[0].chunksize
+    chunks = normalize_chunks(chunksize, shape, dtype=dtype)
+
+    # cumulative extents of sources along axis
+    offsets = np.cumsum([0] + [a.shape[axis] for a in arrays]).tolist()
+    out_chunks_axis = chunks[axis]
+
+    extra_projected_mem = 2 * chunk_memory(dtype, chunksize)
+
+    def _read_concat_chunk(block, *zarrays, block_id=None):
+        # the output block covers [start, stop) along axis; gather the pieces
+        start = sum(out_chunks_axis[: block_id[axis]])
+        stop = start + out_chunks_axis[block_id[axis]]
+        pieces = []
+        for i, za in enumerate(zarrays):
+            lo, hi = offsets[i], offsets[i + 1]
+            s = max(start, lo)
+            e = min(stop, hi)
+            if s >= e:
+                continue
+            sel = tuple(
+                slice(s - lo, e - lo)
+                if ax == axis
+                else slice(
+                    sum(chunks[ax][: block_id[ax]]),
+                    sum(chunks[ax][: block_id[ax] + 1]),
+                )
+                for ax in range(ndim)
+            )
+            pieces.append(numpy_array_to_backend_array(za[sel]))
+        if len(pieces) == 1:
+            return pieces[0]
+        return nxp.concatenate(pieces, axis=axis)
+
+    return map_direct(
+        _read_concat_chunk,
+        *arrays,
+        shape=shape,
+        dtype=dtype,
+        chunks=chunks,
+        extra_projected_mem=extra_projected_mem,
+    )
+
+
+def _astype_maybe(a, dtype):
+    if a.dtype == dtype:
+        return a
+    from .data_type_functions import astype
+
+    return astype(a, dtype)
+
+
+def expand_dims(x, /, *, axis=0):
+    if isinstance(axis, (int, np.integer)):
+        axis = (int(axis),)
+    out_ndim = x.ndim + len(axis)
+    axis = tuple(ax % out_ndim for ax in axis)
+
+    chunks_idx = 0
+    out_chunks = []
+    for d in range(out_ndim):
+        if d in axis:
+            out_chunks.append((1,))
+        else:
+            out_chunks.append(x.chunks[chunks_idx])
+            chunks_idx += 1
+
+    def _expand(chunk):
+        return nxp.expand_dims(chunk, axis=axis)
+
+    in_ind = tuple(i for i in range(out_ndim) if i not in axis)
+    out_ind = tuple(range(out_ndim))
+    return blockwise(
+        _expand,
+        out_ind,
+        x,
+        in_ind,
+        dtype=x.dtype,
+        new_axes={ax: 1 for ax in axis},
+        align_arrays=False,
+    )
+
+
+def flatten(x, /):
+    return reshape(x, (-1,))
+
+
+def flip(x, /, *, axis=None):
+    """Reverse along the given axes (reads reversed regions via map_direct)."""
+    if axis is None:
+        axis = tuple(range(x.ndim))
+    if isinstance(axis, (int, np.integer)):
+        axis = (int(axis),)
+    axis = tuple(ax % x.ndim for ax in axis)
+    chunks = x.chunks
+    shape = x.shape
+
+    extra_projected_mem = 2 * x.chunkmem
+
+    def _read_flipped(block, zarray, block_id=None):
+        sel = []
+        for ax in range(x.ndim):
+            start = sum(chunks[ax][: block_id[ax]])
+            stop = start + chunks[ax][block_id[ax]]
+            if ax in axis:
+                # output [start, stop) maps to input [size-stop, size-start)
+                sel.append(slice(shape[ax] - stop, shape[ax] - start))
+            else:
+                sel.append(slice(start, stop))
+        data = numpy_array_to_backend_array(zarray[tuple(sel)])
+        return nxp.flip(data, axis=axis)
+
+    return map_direct(
+        _read_flipped,
+        x,
+        shape=shape,
+        dtype=x.dtype,
+        chunks=chunks,
+        extra_projected_mem=extra_projected_mem,
+    )
+
+
+def moveaxis(x, source, destination, /):
+    if isinstance(source, (int, np.integer)):
+        source = (source,)
+    if isinstance(destination, (int, np.integer)):
+        destination = (destination,)
+    source = tuple(s % x.ndim for s in source)
+    destination = tuple(d % x.ndim for d in destination)
+    order = [n for n in range(x.ndim) if n not in source]
+    for dest, src in sorted(zip(destination, source)):
+        order.insert(dest, src)
+    return permute_dims(x, tuple(order))
+
+
+def permute_dims(x, /, axes=None):
+    if axes is None:
+        axes = tuple(range(x.ndim))[::-1]
+    if len(axes) != x.ndim:
+        raise ValueError("axes don't match array")
+
+    def _transpose(chunk):
+        return nxp.permute_dims(chunk, axes)
+
+    extra_projected_mem = x.chunkmem  # C-order copy of the transposed chunk
+    return blockwise(
+        _transpose,
+        tuple(axes),
+        x,
+        tuple(range(x.ndim)),
+        dtype=x.dtype,
+        extra_projected_mem=extra_projected_mem,
+    )
+
+
+def repeat(x, repeats, /, *, axis=0):
+    """Repeat each element; implemented as expand+broadcast+reshape."""
+    if not isinstance(repeats, (int, np.integer)):
+        raise NotImplementedError("repeat only supports int repeats")
+    shape = x.shape
+    axis = axis % x.ndim
+    expanded = expand_dims(x, axis=axis + 1)
+    bshape = shape[: axis + 1] + (int(repeats),) + shape[axis + 1 :]
+    bchunks = expanded.chunks[: axis + 1] + ((int(repeats),),) + expanded.chunks[axis + 2 :]
+    b = broadcast_to(expanded, bshape, chunks=bchunks)
+    out_shape = shape[:axis] + (shape[axis] * int(repeats),) + shape[axis + 1 :]
+    return reshape(b, out_shape)
+
+
+def reshape(x, /, shape, *, copy=None):
+    shape = tuple(shape)
+    # resolve -1
+    if any(s == -1 for s in shape):
+        known = prod(s for s in shape if s != -1)
+        shape = tuple(x.size // known if s == -1 else s for s in shape)
+    if prod(shape) != x.size:
+        raise ValueError(f"cannot reshape array of size {x.size} into shape {shape}")
+    if shape == x.shape:
+        return x
+    return _reshape_via_rechunk(x, shape)
+
+
+def _reshape_via_rechunk(x, shape):
+    inchunks = x.chunks if x.ndim else ()
+    if x.ndim == 0:
+        rechunk_to, outchunks = (), tuple((s,) for s in shape)
+        x2 = x
+    else:
+        rechunk_to, outchunks = reshape_rechunk(x.shape, shape, inchunks)
+        x2 = rechunk(x, tuple(rechunk_to))
+
+    # block i of x2 maps 1:1 (by linear offset) to block i of the output
+    in_numblocks = tuple(len(c) for c in (x2.chunks if x2.ndim else ()))
+    out_numblocks = tuple(len(c) for c in outchunks)
+    x2_name = x2.name
+
+    def block_function(out_key):
+        out_coords = out_key[1:]
+        offset = block_id_to_offset(out_coords, out_numblocks) if out_numblocks else 0
+        in_coords = (
+            offset_to_block_id(offset, in_numblocks) if in_numblocks else ()
+        )
+        return ((x2_name, *in_coords),)
+
+    return general_blockwise(
+        _ReshapeFn(outchunks),
+        block_function,
+        x2,
+        shape=shape,
+        dtype=x.dtype,
+        chunks=outchunks,
+        op_name="reshape",
+        fusable=False,  # needs block_id, which fused kernels don't thread
+    )
+
+
+class _ReshapeFn:
+    """Reshapes a chunk to its target block shape (from the output chunk grid).
+
+    ``needs_block_id`` tells apply_blockwise to pass the output block coords.
+    """
+
+    __name__ = "reshape_chunk"
+    needs_block_id = True
+
+    def __init__(self, outchunks):
+        self.outchunks = outchunks
+
+    def __call__(self, chunk, block_id=None):
+        t = tuple(
+            self.outchunks[ax][block_id[ax]] for ax in range(len(self.outchunks))
+        )
+        return nxp.reshape(chunk, t)
+
+
+def roll(x, /, shift, *, axis=None):
+    """Roll elements along axes (reads shifted regions via map_direct)."""
+    if axis is None:
+        flat = flatten(x)
+        rolled = roll(flat, shift, axis=0)
+        return reshape(rolled, x.shape)
+    if isinstance(shift, (int, np.integer)):
+        shift = (int(shift),)
+    if isinstance(axis, (int, np.integer)):
+        axis = (int(axis),)
+    if len(shift) != len(axis):
+        raise ValueError("shift and axis must have the same length")
+    shifts = {ax % x.ndim: int(s) for ax, s in zip(axis, shift)}
+    chunks = x.chunks
+    shape = x.shape
+
+    def _read_rolled(block, zarray, block_id=None):
+        pieces_sel = []
+        for ax in range(x.ndim):
+            start = sum(chunks[ax][: block_id[ax]])
+            stop = start + chunks[ax][block_id[ax]]
+            s = shifts.get(ax, 0) % (shape[ax] or 1)
+            # output [start, stop) comes from input [(start-s) % n, ...)
+            pieces_sel.append((start - s) % shape[ax] if shape[ax] else 0)
+        # read possibly-wrapping region via two slices per axis
+        out = _wrapped_read(zarray, pieces_sel, [
+            chunks[ax][block_id[ax]] for ax in range(x.ndim)
+        ], shape)
+        return numpy_array_to_backend_array(out)
+
+    return map_direct(
+        _read_rolled,
+        x,
+        shape=shape,
+        dtype=x.dtype,
+        chunks=chunks,
+        extra_projected_mem=2 * x.chunkmem,
+    )
+
+
+def _wrapped_read(zarray, starts, lengths, shape):
+    """Read a hyper-rectangle that may wrap around each axis.
+
+    Each axis contributes one or two (in_start, in_stop, out_offset) segments;
+    the cartesian product of segments tiles the output block.
+    """
+    ndim = len(shape)
+    segs = []
+    for ax in range(ndim):
+        start, length, n = starts[ax], lengths[ax], shape[ax]
+        if n == 0 or length == 0:
+            segs.append([(0, 0, 0)])
+        elif start + length <= n:
+            segs.append([(start, start + length, 0)])
+        else:
+            segs.append([(start, n, 0), (0, start + length - n, n - start)])
+    out = np.empty(tuple(lengths), dtype=zarray.dtype)
+    for combo in itertools.product(*segs):
+        in_sel = tuple(slice(s, e) for s, e, _ in combo)
+        out_sel = tuple(slice(off, off + (e - s)) for s, e, off in combo)
+        if any(s.start >= s.stop for s in in_sel):
+            continue
+        out[out_sel] = zarray[in_sel]
+    return out
+
+
+def squeeze(x, /, axis=None):
+    if axis is None:
+        axis = tuple(i for i, s in enumerate(x.shape) if s == 1)
+    if isinstance(axis, (int, np.integer)):
+        axis = (int(axis),)
+    axis = tuple(ax % x.ndim for ax in axis)
+    if any(x.shape[ax] != 1 for ax in axis):
+        raise ValueError(f"cannot squeeze axes {axis} of shape {x.shape}")
+    return _squeeze_axes(x, axis)
+
+
+def _squeeze_axes(x, axis: tuple[int, ...]):
+    """Drop single-block size-1 axes via an explicit 1:1 block mapping."""
+    if not axis:
+        return x
+    axis = tuple(sorted(ax % x.ndim for ax in axis))
+    keep = [i for i in range(x.ndim) if i not in axis]
+    shape = tuple(x.shape[i] for i in keep)
+    chunks = tuple(x.chunks[i] for i in keep)
+    x_name = x.name
+
+    def block_function(out_key):
+        out_coords = out_key[1:]
+        it = iter(out_coords)
+        in_coords = tuple(0 if i in axis else next(it) for i in range(x.ndim))
+        return ((x_name, *in_coords),)
+
+    def _sq(chunk):
+        return nxp.squeeze(chunk, axis=axis)
+
+    _sq.__name__ = "squeeze"
+    return general_blockwise(
+        _sq, block_function, x, shape=shape, dtype=x.dtype, chunks=chunks,
+        op_name="squeeze",
+    )
+
+
+def stack(arrays, /, *, axis=0):
+    """Stack arrays along a new axis (general_blockwise selecting by coord)."""
+    if not arrays:
+        raise ValueError("Need at least one array to stack")
+    arrays = list(arrays)
+    shapes = {a.shape for a in arrays}
+    if len(shapes) > 1:
+        raise ValueError("all input arrays must have the same shape for stack")
+    from .data_type_functions import result_type
+
+    dtype = result_type(*arrays)
+    arrays = [_astype_maybe(a, dtype) for a in arrays]
+
+    # align chunks across inputs
+    inds = [tuple(range(a.ndim)) for a in arrays]
+    pairs = list(itertools.chain(*zip(arrays, inds)))
+    _, arrays = unify_chunks(*pairs)
+
+    old_shape = arrays[0].shape
+    ndim_out = len(old_shape) + 1
+    axis = axis % ndim_out
+    shape = old_shape[:axis] + (len(arrays),) + old_shape[axis:]
+    chunks = arrays[0].chunks[:axis] + ((1,) * len(arrays),) + arrays[0].chunks[axis:]
+
+    names = [a.name for a in arrays]
+
+    def block_function(out_key):
+        out_coords = out_key[1:]
+        which = out_coords[axis]
+        in_coords = out_coords[:axis] + out_coords[axis + 1 :]
+        return ((names[which], *in_coords),)
+
+    def _stack_chunk(chunk):
+        return nxp.expand_dims(chunk, axis=axis)
+
+    _stack_chunk.__name__ = "stack"
+
+    return general_blockwise(
+        _stack_chunk,
+        block_function,
+        *arrays,
+        shape=shape,
+        dtype=dtype,
+        chunks=chunks,
+        op_name="stack",
+    )

@@ -1,0 +1,49 @@
+"""Array-API searching functions. Reference parity:
+cubed/array_api/searching_functions.py (33 LoC)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend_array_api import nxp
+from ..core.ops import arg_reduction, elemwise
+from .data_type_functions import result_type
+from .dtypes import _real_numeric_dtypes
+from .manipulation_functions import flatten
+
+
+def argmax(x, /, *, axis=None, keepdims=False, split_every=None):
+    if x.dtype not in _real_numeric_dtypes:
+        raise TypeError("Only real numeric dtypes are allowed in argmax")
+    if axis is None:
+        x = flatten(x)
+        axis = 0
+    return _maybe_keepdims(
+        arg_reduction(x, nxp.argmax, nxp.max, axis=axis, dtype=np.dtype(np.int64)),
+        keepdims, axis, x.ndim,
+    )
+
+
+def argmin(x, /, *, axis=None, keepdims=False, split_every=None):
+    if x.dtype not in _real_numeric_dtypes:
+        raise TypeError("Only real numeric dtypes are allowed in argmin")
+    if axis is None:
+        x = flatten(x)
+        axis = 0
+    return _maybe_keepdims(
+        arg_reduction(x, nxp.argmin, nxp.min, axis=axis, dtype=np.dtype(np.int64)),
+        keepdims, axis, x.ndim,
+    )
+
+
+def _maybe_keepdims(out, keepdims, axis, ndim):
+    if keepdims:
+        from .manipulation_functions import expand_dims
+
+        return expand_dims(out, axis=axis % ndim)
+    return out
+
+
+def where(condition, x1, x2, /):
+    dtype = result_type(x1, x2)
+    return elemwise(nxp.where, condition, x1, x2, dtype=dtype)

@@ -1,0 +1,225 @@
+"""Array-API statistical functions (reductions).
+
+``mean``/``var``/``std`` use dict-of-arrays (pytree) intermediates instead of
+the reference's Zarr structured dtypes — jax has no structured arrays, and
+pytrees jit cleanly. The write path stores them as structured Zarr arrays, so
+the storage format matches the reference's design.
+Reference parity: cubed/array_api/statistical_functions.py (156 LoC).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend_array_api import nxp
+from ..core.ops import reduction
+from .dtypes import (
+    _numeric_dtypes,
+    _real_floating_dtypes,
+    _real_numeric_dtypes,
+    _signed_integer_dtypes,
+    _unsigned_integer_dtypes,
+    complex64,
+    complex128,
+    float32,
+    float64,
+    int64,
+    uint64,
+)
+
+
+def max(x, /, *, axis=None, keepdims=False, split_every=None):  # noqa: A001
+    if x.dtype not in _real_numeric_dtypes:
+        raise TypeError("Only real numeric dtypes are allowed in max")
+    return reduction(
+        x, nxp.max, axis=axis, dtype=x.dtype, keepdims=keepdims, split_every=split_every
+    )
+
+
+def min(x, /, *, axis=None, keepdims=False, split_every=None):  # noqa: A001
+    if x.dtype not in _real_numeric_dtypes:
+        raise TypeError("Only real numeric dtypes are allowed in min")
+    return reduction(
+        x, nxp.min, axis=axis, dtype=x.dtype, keepdims=keepdims, split_every=split_every
+    )
+
+
+def sum(x, /, *, axis=None, dtype=None, keepdims=False, split_every=None):  # noqa: A001
+    if x.dtype not in _numeric_dtypes:
+        raise TypeError("Only numeric dtypes are allowed in sum")
+    if dtype is None:
+        if x.dtype in _signed_integer_dtypes:
+            dtype = int64
+        elif x.dtype in _unsigned_integer_dtypes:
+            dtype = uint64
+        elif x.dtype == float32:
+            dtype = float32
+        elif x.dtype == complex64:
+            dtype = complex64
+        else:
+            dtype = x.dtype
+    dtype = np.dtype(dtype)
+    return reduction(
+        x,
+        _sum_with_dtype,
+        combine_func=_sum_with_dtype,
+        axis=axis,
+        intermediate_dtype=dtype,
+        dtype=dtype,
+        keepdims=keepdims,
+        split_every=split_every,
+        extra_func_kwargs=dict(dtype=dtype),
+    )
+
+
+def _sum_with_dtype(a, axis=None, keepdims=False, dtype=None):
+    return nxp.sum(a, axis=axis, keepdims=keepdims, dtype=dtype)
+
+
+def prod(x, /, *, axis=None, dtype=None, keepdims=False, split_every=None):
+    if x.dtype not in _numeric_dtypes:
+        raise TypeError("Only numeric dtypes are allowed in prod")
+    if dtype is None:
+        if x.dtype in _signed_integer_dtypes:
+            dtype = int64
+        elif x.dtype in _unsigned_integer_dtypes:
+            dtype = uint64
+        elif x.dtype == float32:
+            dtype = float32
+        elif x.dtype == complex64:
+            dtype = complex64
+        else:
+            dtype = x.dtype
+    dtype = np.dtype(dtype)
+    return reduction(
+        x,
+        _prod_with_dtype,
+        combine_func=_prod_with_dtype,
+        axis=axis,
+        intermediate_dtype=dtype,
+        dtype=dtype,
+        keepdims=keepdims,
+        split_every=split_every,
+        extra_func_kwargs=dict(dtype=dtype),
+    )
+
+
+def _prod_with_dtype(a, axis=None, keepdims=False, dtype=None):
+    return nxp.prod(a, axis=axis, keepdims=keepdims, dtype=dtype)
+
+
+# -- mean / var / std (pytree intermediates) --------------------------------
+
+#: structured storage dtype for the {n, total} intermediate; the design note in
+#: the reference explains why a single structured array is used rather than
+#: multiple outputs (cubed/array_api/statistical_functions.py:33-36)
+def _mean_intermediate_dtype(x_dtype):
+    return np.dtype([("n", np.int64), ("total", np.float64)])
+
+
+def mean(x, /, *, axis=None, keepdims=False, split_every=None):
+    if x.dtype not in _real_floating_dtypes:
+        raise TypeError("Only real floating-point dtypes are allowed in mean")
+    dtype = x.dtype
+    intermediate_dtype = _mean_intermediate_dtype(dtype)
+    return reduction(
+        x,
+        _mean_func,
+        combine_func=_mean_combine,
+        aggregate_func=_mean_aggregate,
+        axis=axis,
+        intermediate_dtype=intermediate_dtype,
+        dtype=dtype,
+        keepdims=keepdims,
+        split_every=split_every,
+    )
+
+
+def _numel(x, axis=None, keepdims=False, dtype=np.float64):
+    """Number of elements along axis, broadcast to the reduced shape."""
+    shape = x.shape
+    n = 1
+    for ax in axis:
+        n *= shape[ax]
+    reduced_shape = tuple(
+        1 if ax in axis else s for ax, s in enumerate(shape)
+    )
+    return nxp.broadcast_to(nxp.asarray(n, dtype=dtype), reduced_shape)
+
+
+def _mean_func(a, axis=None, keepdims=True, **kwargs):
+    n = _numel(a, axis=axis, keepdims=keepdims, dtype=np.int64)
+    total = nxp.sum(a, axis=axis, keepdims=keepdims, dtype=np.float64)
+    return {"n": n, "total": total}
+
+
+def _mean_combine(a, axis=None, keepdims=True, **kwargs):
+    n = nxp.sum(a["n"], axis=axis, keepdims=keepdims)
+    total = nxp.sum(a["total"], axis=axis, keepdims=keepdims)
+    return {"n": n, "total": total}
+
+
+def _mean_aggregate(a):
+    return nxp.divide(a["total"], a["n"])
+
+
+def _var_intermediate_dtype(x_dtype):
+    return np.dtype([("n", np.int64), ("mu", np.float64), ("M2", np.float64)])
+
+
+def var(x, /, *, axis=None, correction=0.0, keepdims=False, split_every=None):
+    """Variance via parallel Welford (Chan et al.) combination."""
+    if x.dtype not in _real_floating_dtypes:
+        raise TypeError("Only real floating-point dtypes are allowed in var")
+    dtype = x.dtype
+    intermediate_dtype = _var_intermediate_dtype(dtype)
+    import functools
+
+    return reduction(
+        x,
+        _var_func,
+        combine_func=_var_combine,
+        aggregate_func=functools.partial(_var_aggregate, correction=correction),
+        axis=axis,
+        intermediate_dtype=intermediate_dtype,
+        dtype=dtype,
+        keepdims=keepdims,
+        split_every=split_every,
+    )
+
+
+def _var_func(a, axis=None, keepdims=True, **kwargs):
+    n = _numel(a, axis=axis, dtype=np.int64)
+    mu = nxp.mean(a, axis=axis, keepdims=keepdims, dtype=np.float64)
+    M2 = nxp.sum(
+        nxp.square(nxp.subtract(a, mu)), axis=axis, keepdims=keepdims, dtype=np.float64
+    )
+    return {"n": n, "mu": mu, "M2": M2}
+
+
+def _var_combine(a, axis=None, keepdims=True, **kwargs):
+    # pairwise Chan/Welford merge folded over the concatenated axis
+    n = a["n"]
+    mu = a["mu"]
+    M2 = a["M2"]
+    ax = axis[0] if isinstance(axis, tuple) else axis
+    total_n = nxp.sum(n, axis=ax, keepdims=True)
+    total = nxp.sum(nxp.multiply(mu, n), axis=ax, keepdims=True)
+    new_mu = nxp.divide(total, total_n)
+    # M2_total = sum(M2_i) + sum(n_i * (mu_i - new_mu)^2)
+    new_M2 = nxp.sum(M2, axis=ax, keepdims=True) + nxp.sum(
+        nxp.multiply(n, nxp.square(nxp.subtract(mu, new_mu))), axis=ax, keepdims=True
+    )
+    return {"n": total_n, "mu": new_mu, "M2": new_M2}
+
+
+def _var_aggregate(a, correction=0.0):
+    d = nxp.subtract(nxp.asarray(a["n"], dtype=np.float64), correction)
+    return nxp.divide(a["M2"], d)
+
+
+def std(x, /, *, axis=None, correction=0.0, keepdims=False, split_every=None):
+    from .elementwise_functions import sqrt
+
+    return sqrt(var(x, axis=axis, correction=correction, keepdims=keepdims,
+                    split_every=split_every))

@@ -1,0 +1,39 @@
+"""Array-API utility functions. Reference parity:
+cubed/array_api/utility_functions.py (15 LoC)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend_array_api import nxp
+from ..core.ops import reduction
+
+
+def all(x, /, *, axis=None, keepdims=False, split_every=None):  # noqa: A001
+    if x.size == 0:
+        from .creation_functions import asarray
+
+        return asarray(True, dtype=np.bool_, spec=x.spec)
+    return reduction(
+        x, _all_fn, axis=axis, dtype=np.dtype(np.bool_), keepdims=keepdims,
+        split_every=split_every,
+    )
+
+
+def any(x, /, *, axis=None, keepdims=False, split_every=None):  # noqa: A001
+    if x.size == 0:
+        from .creation_functions import asarray
+
+        return asarray(False, dtype=np.bool_, spec=x.spec)
+    return reduction(
+        x, _any_fn, axis=axis, dtype=np.dtype(np.bool_), keepdims=keepdims,
+        split_every=split_every,
+    )
+
+
+def _all_fn(a, axis=None, keepdims=True, **kw):
+    return nxp.all(a, axis=axis, keepdims=keepdims)
+
+
+def _any_fn(a, axis=None, keepdims=True, **kw):
+    return nxp.any(a, axis=axis, keepdims=keepdims)

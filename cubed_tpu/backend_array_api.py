@@ -1,0 +1,72 @@
+"""The single seam selecting the per-chunk compute namespace.
+
+TPU-first: the default backend namespace is ``jax.numpy``, so every per-chunk
+kernel in the framework is a pure jittable function and fused op chains compile
+to one XLA program. A numpy backend is selectable (``CUBED_TPU_BACKEND=numpy``)
+as the float64-exact CPU oracle for differential testing.
+
+Reference parity: cubed/backend_array_api.py:1-23 (there the namespace is
+array_api_compat.numpy; here the seam itself is the TPU design point).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+BACKEND = os.environ.get("CUBED_TPU_BACKEND", "jax").lower()
+
+if BACKEND == "jax":
+    import jax
+
+    # Array-API dtype parity (int64 indices, float64 defaults) requires x64.
+    # TPU kernels run in f32/bf16; the TPU executor downcasts f64 tiles on
+    # device ingestion when the hardware lacks double support.
+    if os.environ.get("CUBED_TPU_ENABLE_X64", "1") == "1":
+        jax.config.update("jax_enable_x64", True)
+
+    import jax.numpy as namespace  # noqa: F401
+
+    def backend_array_to_numpy_array(arr) -> np.ndarray:
+        """Device array -> host numpy (blocks on transfer)."""
+        return np.asarray(arr)
+
+    def numpy_array_to_backend_array(arr, *, dtype=None):
+        """Host numpy -> backend array (device placement is executor policy).
+
+        Structured numpy arrays become dict-of-arrays pytrees (jax has no
+        structured dtypes); the dict presents the same ``arr["field"]`` access
+        the reference's kernels use on zarr structured intermediates.
+        """
+        if isinstance(arr, dict):  # pytree chunk (e.g. mean's {n, total})
+            return {k: numpy_array_to_backend_array(v, dtype=None) for k, v in arr.items()}
+        a = np.asarray(arr)
+        if a.dtype.fields is not None:
+            return {k: namespace.asarray(np.ascontiguousarray(a[k])) for k in a.dtype.names}
+        return namespace.asarray(a, dtype=dtype)
+
+else:
+    import numpy as namespace  # noqa: F401
+
+    def backend_array_to_numpy_array(arr) -> np.ndarray:
+        return np.asarray(arr)
+
+    def numpy_array_to_backend_array(arr, *, dtype=None):
+        if isinstance(arr, dict):
+            return {k: numpy_array_to_backend_array(v, dtype=None) for k, v in arr.items()}
+        return np.asarray(arr, dtype=dtype)
+
+
+#: alias used throughout the codebase, mirroring the reference's ``nxp``
+nxp = namespace
+
+
+def default_dtypes() -> dict:
+    """Array-API default dtypes (float64/int64/complex128, bool)."""
+    return {
+        "real floating": np.dtype(np.float64),
+        "integral": np.dtype(np.int64),
+        "complex floating": np.dtype(np.complex128),
+        "boolean": np.dtype(np.bool_),
+    }

@@ -1,0 +1,281 @@
+"""Chunk normalization and unification.
+
+Reimplements (from semantics, not source) the chunk-grid algebra the reference
+vendors from dask: ``normalize_chunks`` including ``"auto"`` sizing,
+``common_blockdim`` unification, and broadcast chunk computation.
+Reference parity: cubed/vendor/dask/array/core.py:21-532.
+"""
+
+from __future__ import annotations
+
+from math import prod
+from numbers import Integral
+from typing import Any, Sequence
+
+import numpy as np
+
+from .utils import accumulate_prepend_zero, convert_to_bytes, itemsize
+
+#: Default target bytes per chunk when chunks="auto" (128 MiB, the common
+#: operating point; cf. reference docs/user-guide/memory.md "Chunk sizes").
+DEFAULT_CHUNK_BYTES = 128 * 1024 * 1024
+
+
+def blockdims_from_blockshape(
+    shape: Sequence[int], chunkshape: Sequence[int]
+) -> tuple[tuple[int, ...], ...]:
+    """Expand a single chunk shape into per-dim tuples of block sizes."""
+    if len(shape) != len(chunkshape):
+        raise ValueError(f"shape {shape} and chunk shape {chunkshape} differ in rank")
+    out = []
+    for s, c in zip(shape, chunkshape):
+        s, c = int(s), int(c)
+        if s == 0:
+            out.append((0,))
+            continue
+        if c <= 0:
+            raise ValueError(f"Chunk size must be positive, got {c}")
+        c = min(c, s)
+        blocks = (c,) * (s // c)
+        if s % c:
+            blocks = blocks + (s % c,)
+        out.append(blocks)
+    return tuple(out)
+
+
+def normalize_chunks(
+    chunks: Any,
+    shape: tuple[int, ...],
+    dtype: Any = None,
+    limit: int | str | None = None,
+    previous_chunks: tuple[tuple[int, ...], ...] | None = None,
+) -> tuple[tuple[int, ...], ...]:
+    """Normalize any accepted chunks argument to a tuple-of-tuples of block sizes.
+
+    Accepts an int (same size every dim), a str/int byte limit, ``"auto"``, a
+    tuple mixing ints / ``-1`` / ``None`` / ``"auto"`` / explicit per-dim tuples,
+    or a dict mapping axis to any of the above.
+    """
+    ndim = len(shape)
+    if chunks is None:
+        chunks = "auto"
+    if isinstance(chunks, dict):
+        chunks = tuple(chunks.get(i, "auto") for i in range(ndim))
+    if isinstance(chunks, (int, np.integer, float)):
+        chunks = (int(chunks),) * ndim
+    if isinstance(chunks, str):
+        if chunks.lower() == "auto":
+            chunks = ("auto",) * ndim
+        else:
+            # a byte-string limit like "128MB" applies auto-chunking with that target
+            limit = convert_to_bytes(chunks)
+            chunks = ("auto",) * ndim
+    chunks = tuple(chunks)
+    if len(chunks) != ndim:
+        raise ValueError(f"chunks {chunks} do not match array rank {ndim}")
+
+    # substitute full-extent markers
+    norm: list[Any] = []
+    for i, c in enumerate(chunks):
+        if c is None or (isinstance(c, (int, np.integer)) and int(c) == -1):
+            norm.append(shape[i])
+        elif isinstance(c, str) and c.lower() == "auto":
+            norm.append("auto")
+        elif isinstance(c, (int, np.integer)):
+            norm.append(int(c))
+        elif isinstance(c, (tuple, list)):
+            t = tuple(int(x) for x in c)
+            if sum(t) != shape[i]:
+                raise ValueError(
+                    f"explicit chunks {t} for axis {i} do not sum to extent {shape[i]}"
+                )
+            norm.append(t)
+        else:
+            raise ValueError(f"Unrecognized chunks element {c!r}")
+
+    if any(c == "auto" for c in norm):
+        norm = _auto_chunks(norm, shape, dtype, limit, previous_chunks)
+
+    out = []
+    for i, c in enumerate(norm):
+        if isinstance(c, tuple):
+            out.append(c)
+        else:
+            out.append(blockdims_from_blockshape((shape[i],), (c,))[0])
+    return tuple(out)
+
+
+def _auto_chunks(
+    norm: list[Any],
+    shape: tuple[int, ...],
+    dtype: Any,
+    limit: int | str | None,
+    previous_chunks: tuple[tuple[int, ...], ...] | None,
+) -> list[Any]:
+    """Resolve ``"auto"`` markers so chunk bytes approach the target limit.
+
+    All auto dims get (approximately) equal extents chosen so the product of all
+    chunk extents times the itemsize is at most the byte limit.
+    """
+    if dtype is None:
+        raise ValueError("dtype must be known to use chunks='auto'")
+    limit_bytes = convert_to_bytes(limit) if limit is not None else DEFAULT_CHUNK_BYTES
+    isize = itemsize(dtype)
+
+    fixed_elems = 1
+    for i, c in enumerate(norm):
+        if c == "auto":
+            continue
+        fixed_elems *= max(c) if isinstance(c, tuple) else int(c)
+
+    auto_axes = [i for i, c in enumerate(norm) if c == "auto"]
+    budget = max(1, limit_bytes // max(1, isize * fixed_elems))
+
+    # distribute the element budget over auto axes, clamping at each extent
+    remaining = sorted(auto_axes, key=lambda i: shape[i])
+    sizes: dict[int, int] = {}
+    while remaining:
+        per_axis = max(1, int(round(budget ** (1.0 / len(remaining)))))
+        axis = remaining[0]
+        if shape[axis] <= per_axis:
+            sizes[axis] = max(1, shape[axis])
+            budget = max(1, budget // max(1, shape[axis]))
+            remaining.pop(0)
+        else:
+            for ax in remaining:
+                sizes[ax] = max(1, min(shape[ax], per_axis))
+            remaining = []
+    for i in auto_axes:
+        norm[i] = sizes[i]
+    return norm
+
+
+def common_blockdim(blockdims: Sequence[tuple[int, ...]]) -> tuple[int, ...]:
+    """Unify several chunkings of the same extent into their common refinement.
+
+    Dims of total extent 1 (broadcast candidates) are ignored. If the extents
+    disagree otherwise, raises. The result's block boundaries are the union of
+    every input's boundaries, so each input can be resliced without crossing a
+    block boundary. Reference parity: cubed/vendor/dask/array/core.py:467.
+    """
+    non_trivial = [b for b in blockdims if sum(b) != 1 or len(b) > 1]
+    if not non_trivial:
+        return blockdims[0] if blockdims else ()
+    totals = {sum(b) for b in non_trivial}
+    if len(totals) > 1:
+        raise ValueError(f"Chunks do not align: extents {sorted(totals)}")
+    uniq = set(non_trivial)
+    if len(uniq) == 1:
+        return non_trivial[0]
+    boundaries: set[int] = set()
+    for b in non_trivial:
+        boundaries.update(accumulate_prepend_zero(b)[1:])
+        boundaries.add(sum(b))
+    cuts = sorted(boundaries)
+    return tuple(b - a for a, b in zip([0] + cuts, cuts))
+
+
+def broadcast_chunks(*chunkss: tuple[tuple[int, ...], ...]) -> tuple[tuple[int, ...], ...]:
+    """Chunks of the array resulting from broadcasting the given chunked arrays."""
+    if not chunkss:
+        return ()
+    ndim = max(len(c) for c in chunkss)
+    padded = [((1,),) * (ndim - len(c)) + tuple(c) for c in chunkss]
+    out = []
+    for dim in range(ndim):
+        dims = [p[dim] for p in padded]
+        non_unit = [d for d in dims if sum(d) != 1]
+        if not non_unit:
+            out.append((1,))
+            continue
+        extents = {sum(d) for d in non_unit}
+        if len(extents) > 1:
+            raise ValueError(f"operands could not be broadcast together at dim {dim}")
+        out.append(common_blockdim(non_unit))
+    return tuple(out)
+
+
+def numblocks(chunks: tuple[tuple[int, ...], ...]) -> tuple[int, ...]:
+    return tuple(len(c) for c in chunks)
+
+
+def chunk_offsets(chunks: tuple[tuple[int, ...], ...]) -> tuple[list[int], ...]:
+    """Per-dim start offsets of each block."""
+    return tuple(accumulate_prepend_zero(c) for c in chunks)
+
+
+def reshape_rechunk(
+    inshape: tuple[int, ...],
+    outshape: tuple[int, ...],
+    inchunks: tuple[tuple[int, ...], ...],
+) -> tuple[tuple[tuple[int, ...], ...], tuple[tuple[int, ...], ...]]:
+    """Factor a reshape into (rechunk-to, result-chunks) so blocks map 1:1.
+
+    Greedily matches runs of input dims to runs of output dims with equal element
+    products (the only reshapes expressible block-preserving). Within each run,
+    the slowest-varying dim keeps its chunking (adjusted) and all faster dims are
+    collapsed to full extent. Reference parity: cubed/vendor/dask/array/reshape.py:20.
+    """
+    if prod(inshape) != prod(outshape):
+        raise ValueError(f"cannot reshape {inshape} -> {outshape}")
+
+    # split both shapes into aligned groups with equal products
+    groups: list[tuple[list[int], list[int]]] = []
+    i = j = 0
+    while i < len(inshape) or j < len(outshape):
+        gi, gj = [i], [j]
+        pi = inshape[i] if i < len(inshape) else 1
+        pj = outshape[j] if j < len(outshape) else 1
+        i += 1
+        j += 1
+        while pi != pj:
+            if pi < pj:
+                if i >= len(inshape):
+                    raise ValueError("cannot align reshape groups")
+                pi *= inshape[i]
+                gi.append(i)
+                i += 1
+            else:
+                if j >= len(outshape):
+                    raise ValueError("cannot align reshape groups")
+                pj *= outshape[j]
+                gj.append(j)
+                j += 1
+        # absorb trailing 1s
+        while i < len(inshape) and inshape[i] == 1:
+            gi.append(i)
+            i += 1
+        while j < len(outshape) and outshape[j] == 1:
+            gj.append(j)
+            j += 1
+        groups.append((gi, gj))
+
+    rechunk_to: list[tuple[int, ...]] = [None] * len(inshape)  # type: ignore
+    outchunks: list[tuple[int, ...]] = [None] * len(outshape)  # type: ignore
+    for gi, gj in groups:
+        lead_in, rest_in = gi[0], gi[1:]
+        lead_out, rest_out = gj[0], gj[1:]
+        rest_in_elems = prod(inshape[k] for k in rest_in) if rest_in else 1
+        rest_out_elems = prod(outshape[k] for k in rest_out) if rest_out else 1
+        if len(gi) == 1 and len(gj) == 1:
+            # 1:1 dim, keep chunking as-is
+            rechunk_to[lead_in] = inchunks[lead_in]
+            outchunks[lead_out] = inchunks[lead_in]
+            continue
+        # collapse: rest dims single-block; lead dim carries the block structure.
+        for k in rest_in:
+            rechunk_to[k] = (inshape[k],) if inshape[k] > 0 else (0,)
+        lead_chunks = inchunks[lead_in]
+        # blocks in the lead-in dim must land on boundaries that are expressible
+        # in the lead-out dim: each lead-in block of b rows covers
+        # b*rest_in_elems elements = (b*rest_in_elems/rest_out_elems) lead-out rows
+        factor = rest_in_elems
+        ok = all((b * factor) % rest_out_elems == 0 for b in lead_chunks)
+        if not ok:
+            # fall back to one block along this group
+            lead_chunks = (inshape[lead_in],) if inshape[lead_in] > 0 else (0,)
+        rechunk_to[lead_in] = lead_chunks
+        outchunks[lead_out] = tuple((b * factor) // rest_out_elems for b in lead_chunks)
+        for k in rest_out:
+            outchunks[k] = (outshape[k],) if outshape[k] > 0 else (0,)
+    return tuple(rechunk_to), tuple(outchunks)

@@ -1,0 +1,128 @@
+"""apply_gufunc: apply a generalized ufunc ("(i,j),(j)->(i)" signatures) over
+loop dimensions by lowering to blockwise. Core dimensions must be single-chunk
+(no allow_rechunk), single output only. Reference parity:
+cubed/core/gufunc.py:7-148."""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import numpy as np
+
+from .ops import blockwise
+
+_DIMENSION_NAME = r"\w+"
+_CORE_DIMENSION_LIST = "(?:{0:}(?:,{0:})*,?)?".format(_DIMENSION_NAME)
+_ARGUMENT = rf"\({_CORE_DIMENSION_LIST}\)"
+_INPUT_ARGUMENTS = "(?:{0:}(?:,{0:})*,?)?".format(_ARGUMENT)
+_OUTPUT_ARGUMENTS = "{0:}(?:,{0:})*".format(_ARGUMENT)
+_SIGNATURE = f"^{_INPUT_ARGUMENTS}->{_OUTPUT_ARGUMENTS}$"
+
+
+def _parse_gufunc_signature(signature: str):
+    """Parse a NumPy gufunc signature into (input dims, output dims)."""
+    signature = signature.replace(" ", "")
+    if not re.match(_SIGNATURE, signature):
+        raise ValueError(f"not a valid gufunc signature: {signature}")
+    ins, outs = signature.split("->")
+    input_dims = [
+        tuple(re.findall(_DIMENSION_NAME, arg))
+        for arg in re.findall(_ARGUMENT, ins)
+    ]
+    output_dims = [
+        tuple(re.findall(_DIMENSION_NAME, arg))
+        for arg in re.findall(_ARGUMENT, outs)
+    ]
+    return input_dims, output_dims
+
+
+def apply_gufunc(
+    func,
+    signature: str,
+    *args,
+    axes=None,
+    axis=None,
+    output_dtypes=None,
+    vectorize: Optional[bool] = None,
+    **kwargs,
+):
+    """Apply a generalized ufunc over the loop dimensions of chunked arrays."""
+    input_dims, output_dims = _parse_gufunc_signature(signature)
+    if len(output_dims) > 1:
+        raise NotImplementedError("apply_gufunc supports a single output only")
+    output_dim = output_dims[0]
+
+    if axes is not None or axis is not None:
+        raise NotImplementedError("axes/axis are not supported")
+
+    if len(input_dims) != len(args):
+        raise ValueError(
+            f"signature {signature} expects {len(input_dims)} arrays, got {len(args)}"
+        )
+
+    if output_dtypes is None:
+        raise ValueError("output_dtypes must be specified")
+    otype = output_dtypes[0] if isinstance(output_dtypes, (list, tuple)) else output_dtypes
+
+    if vectorize:
+        func = np.vectorize(func, signature=signature)
+
+    # dimension sizes from args
+    dim_sizes: dict = {}
+    loop_ndims = []
+    for a, dims in zip(args, input_dims):
+        if len(dims) > a.ndim:
+            raise ValueError(
+                f"array with {a.ndim} dims cannot supply core dims {dims}"
+            )
+        loop_ndims.append(a.ndim - len(dims))
+        for d, size in zip(dims, a.shape[a.ndim - len(dims):]):
+            if d in dim_sizes and dim_sizes[d] != size:
+                raise ValueError(f"inconsistent size for core dimension {d!r}")
+            dim_sizes[d] = size
+
+    max_loop = max(loop_ndims) if loop_ndims else 0
+
+    # core dims must be single-chunk
+    for a, dims in zip(args, input_dims):
+        nc = len(dims)
+        if nc:
+            for ax, d in enumerate(dims):
+                chunks_ax = a.chunks[a.ndim - nc + ax]
+                if len(chunks_ax) > 1:
+                    raise ValueError(
+                        f"core dimension {d!r} of array is chunked "
+                        f"({chunks_ax}); rechunk so core dimensions have a "
+                        "single chunk"
+                    )
+
+    # index symbols: loop dims (broadcast-aligned, negative positions) then core
+    core_syms = {d: f"c_{d}" for d in dim_sizes}
+
+    blockwise_args = []
+    for a, dims, lnd in zip(args, input_dims, loop_ndims):
+        loop_syms = tuple(range(max_loop - lnd, max_loop))
+        ind = loop_syms + tuple(core_syms[d] for d in dims)
+        blockwise_args.extend([a, ind])
+
+    out_ind = tuple(range(max_loop)) + tuple(core_syms[d] for d in output_dim)
+
+    # output core dims may be new symbols (not in any input)
+    new_axes = {}
+    for d in output_dim:
+        if not any(d in dims for dims in input_dims):
+            new_axes[core_syms[d]] = dim_sizes.get(d, kwargs.get("output_sizes", {}).get(d))
+            if new_axes[core_syms[d]] is None:
+                raise ValueError(f"size of output core dimension {d!r} unknown")
+
+    kwargs.pop("output_sizes", None)
+
+    return blockwise(
+        func,
+        out_ind,
+        *blockwise_args,
+        dtype=otype,
+        new_axes=new_axes or None,
+        **kwargs,
+    )

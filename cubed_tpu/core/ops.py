@@ -1,0 +1,1007 @@
+"""Whole-array operations, all built on the two primitives (blockwise, rechunk).
+
+Reference parity: cubed/core/ops.py (behavioral; clean-room). Reduction uses
+the tree formulation (reference ``reduction_new``, core/ops.py:906-1090) as the
+default — it maps directly onto collective trees on the TPU executor.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from functools import partial
+from numbers import Integral, Number
+from typing import Any, Callable, Optional, Sequence, Union
+
+import numpy as np
+
+from ..backend_array_api import numpy_array_to_backend_array, nxp
+from ..chunks import (
+    blockdims_from_blockshape,
+    broadcast_chunks,
+    common_blockdim,
+    normalize_chunks,
+    numblocks as chunks_to_numblocks,
+)
+from ..primitive.blockwise import (
+    blockwise as primitive_blockwise,
+    general_blockwise as primitive_general_blockwise,
+)
+from ..primitive.rechunk import rechunk as primitive_rechunk
+from ..spec import Spec, spec_from_config
+from ..storage.store import ZarrV2Array, open_zarr_array
+from ..storage.virtual import (
+    virtual_empty,
+    virtual_full,
+    virtual_in_memory,
+    virtual_offsets,
+)
+from ..utils import (
+    chunk_memory,
+    get_item,
+    offset_to_block_id,
+    to_chunksize,
+)
+from .array import CoreArray, check_array_specs, compute
+from .plan import Plan, gensym, new_temp_path
+
+TaskEndEvent = None  # re-exported elsewhere
+
+
+# ---------------------------------------------------------------------------
+# Creation from / export to storage
+# ---------------------------------------------------------------------------
+
+
+def _spec_of(*arrays, spec=None) -> Spec:
+    if spec is not None:
+        return spec
+    found = check_array_specs([a for a in arrays if isinstance(a, CoreArray)])
+    return found if found is not None else spec_from_config(None)
+
+
+def new_array(name, target, spec, plan) -> "CoreArray":
+    from ..array_api.array_object import Array
+
+    return Array(name, target, spec, plan)
+
+
+def from_array(x, chunks="auto", asarray=None, spec=None) -> "CoreArray":
+    """Create an array from an in-memory (numpy/jax) or zarr-like array.
+
+    Zarr-like stores wrap in place (no data read); small in-memory arrays ride
+    the plan as virtual arrays; larger ones are sliced per output chunk by a
+    map_blocks whose closure carries the source (reference cubed/core/ops.py:40-85).
+    """
+    if isinstance(x, CoreArray):
+        raise ValueError(
+            "Array is already a cubed_tpu array - use rechunk instead of from_array"
+        )
+    spec = spec_from_config(spec)
+    if isinstance(x, ZarrV2Array):
+        name = gensym("from-array")
+        plan = Plan._new(name, "from_array", x)
+        arr = new_array(name, x, spec, plan)
+        outchunks = normalize_chunks(chunks, x.shape, dtype=x.dtype)
+        if to_chunksize(outchunks) != tuple(x.chunks):
+            arr = rechunk(arr, outchunks)
+        return arr
+    x = np.asarray(x)
+    outchunks = normalize_chunks(chunks, x.shape, dtype=x.dtype)
+    name = gensym("array")
+    from ..storage.virtual import MAX_IN_MEMORY_BYTES
+
+    if x.nbytes <= MAX_IN_MEMORY_BYTES:
+        target = virtual_in_memory(x, to_chunksize(outchunks) if x.shape else ())
+        plan = Plan._new(name, "from_array", target)
+        return new_array(name, target, spec, plan)
+
+    # large in-memory source: slice it per output chunk inside the task
+    def _from_array_chunk(chunk, block_id=None):
+        sel = get_item(outchunks, block_id)
+        return numpy_array_to_backend_array(x[sel])
+
+    _from_array_chunk.__name__ = "from_array"
+    return map_blocks(
+        _from_array_chunk,
+        empty_virtual_array(x.shape, dtype=x.dtype, chunks=outchunks, spec=spec),
+        dtype=x.dtype,
+    )
+
+
+def from_zarr(store, path=None, spec=None, storage_options=None) -> "CoreArray":
+    """Load an array from existing Zarr storage (lazily; no data read)."""
+    spec = spec_from_config(spec)
+    name = gensym("from-zarr")
+    target = open_zarr_array(
+        store if path is None else f"{store}/{path}",
+        mode="r",
+        storage_options=storage_options or (spec.storage_options if spec else None),
+    )
+    plan = Plan._new(name, "from_zarr", target)
+    return new_array(name, target, spec, plan)
+
+
+def to_zarr(x: CoreArray, store, path=None, executor=None, storage_options=None, **kwargs) -> None:
+    """Compute the array and write it to a new Zarr store (eagerly)."""
+    out = _store_op(x, store if path is None else f"{store}/{path}", storage_options)
+    out.compute(executor=executor, **kwargs)
+
+
+def store(sources, targets, executor=None, **kwargs) -> None:
+    """Compute multiple arrays into multiple existing stores."""
+    if isinstance(sources, CoreArray):
+        sources = [sources]
+        targets = [targets]
+    outs = [_store_op(s, t, None) for s, t in zip(sources, targets)]
+    compute(*outs, executor=executor, **kwargs)
+
+
+def _store_op(x: CoreArray, store, storage_options) -> CoreArray:
+    def _identity(a):
+        return a
+
+    # identity blockwise into an explicit target store; fuses with producers
+    return blockwise(
+        _identity,
+        tuple(range(x.ndim))[::-1],
+        x,
+        tuple(range(x.ndim))[::-1],
+        dtype=x.dtype,
+        target_store=str(store),
+        storage_options=storage_options,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Blockwise (core wrapper)
+# ---------------------------------------------------------------------------
+
+
+def blockwise(
+    func: Callable,
+    out_ind: Sequence,
+    *args,  # pairs of (array, indices)
+    dtype=None,
+    adjust_chunks: Optional[dict] = None,
+    new_axes: Optional[dict] = None,
+    align_arrays: bool = True,
+    target_store=None,
+    storage_options=None,
+    extra_projected_mem: int = 0,
+    fusable: bool = True,
+    extra_func_kwargs: Optional[dict] = None,
+    **kwargs,
+) -> CoreArray:
+    arrays = list(args[0::2])
+    inds = [tuple(i) if i is not None else None for i in args[1::2]]
+
+    spec = _spec_of(*arrays)
+    if align_arrays:
+        _, arrays = unify_chunks(*itertools.chain(*zip(arrays, inds)))
+
+    # chunking of each index symbol (max-blocks rule over aligned inputs)
+    chunkss: dict = {}
+    for a, ind in zip(arrays, inds):
+        if ind is None:
+            continue
+        for sym, c in zip(ind, a.chunks):
+            if sym not in chunkss or len(c) > len(chunkss[sym]):
+                chunkss[sym] = c
+    if new_axes:
+        for sym, size in new_axes.items():
+            if isinstance(size, (tuple, list)):
+                chunkss[sym] = tuple(size)
+            else:
+                chunkss[sym] = (size,)
+
+    chunks_out = []
+    for sym in out_ind:
+        c = chunkss[sym]
+        if adjust_chunks and sym in adjust_chunks:
+            adj = adjust_chunks[sym]
+            if callable(adj):
+                c = tuple(adj(x) for x in c)
+            elif isinstance(adj, (int, np.integer)):
+                c = (int(adj),) * len(c)
+            else:
+                c = tuple(adj)
+        chunks_out.append(tuple(c))
+    chunks_out = tuple(chunks_out)
+    shape = tuple(sum(c) for c in chunks_out)
+
+    name = gensym("array")
+    if target_store is None:
+        target_store = new_temp_path(name, spec)
+    in_names = [a.name for a in arrays]
+
+    prim_args = []
+    for a, ind in zip(arrays, inds):
+        prim_args.extend([a.zarray_maybe_lazy, ind])
+
+    op = primitive_blockwise(
+        func,
+        tuple(out_ind),
+        *prim_args,
+        allowed_mem=spec.allowed_mem,
+        reserved_mem=spec.reserved_mem,
+        target_store=target_store,
+        storage_options=storage_options or spec.storage_options,
+        shape=shape,
+        dtype=dtype,
+        chunks=chunks_out,
+        new_axes=new_axes,
+        in_names=in_names,
+        out_name=name,
+        extra_projected_mem=extra_projected_mem,
+        extra_func_kwargs=extra_func_kwargs,
+        fusable=fusable,
+        **kwargs,
+    )
+    plan = Plan._new(name, func.__name__ if hasattr(func, "__name__") else "blockwise",
+                     op.target_array, op, False, *arrays)
+    return new_array(name, op.target_array, spec, plan)
+
+
+def general_blockwise(
+    func: Callable,
+    block_function: Callable,
+    *arrays,
+    shape,
+    dtype,
+    chunks,
+    extra_projected_mem: int = 0,
+    num_input_blocks=None,
+    fusable: bool = True,
+    target_store=None,
+    op_name: str = "general_blockwise",
+    **kwargs,
+) -> CoreArray:
+    spec = _spec_of(*arrays)
+    name = gensym("array")
+    if target_store is None:
+        target_store = new_temp_path(name, spec)
+    chunks = normalize_chunks(chunks, shape, dtype=dtype)
+    op = primitive_general_blockwise(
+        func,
+        block_function,
+        *[a.zarray_maybe_lazy for a in arrays],
+        allowed_mem=spec.allowed_mem,
+        reserved_mem=spec.reserved_mem,
+        target_store=target_store,
+        storage_options=spec.storage_options,
+        shape=tuple(shape),
+        dtype=dtype,
+        chunks=chunks,
+        in_names=[a.name for a in arrays],
+        out_name=name,
+        extra_projected_mem=extra_projected_mem,
+        num_input_blocks=num_input_blocks,
+        fusable=fusable,
+    )
+    plan = Plan._new(name, op_name, op.target_array, op, False, *arrays)
+    return new_array(name, op.target_array, spec, plan)
+
+
+# ---------------------------------------------------------------------------
+# Elementwise and map operations
+# ---------------------------------------------------------------------------
+
+
+def elemwise(func: Callable, *args: CoreArray, dtype=None) -> CoreArray:
+    """Apply an elementwise function with broadcasting."""
+    if dtype is None:
+        raise ValueError("dtype must be specified for elemwise")
+    shapes = [getattr(a, "shape", ()) for a in args]
+    out_ndim = max((len(s) for s in shapes), default=0)
+    expr_inds = tuple(range(out_ndim))[::-1]
+    blockwise_args = []
+    for a in args:
+        nd = getattr(a, "ndim", 0)
+        # trailing dims align rightmost (broadcasting); 0-d arrays use ()
+        blockwise_args.extend([a, tuple(range(nd))[::-1]])
+    return blockwise(func, expr_inds, *blockwise_args, dtype=dtype)
+
+
+def map_blocks(
+    func: Callable,
+    *args,
+    dtype=None,
+    chunks=None,
+    drop_axis=None,
+    new_axis=None,
+    spec=None,
+    **kwargs,
+) -> CoreArray:
+    """Apply a function to corresponding blocks, possibly changing chunk shape.
+
+    Supports ``block_id`` in *func* via a hidden offsets virtual array
+    (reference cubed/core/ops.py:539-565).
+    """
+    arrays = [a for a in args if isinstance(a, CoreArray)]
+    if not arrays:
+        # no-input case: build a grid from an empty virtual array
+        if chunks is None:
+            raise ValueError("chunks must be specified with no array args")
+        nc = normalize_chunks(chunks, shape=kwargs.pop("shape"), dtype=dtype)
+        return _map_blocks_no_args(func, nc, dtype, spec, **kwargs)
+
+    if drop_axis is None:
+        drop_axis = []
+    if isinstance(drop_axis, Integral):
+        drop_axis = [drop_axis]
+    if isinstance(new_axis, Integral):
+        new_axis = [new_axis]
+
+    has_block_id = "block_id" in _func_argnames(func)
+
+    x = arrays[0]
+    in_ndim = x.ndim
+    out_ind_full = list(range(in_ndim))
+    out_ind = [i for i in out_ind_full if i not in drop_axis]
+    if new_axis:
+        # renumber: insert new symbols at the new axis positions
+        sym = in_ndim
+        for ax in sorted(new_axis):
+            out_ind.insert(ax, sym)
+            sym += 1
+
+    adjust_chunks = None
+    new_axes = {}
+    if chunks is not None:
+        # explicit output chunks: normalize against derived shape
+        nc = chunks
+        if isinstance(nc, tuple) and len(nc) > 0 and not isinstance(nc[0], tuple):
+            nc = tuple((c,) if isinstance(c, (int, np.integer)) else tuple(c) for c in nc)
+            # expand single chunk sizes across the block grid of the mapped dims
+        adjust_chunks = {}
+        for pos, sym in enumerate(out_ind):
+            if isinstance(chunks[pos], (int, np.integer)):
+                adjust_chunks[sym] = int(chunks[pos])
+            else:
+                adjust_chunks[sym] = tuple(chunks[pos])
+        # symbols for new axes need sizes
+        if new_axis:
+            for ax in sorted(new_axis):
+                sym = out_ind[ax]
+                if isinstance(chunks[ax], (int, np.integer)):
+                    new_axes[sym] = int(chunks[ax])
+                    adjust_chunks.pop(sym, None)
+                else:
+                    new_axes[sym] = tuple(chunks[ax])
+                    adjust_chunks.pop(sym, None)
+    elif new_axis:
+        for ax in sorted(new_axis):
+            new_axes[out_ind[ax]] = 1
+
+    blockwise_args = []
+    for a in args:
+        if isinstance(a, CoreArray):
+            blockwise_args.extend([a, tuple(range(a.ndim)) if a.ndim else None])
+        else:
+            # non-array args are closed over
+            raise ValueError("non-array positional args not supported; use kwargs")
+
+    if has_block_id:
+        offsets = _offsets_array_for(x)
+        numblocks = x.numblocks
+
+        def func_with_block_id(*chunk_args, **kw):
+            *real, offset = chunk_args
+            block_id = offset_to_block_id(int(np.asarray(offset).ravel()[0]), numblocks)
+            return func(*real, block_id=block_id, **kw)
+
+        func_with_block_id.__name__ = getattr(func, "__name__", "map_blocks")
+        blockwise_args.extend([offsets, tuple(range(in_ndim))])
+        return blockwise(
+            func_with_block_id,
+            tuple(out_ind),
+            *blockwise_args,
+            dtype=dtype,
+            adjust_chunks=adjust_chunks,
+            new_axes=new_axes or None,
+            align_arrays=False,
+            **kwargs,
+        )
+
+    return blockwise(
+        func,
+        tuple(out_ind),
+        *blockwise_args,
+        dtype=dtype,
+        adjust_chunks=adjust_chunks,
+        new_axes=new_axes or None,
+        **kwargs,
+    )
+
+
+def _offsets_array_for(x: CoreArray):
+    """A CoreArray wrapping a VirtualOffsetsArray matching x's block grid."""
+    offsets = virtual_offsets(x.numblocks)
+    name = gensym("block-ids")
+    plan = Plan._new(name, "block_ids", offsets)
+    return new_array(name, offsets, x.spec, plan)
+
+
+def _map_blocks_no_args(func, chunks, dtype, spec, **kwargs):
+    spec = spec_from_config(spec)
+    shape = tuple(sum(c) for c in chunks)
+    temp = empty_virtual_array(shape, dtype=dtype, chunks=chunks, spec=spec)
+    return map_blocks(_DropFirst(func), temp, dtype=dtype, **kwargs)
+
+
+class _DropFirst:
+    """Adapter dropping the placeholder chunk arg for no-input map_blocks."""
+
+    def __init__(self, func):
+        self.func = func
+        self.__name__ = getattr(func, "__name__", "map_blocks")
+        import inspect
+
+        try:
+            params = inspect.signature(func).parameters
+            self._block_id = "block_id" in params
+        except (TypeError, ValueError):
+            self._block_id = False
+
+    def __call__(self, _placeholder, block_id=None, **kwargs):
+        if self._block_id:
+            return self.func(block_id=block_id, **kwargs)
+        return self.func(**kwargs)
+
+
+def _func_argnames(func) -> tuple:
+    import inspect
+
+    try:
+        return tuple(inspect.signature(func).parameters)
+    except (TypeError, ValueError):
+        return ()
+
+
+def empty_virtual_array(shape, dtype=np.float64, chunks="auto", spec=None, hidden=True) -> CoreArray:
+    spec = spec_from_config(spec)
+    outchunks = normalize_chunks(chunks, shape, dtype=dtype)
+    target = virtual_empty(shape, dtype=dtype, chunks=to_chunksize(outchunks) if shape else ())
+    name = gensym("empty")
+    plan = Plan._new(name, "empty", target, None, hidden)
+    return new_array(name, target, spec, plan)
+
+
+def map_direct(
+    func: Callable,
+    *args: CoreArray,
+    shape,
+    dtype,
+    chunks,
+    extra_projected_mem: int,
+    spec=None,
+    **kwargs,
+) -> CoreArray:
+    """Map a function over blocks of a new array, with side-input access to
+    whole source arrays (any access pattern). Not fusable: side-input reads
+    are outside the blockwise memory model. Reference cubed/core/ops.py:646-699.
+    """
+    from ..array_api.creation_functions import _finalize_spec
+
+    spec = _spec_of(*args, spec=spec)
+    nc = normalize_chunks(chunks, shape, dtype=dtype)
+    out = empty_virtual_array(shape, dtype=dtype, chunks=nc, spec=spec, hidden=True)
+
+    side_arrays = [a.zarray_maybe_lazy for a in args]
+
+    def new_func(block, block_id=None, **kw):
+        # side inputs are opened inside the task
+        from ..storage.zarr import open_if_lazy_zarr_array
+
+        opened = [open_if_lazy_zarr_array(s) for s in side_arrays]
+        return func(block, *opened, block_id=block_id, **kw)
+
+    new_func.__name__ = getattr(func, "__name__", "map_direct")
+
+    mapped = map_blocks(
+        new_func,
+        out,
+        dtype=dtype,
+        chunks=nc,
+        extra_projected_mem=extra_projected_mem,
+        fusable=False,
+        **kwargs,
+    )
+    # record the true dependencies in the plan (side inputs), so side-input
+    # arrays are created/computed before this op runs
+    import networkx as nx
+
+    dag = mapped.plan.dag
+    op_node = _producing_op(mapped)
+    for a in args:
+        dag = nx.compose(a.plan.dag, dag)
+        dag.add_edge(a.name, op_node)
+    mapped.plan = Plan(dag)
+    return mapped
+
+
+def _producing_op(x: CoreArray) -> str:
+    for pred in x.plan.dag.predecessors(x.name):
+        return pred
+    raise ValueError(f"no producing op for {x.name}")
+
+
+# ---------------------------------------------------------------------------
+# Indexing
+# ---------------------------------------------------------------------------
+
+
+def index(x: CoreArray, key) -> CoreArray:
+    """Orthogonal (outer) indexing: ints, slices, one integer-array index.
+
+    Reference cubed/core/ops.py:374-517.
+    """
+    if not isinstance(key, tuple):
+        key = (key,)
+
+    # replace None (newaxis) markers: handle by expand_dims at the end
+    newaxis_positions = [i for i, k in enumerate(key) if k is None]
+    key = tuple(k for k in key if k is not None)
+
+    if Ellipsis in key:
+        i = key.index(Ellipsis)
+        fill = x.ndim - (len(key) - 1)
+        key = key[:i] + (slice(None),) * fill + key[i + 1 :]
+    key = key + (slice(None),) * (x.ndim - len(key))
+    if len(key) > x.ndim:
+        raise IndexError(f"too many indices for array with {x.ndim} dimensions")
+
+    # eagerly compute any lazy-array indices (reference ops.py:391-395)
+    norm_key = []
+    for k in key:
+        if isinstance(k, CoreArray):
+            norm_key.append(np.asarray(k.compute()))
+        elif isinstance(k, (list, np.ndarray)):
+            norm_key.append(np.asarray(k))
+        else:
+            norm_key.append(k)
+    key = tuple(norm_key)
+
+    n_array_idx = sum(1 for k in key if isinstance(k, np.ndarray))
+    if n_array_idx > 1:
+        raise NotImplementedError("Only one integer array index is allowed")
+
+    # per-axis selections; ints drop the axis afterwards
+    int_axes = [i for i, k in enumerate(key) if isinstance(k, (int, np.integer))]
+    selections = []
+    for ax, k in enumerate(key):
+        size = x.shape[ax]
+        if isinstance(k, (int, np.integer)):
+            kk = int(k) + (size if k < 0 else 0)
+            if not (0 <= kk < size):
+                raise IndexError(f"index {k} out of bounds for axis {ax} (size {size})")
+            selections.append(np.array([kk]))
+        elif isinstance(k, slice):
+            selections.append(k)
+        else:
+            arr = np.asarray(k)
+            if arr.dtype == bool:
+                raise NotImplementedError("boolean array indexing is not supported")
+            arr = np.where(arr < 0, arr + size, arr)
+            selections.append(arr.astype(np.int64))
+
+    steps = [
+        (s.step or 1) if isinstance(s, slice) else 1 for s in selections
+    ]
+
+    out_shape = []
+    for ax, s in enumerate(selections):
+        if isinstance(s, slice):
+            start, stop, step = s.indices(x.shape[ax])
+            out_shape.append(max(0, (stop - start + (step - 1 if step > 0 else step + 1)) // step))
+        else:
+            out_shape.append(len(s))
+    out_shape = tuple(out_shape)
+
+    if out_shape == x.shape and all(
+        isinstance(s, slice) and s.indices(x.shape[i]) == (0, x.shape[i], 1)
+        for i, s in enumerate(selections)
+    ):
+        result = x
+    else:
+        # output keeps the input chunksize (regular chunks)
+        out_chunksize = tuple(
+            min(cs, osh) if osh > 0 else 1
+            for cs, osh in zip(x.chunksize, out_shape)
+        )
+        out_chunks = normalize_chunks(out_chunksize, out_shape, dtype=x.dtype)
+
+        # resolved global selections (start offsets etc.) for task-side math
+        resolved = []
+        for ax, s in enumerate(selections):
+            if isinstance(s, slice):
+                resolved.append(s.indices(x.shape[ax]))
+            else:
+                resolved.append(s)
+
+        extra_projected_mem = x.chunkmem + chunk_memory(x.dtype, out_chunksize)
+
+        result = map_direct(
+            partial(_read_index_chunk, out_chunks=out_chunks, selections=resolved),
+            x,
+            shape=out_shape,
+            dtype=x.dtype,
+            chunks=out_chunks,
+            extra_projected_mem=extra_projected_mem,
+        )
+
+    if int_axes:
+        from ..array_api.manipulation_functions import _squeeze_axes
+
+        result = _squeeze_axes(result, tuple(int_axes))
+    for pos in newaxis_positions:
+        from ..array_api.manipulation_functions import expand_dims
+
+        result = expand_dims(result, axis=pos)
+    return result
+
+
+def _read_index_chunk(block, zarray, *, out_chunks, selections, block_id=None):
+    """Task body for index: read this output block's selection via oindex."""
+    sel = []
+    for ax, (bid, chunks_ax, s) in enumerate(zip(block_id, out_chunks, selections)):
+        start = sum(chunks_ax[:bid])
+        stop = start + chunks_ax[bid]
+        if isinstance(s, tuple):  # resolved slice (start, stop, step)
+            s0, s1, st = s
+            sel.append(slice(s0 + start * st, s0 + stop * st, st))
+        else:
+            sel.append(s[start:stop])
+    out = zarray.oindex[tuple(sel)]
+    return numpy_array_to_backend_array(out)
+
+
+# ---------------------------------------------------------------------------
+# Rechunk / merge_chunks
+# ---------------------------------------------------------------------------
+
+
+def rechunk(x: CoreArray, chunks, target_store=None) -> CoreArray:
+    """Change the chunking of x without changing its shape."""
+    if isinstance(chunks, dict):
+        chunks = {k: v for k, v in chunks.items()}
+        chunks = tuple(chunks.get(i, x.chunksize[i]) for i in range(x.ndim))
+    if isinstance(chunks, (int, np.integer)):
+        chunks = (int(chunks),) * x.ndim
+    norm = normalize_chunks(chunks, x.shape, dtype=x.dtype)
+    target_chunksize = to_chunksize(norm) if x.shape else ()
+    if target_chunksize == x.chunksize:
+        return x
+
+    spec = x.spec
+    name = gensym("array")
+    if target_store is None:
+        target_store = new_temp_path(name, spec)
+    temp_store = new_temp_path(f"{name}-int", spec)
+    ops = primitive_rechunk(
+        x.zarray_maybe_lazy,
+        source_chunks=x.chunksize,
+        target_chunks=target_chunksize,
+        allowed_mem=spec.allowed_mem,
+        reserved_mem=spec.reserved_mem,
+        target_store=target_store,
+        temp_store=temp_store,
+        storage_options=spec.storage_options,
+    )
+    if len(ops) == 1:
+        op = ops[0]
+        plan = Plan._new(name, "rechunk", op.target_array, op, False, x)
+        return new_array(name, op.target_array, spec, plan)
+    op1, op2 = ops
+    int_name = gensym("array")
+    plan1 = Plan._new(int_name, "rechunk", op1.target_array, op1, True, x)
+    intermediate = new_array(int_name, op1.target_array, spec, plan1)
+    plan2 = Plan._new(name, "rechunk", op2.target_array, op2, False, intermediate)
+    return new_array(name, op2.target_array, spec, plan2)
+
+
+def merge_chunks(x: CoreArray, chunks) -> CoreArray:
+    """Coalesce chunks: target chunksize must be a multiple of the current."""
+    target_chunksize = chunks if isinstance(chunks, tuple) else tuple(chunks)
+    if len(target_chunksize) != x.ndim:
+        raise ValueError(f"chunks {chunks} must have {x.ndim} dimensions")
+    if any(
+        t % c != 0 and t != s
+        for t, c, s in zip(target_chunksize, x.chunksize, x.shape)
+    ):
+        raise ValueError(
+            f"merge_chunks: target chunks {chunks} must be a multiple of the "
+            f"current chunks {x.chunksize}"
+        )
+    target_chunks = normalize_chunks(target_chunksize, x.shape, dtype=x.dtype)
+    extra_projected_mem = chunk_memory(x.dtype, to_chunksize(target_chunks)) + x.chunkmem
+    return map_direct(
+        partial(_read_merged_chunk, target_chunks=target_chunks),
+        x,
+        shape=x.shape,
+        dtype=x.dtype,
+        chunks=target_chunks,
+        extra_projected_mem=extra_projected_mem,
+    )
+
+
+def _read_merged_chunk(block, zarray, *, target_chunks, block_id=None):
+    sel = get_item(target_chunks, block_id)
+    return numpy_array_to_backend_array(zarray[sel])
+
+
+# ---------------------------------------------------------------------------
+# Reductions (tree formulation)
+# ---------------------------------------------------------------------------
+
+
+def reduction(
+    x: CoreArray,
+    func: Callable,
+    combine_func: Optional[Callable] = None,
+    aggregate_func: Optional[Callable] = None,
+    axis=None,
+    intermediate_dtype=None,
+    dtype=None,
+    keepdims: bool = False,
+    split_every: Optional[int] = None,
+    extra_func_kwargs: Optional[dict] = None,
+) -> CoreArray:
+    """Tree reduction: per-block partial reduce, then rounds of bounded
+    combines until one block remains per reduced axis, then optional aggregate.
+
+    On the TPU executor the combine rounds over mesh-sharded axes lower to
+    ``lax.psum``-style collective trees (reference: round-based merge/combine
+    through storage, cubed/core/ops.py:790-1090).
+    """
+    if combine_func is None:
+        combine_func = func
+    if axis is None:
+        axis = tuple(range(x.ndim))
+    if isinstance(axis, (int, np.integer)):
+        axis = (int(axis),)
+    axis = tuple(ax % x.ndim for ax in axis)
+    if intermediate_dtype is None:
+        intermediate_dtype = dtype
+
+    kw = dict(extra_func_kwargs or {})
+
+    # initial per-block reduction (reduced axes -> size 1)
+    adjust = {i: 1 for i in range(x.ndim) if i in axis}
+    inds = tuple(range(x.ndim))
+    result = blockwise(
+        partial(_initial_reduce, func=func, axis=axis, kw=kw),
+        inds,
+        x,
+        inds,
+        dtype=intermediate_dtype,
+        adjust_chunks=adjust,
+    )
+
+    # combine rounds
+    split = split_every or 4
+    while any(result.numblocks[ax] > 1 for ax in axis):
+        result = partial_reduce(
+            result,
+            partial(_combine_reduce, combine_func=combine_func, axis=axis, kw=kw),
+            split_every={ax: split for ax in axis},
+            dtype=intermediate_dtype,
+        )
+
+    # aggregate
+    if aggregate_func is not None:
+        result = map_blocks(
+            partial(_apply_aggregate, aggregate_func=aggregate_func),
+            result, dtype=dtype,
+        )
+
+    if not keepdims:
+        from ..array_api.manipulation_functions import _squeeze_axes
+
+        result = _squeeze_axes(result, axis)
+
+    if dtype is not None and result.dtype != np.dtype(dtype):
+        from ..array_api.data_type_functions import astype
+
+        result = astype(result, dtype)
+    return result
+
+
+def _initial_reduce(chunk, *, func, axis, kw):
+    return func(chunk, axis=axis, keepdims=True, **kw)
+
+
+def _combine_reduce(chunks_iter, *, combine_func, axis, kw):
+    """Accumulate streamed chunks pairwise: concat along axes then combine."""
+    acc = None
+    for chunk in chunks_iter:
+        if acc is None:
+            acc = chunk
+        else:
+            merged = _concat_pytree(acc, chunk, axis[0] if len(axis) == 1 else axis)
+            acc = combine_func(merged, axis=axis, keepdims=True, **kw)
+    return acc
+
+
+def _concat_pytree(a, b, axis):
+    ax = axis if isinstance(axis, int) else axis[0]
+    if isinstance(a, dict):
+        return {k: _concat_pytree(a[k], b[k], ax) for k in a}
+    return nxp.concatenate([a, b], axis=ax)
+
+
+def _apply_aggregate(chunk, *, aggregate_func):
+    return aggregate_func(chunk)
+
+
+def partial_reduce(
+    x: CoreArray,
+    func: Callable,
+    split_every: dict,
+    dtype=None,
+) -> CoreArray:
+    """Combine groups of blocks along reduced axes (one tree level).
+
+    The block function yields an *iterator* of input keys so the task streams
+    chunks one at a time (bounded memory regardless of group size).
+    Reference cubed/core/ops.py:1033-1090.
+    """
+    # each merged group of k blocks combines (keepdims) into one size-1 block
+    chunks = tuple(
+        (1,) * math.ceil(len(c) / split_every[i]) if i in split_every else c
+        for i, c in enumerate(x.chunks)
+    )
+    shape = tuple(sum(c) for c in chunks)
+
+    in_numblocks = x.numblocks
+    x_name = x.name
+
+    def block_function(out_key):
+        out_coords = out_key[1:]
+        ranges = []
+        for i, bi in enumerate(out_coords):
+            if i in split_every:
+                k = split_every[i]
+                start = bi * k
+                stop = min(start + k, in_numblocks[i])
+                ranges.append(range(start, stop))
+            else:
+                ranges.append(range(bi, bi + 1))
+        return (iter((x_name, *idx) for idx in itertools.product(*ranges)),)
+
+    extra_projected_mem = 2 * x.chunkmem  # accumulator + concat buffer
+    return general_blockwise(
+        func,
+        block_function,
+        x,
+        shape=shape,
+        dtype=dtype if dtype is not None else x.dtype,
+        chunks=chunks,
+        extra_projected_mem=extra_projected_mem,
+        num_input_blocks=(max(split_every.values()),),
+        fusable=False,
+        op_name="partial_reduce",
+    )
+
+
+def _merged_chunklist(chunks_1d: tuple[int, ...], k: int) -> tuple[int, ...]:
+    out = []
+    for i in range(0, len(chunks_1d), k):
+        out.append(sum(chunks_1d[i : i + k]))
+    return tuple(out)
+
+
+def arg_reduction(
+    x: CoreArray, func: Callable, cmp_func: Callable, axis=None, dtype=np.int64
+) -> CoreArray:
+    """argmin/argmax via a structured {i, v} tree reduction with absolute
+    indices seeded from block_id. Reference cubed/core/ops.py:1093-1153."""
+    if axis is None:
+        raise ValueError("arg_reduction requires an axis (flatten first)")
+    axis = int(axis) % x.ndim
+
+    offsets_per_block = [c for c in x.chunks[axis]]
+    starts = np.cumsum([0] + offsets_per_block[:-1])
+    numblocks = x.numblocks
+
+    def initial(chunk, block_id=None):
+        i = func(chunk, axis=axis, keepdims=True)  # local argmin/argmax
+        v = cmp_func(chunk, axis=axis, keepdims=True)
+        abs_i = i + int(starts[block_id[axis]])
+        return {"i": nxp.asarray(abs_i, dtype=np.int64), "v": v}
+
+    def combine(chunks_iter, axis=None, keepdims=True, **kw):
+        acc = None
+        ax = axis[0] if isinstance(axis, tuple) else axis
+        for chunk in chunks_iter:
+            if acc is None:
+                acc = chunk
+            else:
+                iv = nxp.concatenate([acc["i"], chunk["i"]], axis=ax)
+                vv = nxp.concatenate([acc["v"], chunk["v"]], axis=ax)
+                local = func(vv, axis=ax, keepdims=True)
+                acc = {
+                    "i": nxp.take_along_axis(iv, local, axis=ax),
+                    "v": cmp_func(vv, axis=ax, keepdims=True),
+                }
+        return acc
+
+    intermediate_dtype = np.dtype([("i", np.int64), ("v", x.dtype)])
+
+    result = map_blocks(
+        initial,
+        x,
+        dtype=intermediate_dtype,
+        chunks=tuple(
+            (1,) * numblocks[i] if i == axis else x.chunks[i] for i in range(x.ndim)
+        ),
+    )
+    split = 4
+    while result.numblocks[axis] > 1:
+        result = partial_reduce(
+            result,
+            partial(combine, axis=(axis,)),
+            split_every={axis: split},
+            dtype=intermediate_dtype,
+        )
+    result = map_blocks(lambda c: nxp.asarray(c["i"], dtype=dtype), result, dtype=dtype)
+    from ..array_api.manipulation_functions import _squeeze_axes
+
+    return _squeeze_axes(result, (axis,))
+
+
+# ---------------------------------------------------------------------------
+# squeeze / unify
+# ---------------------------------------------------------------------------
+
+
+def squeeze(x: CoreArray, axis=None) -> CoreArray:
+    from ..array_api.manipulation_functions import squeeze as _squeeze
+
+    return _squeeze(x, axis=axis)
+
+
+def unify_chunks(*args):
+    """Align chunking of arrays sharing index symbols; rechunk as needed.
+
+    Args are (array, ind) pairs. Returns (chunkss, arrays).
+    Reference cubed/core/ops.py:1172-1219.
+    """
+    arrays = list(args[0::2])
+    inds = list(args[1::2])
+
+    # Pick, per symbol, the chunking with the most blocks (regular-storage-
+    # friendly: the common-refinement rule can yield irregular chunks, which
+    # Zarr targets cannot express; rechunk handles arbitrary re-gridding).
+    chunkss: dict = {}
+    for a, ind in zip(arrays, inds):
+        if ind is None:
+            continue
+        for sym, c in zip(ind, a.chunks):
+            if sum(c) == 1 and len(c) == 1:
+                chunkss.setdefault(sym, c)
+            elif sym not in chunkss or (
+                sum(chunkss[sym]) == 1 or len(c) > len(chunkss[sym])
+            ):
+                if sym in chunkss and sum(chunkss[sym]) not in (1, sum(c)):
+                    raise ValueError(
+                        f"Chunks do not align for symbol {sym!r}: "
+                        f"{chunkss[sym]} vs {c}"
+                    )
+                chunkss[sym] = c
+
+    unified = []
+    for a, ind in zip(arrays, inds):
+        if ind is None:
+            unified.append(a)
+            continue
+        target = tuple(
+            chunkss[sym] if sum(chunkss[sym]) == a.shape[dim] else a.chunks[dim]
+            for dim, sym in enumerate(ind)
+        )
+        if target != a.chunks:
+            unified.append(rechunk(a, target))
+        else:
+            unified.append(a)
+    return chunkss, unified

@@ -1,0 +1,264 @@
+"""Plan optimization: blockwise fusion rewrites on the DAG.
+
+Fusing op chains serves two goals: fewer storage round-trips (the reference's
+motivation) and — central here — larger single XLA programs, since the TPU
+executor jit-compiles each op's fused chunk kernel once and XLA fuses the whole
+chain into registers/HBM.
+
+Reference parity: cubed/core/optimization.py (behavioral; clean-room).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterator, Optional
+
+import networkx as nx
+
+from ..primitive.blockwise import (
+    BlockwiseSpec,
+    can_fuse_pipelines,
+    fuse_multiple,
+    is_fuse_candidate,
+)
+
+logger = logging.getLogger(__name__)
+
+#: reference default: do not fuse ops whose combined source-array count
+#: exceeds this (cubed/core/optimization.py:98-209)
+DEFAULT_MAX_TOTAL_SOURCE_ARRAYS = 4
+
+
+def _op_nodes(dag) -> Iterator[str]:
+    for name in list(nx.topological_sort(dag)):
+        if name in dag and dag.nodes[name].get("type") == "op":
+            yield name
+
+
+def _producer_op(dag, array_name: str) -> Optional[str]:
+    preds = list(dag.predecessors(array_name))
+    if len(preds) == 1 and dag.nodes[preds[0]].get("type") == "op":
+        return preds[0]
+    return None
+
+
+def _arg_source_names(primitive_op) -> Optional[list[str]]:
+    """Per-argument input array names, derived by probing the block function."""
+    spec: BlockwiseSpec = primitive_op.pipeline.config
+    try:
+        sample = next(iter(primitive_op.pipeline.mappable))
+    except StopIteration:
+        return None
+    try:
+        structure = spec.block_function(sample)
+    except Exception:
+        return None
+    names = []
+    for entry in structure:
+        key = _first_key(entry)
+        if key is None:
+            return None
+        names.append(key[0])
+    return names
+
+
+def _first_key(entry):
+    if isinstance(entry, tuple) and entry and isinstance(entry[0], str):
+        return entry
+    if isinstance(entry, (list, tuple)):
+        for item in entry:
+            k = _first_key(item)
+            if k is not None:
+                return k
+    return None
+
+
+def can_fuse_predecessors(
+    dag,
+    op_name: str,
+    array_names: Optional[tuple] = None,
+    max_total_source_arrays: int = DEFAULT_MAX_TOTAL_SOURCE_ARRAYS,
+    max_total_num_input_blocks: Optional[int] = None,
+    always_fuse: Optional[set] = None,
+    never_fuse: Optional[set] = None,
+    require_unary: bool = False,
+):
+    """Decide whether op_name's predecessors can fuse into it.
+
+    Returns (arg_names, predecessor_map) or None. predecessor_map maps an input
+    array name to its producing op node when that producer will be fused.
+    """
+    nodes = dag.nodes
+    op = nodes[op_name].get("primitive_op")
+    if op is None or not is_fuse_candidate(op):
+        return None
+    if never_fuse and op_name in never_fuse:
+        return None
+    arg_names = _arg_source_names(op)
+    if arg_names is None:
+        return None
+
+    input_arrays = list(dict.fromkeys(arg_names))
+    if require_unary and len(input_arrays) != 1:
+        return None
+
+    forced = always_fuse is not None and op_name in always_fuse
+    predecessor_map: dict[str, str] = {}
+    total_sources = 0
+    total_input_blocks = 0
+    spec: BlockwiseSpec = op.pipeline.config
+    for arr_name in input_arrays:
+        if arr_name not in dag:
+            return None
+        producer = _producer_op(dag, arr_name)
+        fusable_here = producer is not None
+        if fusable_here:
+            p_op = nodes[producer].get("primitive_op")
+            fusable_here = (
+                p_op is not None
+                and can_fuse_pipelines(p_op, op)
+                and (never_fuse is None or producer not in never_fuse)
+                # the intermediate must have no other consumers and must not be
+                # a requested output
+                and dag.out_degree(arr_name) == _edges_to(dag, arr_name, op_name)
+                and (array_names is None or arr_name not in array_names)
+            )
+        if fusable_here:
+            predecessor_map[arr_name] = producer
+            total_sources += len(p_op.source_array_names) or 1
+            total_input_blocks += sum(p_op.pipeline.config.num_input_blocks)
+        else:
+            total_sources += 1
+            total_input_blocks += 1
+
+    if not predecessor_map:
+        return None
+    if not forced:
+        if total_sources > max_total_source_arrays:
+            logger.debug(
+                "not fusing %s: total source arrays %d > %d",
+                op_name, total_sources, max_total_source_arrays,
+            )
+            return None
+        if (
+            max_total_num_input_blocks is not None
+            and total_input_blocks > max_total_num_input_blocks
+        ):
+            return None
+    return arg_names, predecessor_map
+
+
+def _edges_to(dag, u: str, v: str) -> int:
+    return dag.number_of_edges(u, v)
+
+
+def fuse_predecessors(
+    dag,
+    op_name: str,
+    arg_names: list[str],
+    predecessor_map: dict[str, str],
+) -> bool:
+    """Rewrite the graph fusing the given predecessor ops into op_name.
+
+    Returns False (graph unchanged) if the fused op would exceed allowed_mem.
+    """
+    nodes = dag.nodes
+    op = nodes[op_name]["primitive_op"]
+    predecessor_ops = []
+    for arr_name in arg_names:
+        producer = predecessor_map.get(arr_name)
+        predecessor_ops.append(
+            nodes[producer]["primitive_op"] if producer is not None else None
+        )
+
+    fused = fuse_multiple(op, *predecessor_ops)
+    if fused.projected_mem > op.allowed_mem > 0:
+        logger.debug(
+            "not fusing %s: projected mem %d > allowed %d",
+            op_name, fused.projected_mem, op.allowed_mem,
+        )
+        return False
+
+    nodes[op_name]["primitive_op"] = fused
+    nodes[op_name]["pipeline"] = fused.pipeline
+
+    for arr_name, producer in predecessor_map.items():
+        # rewire: sources of the fused producer now feed op_name directly
+        for src in list(dag.predecessors(producer)):
+            dag.add_edge(src, op_name)
+        dag.remove_node(arr_name)
+        dag.remove_node(producer)
+    return True
+
+
+def simple_optimize_dag(dag, array_names: Optional[tuple] = None):
+    """Linear map-fusion of op1 -> array -> op2 chains (unary only)."""
+    dag = dag.copy()
+    for op_name in list(_op_nodes(dag)):
+        if op_name not in dag:
+            continue
+        result = can_fuse_predecessors(
+            dag, op_name, array_names=array_names, require_unary=True
+        )
+        if result is None:
+            continue
+        arg_names, predecessor_map = result
+        fuse_predecessors(dag, op_name, arg_names, predecessor_map)
+    return dag
+
+
+def multiple_inputs_optimize_dag(
+    dag,
+    array_names: Optional[tuple] = None,
+    max_total_source_arrays: int = DEFAULT_MAX_TOTAL_SOURCE_ARRAYS,
+    max_total_num_input_blocks: Optional[int] = None,
+    always_fuse: Optional[set] = None,
+    never_fuse: Optional[set] = None,
+):
+    """N-ary predecessor fusion in topological order (the default optimizer)."""
+    dag = dag.copy()
+    for op_name in list(_op_nodes(dag)):
+        if op_name not in dag:
+            continue
+        result = can_fuse_predecessors(
+            dag,
+            op_name,
+            array_names=array_names,
+            max_total_source_arrays=max_total_source_arrays,
+            max_total_num_input_blocks=max_total_num_input_blocks,
+            always_fuse=always_fuse,
+            never_fuse=never_fuse,
+        )
+        if result is None:
+            continue
+        arg_names, predecessor_map = result
+        fuse_predecessors(dag, op_name, arg_names, predecessor_map)
+    return dag
+
+
+def fuse_all_optimize_dag(dag, array_names: Optional[tuple] = None):
+    """Test helper: fuse as aggressively as possible."""
+    all_ops = {n for n, d in dag.nodes(data=True) if d.get("type") == "op"}
+    return multiple_inputs_optimize_dag(
+        dag,
+        array_names=array_names,
+        max_total_source_arrays=10**9,
+        max_total_num_input_blocks=10**9,
+        always_fuse=all_ops,
+    )
+
+
+def fuse_only_optimize_dag(
+    dag, array_names: Optional[tuple] = None, only_fuse: Optional[set] = None
+):
+    """Test helper: fuse only the named ops."""
+    all_ops = {n for n, d in dag.nodes(data=True) if d.get("type") == "op"}
+    never = all_ops - set(only_fuse or ())
+    return multiple_inputs_optimize_dag(
+        dag,
+        array_names=array_names,
+        always_fuse=set(only_fuse or ()),
+        never_fuse=never,
+        max_total_source_arrays=10**9,
+        max_total_num_input_blocks=10**9,
+    )

@@ -1,0 +1,317 @@
+"""The lazy whole-operation DAG.
+
+Nodes alternate between *op* nodes (carrying a PrimitiveOperation) and *array*
+nodes (carrying a Zarr target). Data never flows through the graph — each op
+reads chunks of input arrays from shared storage (or, under the TPU executor,
+from HBM-resident buffers) and writes chunks of one output array.
+
+Reference parity: cubed/core/plan.py (behavioral; clean-room).
+"""
+
+from __future__ import annotations
+
+import inspect
+import itertools
+import shutil
+import tempfile
+import uuid
+from functools import lru_cache
+from typing import Any, Callable, Optional, Sequence
+
+import networkx as nx
+
+from ..primitive.types import CubedPipeline, PrimitiveOperation
+from ..runtime.pipeline import already_computed
+from ..runtime.types import (
+    ComputeEndEvent,
+    ComputeStartEvent,
+    callbacks_on,
+)
+from ..storage.zarr import LazyZarrArray
+from ..utils import StackSummary, extract_stack_summaries, join_path, memory_repr
+
+#: unique run id for this client process; work_dir data lives under it
+CONTEXT_ID = f"cubed-{uuid.uuid4().hex[:10]}"
+
+sym_counter = itertools.count()
+
+
+def gensym(name: str = "op") -> str:
+    return f"{name}-{next(sym_counter):03d}"
+
+
+def new_temp_path(name: str, spec=None) -> str:
+    """A unique storage path for an intermediate array in the work_dir."""
+    work_dir = spec.work_dir if spec is not None and spec.work_dir else tempfile.gettempdir()
+    context_dir = join_path(work_dir, CONTEXT_ID)
+    return join_path(context_dir, f"{name}.zarr")
+
+
+class Plan:
+    """A deferred computation constructed as a DAG of whole-array operations."""
+
+    def __init__(self, dag: nx.MultiDiGraph):
+        self.dag = dag
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def _new(
+        cls,
+        name: str,
+        op_name: str,
+        target,
+        primitive_op: Optional[PrimitiveOperation] = None,
+        hidden: bool = False,
+        *source_arrays,
+    ) -> "Plan":
+        """Create a new plan adding an op (and its output array) to the union
+        of the source arrays' plans."""
+        dag = arrays_to_dag(*source_arrays)
+
+        frame = inspect.currentframe()
+        # skip this frame and internal callers
+        stack_summaries = extract_stack_summaries(frame.f_back if frame else None)
+
+        if primitive_op is None:
+            # op with no computation (e.g. wrapping an existing zarr array)
+            op_node = gensym(f"op-{op_name}")
+            dag.add_node(
+                op_node,
+                name=op_node,
+                type="op",
+                op_display_name=f"{op_name}\n{name}",
+                op_name=op_name,
+                primitive_op=None,
+                hidden=hidden,
+                stack_summaries=stack_summaries,
+            )
+            dag.add_node(name, name=name, type="array", target=target, hidden=hidden)
+            dag.add_edge(op_node, name)
+            for x in source_arrays:
+                dag.add_edge(x.name, op_node)
+        else:
+            op_node = gensym(f"op-{op_name}")
+            dag.add_node(
+                op_node,
+                name=op_node,
+                type="op",
+                op_display_name=f"{op_name}\n{name}",
+                op_name=op_name,
+                primitive_op=primitive_op,
+                pipeline=primitive_op.pipeline,
+                hidden=hidden,
+                stack_summaries=stack_summaries,
+            )
+            dag.add_node(name, name=name, type="array", target=target, hidden=hidden)
+            dag.add_edge(op_node, name)
+            for x in source_arrays:
+                dag.add_edge(x.name, op_node)
+        return Plan(dag)
+
+    @classmethod
+    def arrays_to_plan(cls, *arrays) -> "Plan":
+        return Plan(arrays_to_dag(*arrays))
+
+    # -- finalization ------------------------------------------------------
+
+    def _finalize(
+        self,
+        optimize_graph: bool = True,
+        optimize_function: Optional[Callable] = None,
+        array_names: Optional[tuple] = None,
+    ) -> "FinalizedPlan":
+        dag = self.optimize(optimize_function, array_names).dag if optimize_graph else self.dag
+        dag = dag.copy()
+        dag = self.create_lazy_zarr_arrays(dag)
+        return FinalizedPlan(nx.freeze(dag))
+
+    def optimize(
+        self,
+        optimize_function: Optional[Callable] = None,
+        array_names: Optional[tuple] = None,
+    ) -> "Plan":
+        from .optimization import multiple_inputs_optimize_dag
+
+        if optimize_function is None:
+            optimize_function = multiple_inputs_optimize_dag
+        dag = optimize_function(self.dag.copy(), array_names=array_names)
+        return Plan(dag)
+
+    def create_lazy_zarr_arrays(self, dag: nx.MultiDiGraph) -> nx.MultiDiGraph:
+        """Inject a single first op that writes metadata for every lazy target."""
+        lazy = [
+            (name, data["target"])
+            for name, data in dag.nodes(data=True)
+            if data.get("type") == "array" and isinstance(data.get("target"), LazyZarrArray)
+        ]
+        if not lazy:
+            return dag
+        op_node = "create-arrays"
+        targets = [t for _, t in lazy]
+        pipeline = CubedPipeline(
+            create_zarr_array, op_node, targets, None
+        )
+        primitive_op = PrimitiveOperation(
+            pipeline=pipeline,
+            source_array_names=[],
+            target_array=None,
+            projected_mem=0,
+            allowed_mem=0,
+            reserved_mem=0,
+            num_tasks=len(targets),
+            fusable=False,
+        )
+        dag.add_node(
+            op_node,
+            name=op_node,
+            type="op",
+            op_display_name=f"{op_node}\n{len(targets)} arrays",
+            op_name=op_node,
+            primitive_op=primitive_op,
+            pipeline=pipeline,
+            hidden=False,
+            stack_summaries=[],
+        )
+        # run before every other op (reference: edges to all pipeline nodes,
+        # cubed/core/plan.py:136-176)
+        for name, data in list(dag.nodes(data=True)):
+            if (
+                data.get("type") == "op"
+                and name != op_node
+                and data.get("primitive_op") is not None
+            ):
+                dag.add_edge(op_node, name)
+        return dag
+
+    # -- execution ---------------------------------------------------------
+
+    def execute(
+        self,
+        executor=None,
+        callbacks: Optional[Sequence] = None,
+        optimize_graph: bool = True,
+        optimize_function: Optional[Callable] = None,
+        resume: Optional[bool] = None,
+        array_names: Optional[tuple] = None,
+        spec=None,
+        **kwargs,
+    ) -> None:
+        if executor is None:
+            from ..runtime.executors.python import PythonDagExecutor
+
+            executor = PythonDagExecutor()
+
+        finalized = self._finalize(optimize_graph, optimize_function, array_names)
+        dag = finalized.dag
+
+        callbacks_on(callbacks, "on_compute_start", ComputeStartEvent(dag, resume))
+        executor.execute_dag(
+            dag,
+            callbacks=callbacks,
+            array_names=array_names,
+            resume=resume,
+            spec=spec,
+            **kwargs,
+        )
+        callbacks_on(callbacks, "on_compute_end", ComputeEndEvent(dag))
+
+    # -- introspection -----------------------------------------------------
+
+    def num_tasks(self, optimize_graph=True, optimize_function=None, resume=None) -> int:
+        finalized = self._finalize(optimize_graph, optimize_function)
+        return finalized.num_tasks(resume=resume)
+
+    def num_arrays(self, optimize_graph=True, optimize_function=None) -> int:
+        finalized = self._finalize(optimize_graph, optimize_function)
+        return finalized.num_arrays()
+
+    def max_projected_mem(self, optimize_graph=True, optimize_function=None, resume=None) -> int:
+        finalized = self._finalize(optimize_graph, optimize_function)
+        return finalized.max_projected_mem(resume=resume)
+
+    def total_nbytes_written(self, optimize_graph=True, optimize_function=None) -> int:
+        finalized = self._finalize(optimize_graph, optimize_function)
+        return finalized.total_nbytes_written()
+
+    def visualize(
+        self,
+        filename="cubed",
+        format=None,
+        rankdir="TB",
+        optimize_graph=True,
+        optimize_function=None,
+        show_hidden=False,
+    ):
+        from .visualization import visualize_dag
+
+        finalized = self._finalize(optimize_graph, optimize_function)
+        return visualize_dag(
+            finalized.dag,
+            filename=filename,
+            format=format,
+            rankdir=rankdir,
+            show_hidden=show_hidden,
+        )
+
+
+class FinalizedPlan:
+    """A frozen, optimized DAG ready for execution."""
+
+    def __init__(self, dag: nx.MultiDiGraph):
+        self.dag = dag
+
+    def num_tasks(self, resume=None) -> int:
+        nodes = dict(self.dag.nodes(data=True))
+        total = 0
+        for name in nx.topological_sort(self.dag):
+            if already_computed(name, self.dag, nodes, resume):
+                continue
+            total += nodes[name]["primitive_op"].num_tasks
+        return total
+
+    def num_arrays(self) -> int:
+        return sum(1 for _, d in self.dag.nodes(data=True) if d.get("type") == "array")
+
+    def num_ops(self) -> int:
+        return sum(
+            1
+            for _, d in self.dag.nodes(data=True)
+            if d.get("type") == "op" and d.get("primitive_op") is not None
+        )
+
+    def max_projected_mem(self, resume=None) -> int:
+        nodes = dict(self.dag.nodes(data=True))
+        mems = [
+            nodes[name]["primitive_op"].projected_mem
+            for name in nx.topological_sort(self.dag)
+            if not already_computed(name, self.dag, nodes, resume)
+        ]
+        return max(mems) if mems else 0
+
+    def total_nbytes_written(self) -> int:
+        return sum(
+            d["target"].nbytes
+            for _, d in self.dag.nodes(data=True)
+            if d.get("type") == "array" and isinstance(d.get("target"), LazyZarrArray)
+        )
+
+
+def arrays_to_dag(*arrays) -> nx.MultiDiGraph:
+    """Union of the plans of the given arrays (sharing nodes by name)."""
+    from .array import check_array_specs
+
+    check_array_specs(arrays)
+    dags = [a.plan.dag for a in arrays if hasattr(a, "plan")]
+    if not dags:
+        return nx.MultiDiGraph()
+    return nx.compose_all(dags)
+
+
+def arrays_to_plan(*arrays) -> Plan:
+    return Plan(arrays_to_dag(*arrays))
+
+
+def create_zarr_array(lazy_array: LazyZarrArray, config=None) -> None:
+    """Task body of the create-arrays op."""
+    lazy_array.create(mode="a")

@@ -1,0 +1,98 @@
+"""NaN-aware reductions (non-standard extensions).
+
+Reference parity: cubed/nan_functions.py:21-79. ``nanmean`` uses a {n, total}
+pytree intermediate counting only non-NaN elements.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .backend_array_api import nxp
+from .core.ops import reduction
+from .array_api.dtypes import (
+    _numeric_dtypes,
+    _signed_integer_dtypes,
+    _unsigned_integer_dtypes,
+    complex64,
+    float32,
+    int64,
+    uint64,
+)
+
+
+def nanmean(x, /, *, axis=None, keepdims=False, split_every=None):
+    """Mean ignoring NaNs."""
+    dtype = x.dtype
+    intermediate_dtype = np.dtype([("n", np.int64), ("total", np.float64)])
+    return reduction(
+        x,
+        _nanmean_func,
+        combine_func=_nanmean_combine,
+        aggregate_func=_nanmean_aggregate,
+        axis=axis,
+        intermediate_dtype=intermediate_dtype,
+        dtype=dtype,
+        keepdims=keepdims,
+        split_every=split_every,
+    )
+
+
+def _nanmean_func(a, axis=None, keepdims=True, **kw):
+    n = nxp.sum(
+        nxp.astype(nxp.logical_not(nxp.isnan(a)), np.int64),
+        axis=axis, keepdims=keepdims,
+    )
+    total = _nansum_arr(a, axis=axis, keepdims=keepdims, dtype=np.float64)
+    return {"n": n, "total": total}
+
+
+def _nanmean_combine(a, axis=None, keepdims=True, **kw):
+    n = nxp.sum(a["n"], axis=axis, keepdims=keepdims)
+    total = nxp.sum(a["total"], axis=axis, keepdims=keepdims)
+    return {"n": n, "total": total}
+
+
+def _nanmean_aggregate(a):
+    # avoid divide-by-zero: all-NaN regions produce NaN like numpy.nanmean
+    n = nxp.asarray(a["n"], dtype=np.float64)
+    return nxp.where(n > 0, nxp.divide(a["total"], nxp.where(n > 0, n, 1)), np.nan)
+
+
+def nansum(x, /, *, axis=None, dtype=None, keepdims=False, split_every=None):
+    """Sum ignoring NaNs."""
+    if x.dtype not in _numeric_dtypes:
+        raise TypeError("Only numeric dtypes are allowed in nansum")
+    if dtype is None:
+        if x.dtype in _signed_integer_dtypes:
+            dtype = int64
+        elif x.dtype in _unsigned_integer_dtypes:
+            dtype = uint64
+        elif x.dtype == float32:
+            dtype = float32
+        elif x.dtype == complex64:
+            dtype = complex64
+        else:
+            dtype = x.dtype
+    dtype = np.dtype(dtype)
+    return reduction(
+        x,
+        _nansum_arr,
+        combine_func=_sum_arr,
+        axis=axis,
+        intermediate_dtype=dtype,
+        dtype=dtype,
+        keepdims=keepdims,
+        split_every=split_every,
+        extra_func_kwargs=dict(dtype=dtype),
+    )
+
+
+def _nansum_arr(a, axis=None, keepdims=True, dtype=None, **kw):
+    if np.dtype(a.dtype).kind in "fc":
+        a = nxp.where(nxp.isnan(a), nxp.asarray(0, dtype=a.dtype), a)
+    return nxp.sum(a, axis=axis, keepdims=keepdims, dtype=dtype)
+
+
+def _sum_arr(a, axis=None, keepdims=True, dtype=None, **kw):
+    return nxp.sum(a, axis=axis, keepdims=keepdims, dtype=dtype)

@@ -1,0 +1,7 @@
+from .types import (  # noqa: F401
+    CubedArrayProxy,
+    CubedCopySpec,
+    CubedPipeline,
+    MemoryModeller,
+    PrimitiveOperation,
+)

@@ -1,0 +1,76 @@
+"""Shared primitive-layer types. Reference parity: cubed/primitive/types.py:11-75
+and cubed/runtime/types.py:17-24 (CubedPipeline)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+from ..storage.zarr import LazyZarrArray, open_if_lazy_zarr_array
+
+
+@dataclass
+class CubedPipeline:
+    """Serializable op payload: a task function mapped over a task-input iterable."""
+
+    function: Callable
+    name: str
+    mappable: Iterable
+    config: Any
+
+
+@dataclass
+class PrimitiveOperation:
+    """Encapsulates metadata and the pipeline for a primitive operation."""
+
+    pipeline: CubedPipeline
+    source_array_names: list
+    target_array: Any
+    projected_mem: int
+    allowed_mem: int
+    reserved_mem: int
+    num_tasks: int
+    fusable: bool = True
+    write_chunks: Optional[tuple] = None
+
+
+class CubedArrayProxy:
+    """Wrapper around a concrete/lazy/virtual array for task-side access.
+
+    This is what serializes to workers; ``open()`` resolves a LazyZarrArray to
+    its concrete store at task run time.
+    """
+
+    def __init__(self, array: Any, chunks: tuple):
+        self.array = array
+        self.chunks = tuple(chunks)
+
+    def open(self):
+        return open_if_lazy_zarr_array(self.array)
+
+    def __repr__(self) -> str:
+        return f"CubedArrayProxy({self.array!r}, chunks={self.chunks})"
+
+
+@dataclass
+class CubedCopySpec:
+    """Specification of a copy (rechunk stage): read region -> write region."""
+
+    read: CubedArrayProxy
+    write: CubedArrayProxy
+
+
+class MemoryModeller:
+    """Models peak memory of an alloc/free sequence (used to bound fused ops)."""
+
+    def __init__(self) -> None:
+        self.current_mem = 0
+        self.peak_mem = 0
+
+    def allocate(self, num_bytes: int) -> None:
+        self.current_mem += num_bytes
+        self.peak_mem = max(self.peak_mem, self.current_mem)
+
+    def free(self, num_bytes: int) -> None:
+        self.current_mem -= num_bytes
+        self.peak_mem = max(self.peak_mem, self.current_mem)

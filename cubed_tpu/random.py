@@ -1,0 +1,53 @@
+"""Coordination-free distributed random arrays.
+
+The reference keys a Philox generator by ``root_seed + linear block offset``
+(cubed/random.py:13-36); the TPU-native equivalent is the jax threefry PRNG
+with ``jax.random.fold_in(key, block_offset)`` — the same per-block
+determinism contract (reproducible regardless of which worker/chip computes
+which block), expressed with the native counter-based PRNG.
+"""
+
+from __future__ import annotations
+
+import random as pyrandom
+
+import numpy as np
+
+from .backend_array_api import BACKEND, nxp
+from .chunks import normalize_chunks
+from .core.ops import map_blocks
+from .array_api.creation_functions import empty
+from .utils import block_id_to_offset
+
+
+def random(size, *, diagnostics=None, chunks=None, spec=None):
+    """Uniform [0, 1) float64 array with per-block reproducible randomness."""
+    shape = (size,) if isinstance(size, int) else tuple(size)
+    dtype = np.float64
+    chunks = normalize_chunks(chunks, shape, dtype=dtype)
+    numblocks = tuple(len(c) for c in chunks)
+    root_seed = pyrandom.getrandbits(32)
+
+    return map_blocks(
+        _RandomBlock(root_seed, numblocks),
+        empty(shape, dtype=dtype, chunks=chunks, spec=spec),
+        dtype=dtype,
+    )
+
+
+class _RandomBlock:
+    __name__ = "random_block"
+
+    def __init__(self, root_seed: int, numblocks):
+        self.root_seed = root_seed
+        self.numblocks = numblocks
+
+    def __call__(self, chunk, block_id=None):
+        offset = block_id_to_offset(block_id, self.numblocks) if block_id else 0
+        if BACKEND == "jax":
+            import jax
+
+            key = jax.random.fold_in(jax.random.key(self.root_seed), offset)
+            return jax.random.uniform(key, chunk.shape, dtype=np.float64)
+        rng = np.random.Generator(np.random.Philox(seed=self.root_seed + offset))
+        return rng.random(chunk.shape, dtype=np.float64)

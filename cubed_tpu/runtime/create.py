@@ -1,0 +1,22 @@
+"""Executor registry: create executors by name (Spec(executor_name=...))."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def create_executor(name: str, executor_options: Optional[dict] = None):
+    executor_options = executor_options or {}
+    if name in ("single-threaded", "python"):
+        from .executors.python import PythonDagExecutor
+
+        return PythonDagExecutor(**executor_options)
+    if name in ("threads", "processes", "async-python"):
+        from .executors.python_async import AsyncPythonDagExecutor
+
+        return AsyncPythonDagExecutor(**executor_options)
+    if name in ("jax", "tpu", "jax-tpu"):
+        from .executors.jax import JaxExecutor
+
+        return JaxExecutor(**executor_options)
+    raise ValueError(f"Unrecognized executor name: {name!r}")

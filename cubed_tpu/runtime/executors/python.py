@@ -1,0 +1,47 @@
+"""Sequential in-process executor — the correctness oracle.
+
+Reference parity: cubed/runtime/executors/python.py:14-32.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..pipeline import visit_nodes
+from ..types import (
+    Callback,
+    ComputeEndEvent,
+    ComputeStartEvent,
+    DagExecutor,
+    OperationStartEvent,
+    TaskEndEvent,
+    callbacks_on,
+)
+from ..utils import execute_with_stats, handle_callbacks
+
+
+class PythonDagExecutor(DagExecutor):
+    """For each op in topological order, run its tasks one by one in-process."""
+
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+
+    @property
+    def name(self) -> str:
+        return "single-threaded"
+
+    def execute_dag(self, dag, callbacks=None, resume=None, spec=None, **kwargs) -> None:
+        for name, node in visit_nodes(dag, resume=resume):
+            primitive_op = node["primitive_op"]
+            pipeline = primitive_op.pipeline
+            callbacks_on(
+                callbacks, "on_operation_start",
+                OperationStartEvent(name, primitive_op.num_tasks),
+            )
+            for m in pipeline.mappable:
+                created = time.time()
+                _, stats = execute_with_stats(pipeline.function, m, config=pipeline.config)
+                handle_callbacks(
+                    callbacks,
+                    dict(stats, array_name=name, task_create_tstamp=created),
+                )

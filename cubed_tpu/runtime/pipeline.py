@@ -1,0 +1,57 @@
+"""DAG traversal helpers shared by all executors.
+
+Reference parity: cubed/runtime/pipeline.py:8-57.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import networkx as nx
+
+
+def already_computed(name, dag, nodes: dict, resume: bool | None) -> bool:
+    """True if this node's computation can be skipped.
+
+    Nodes without a pipeline (array nodes) are always skipped. With
+    ``resume=True`` an op is skipped when every successor array's store reports
+    all chunks initialized (the op-granularity checkpoint).
+    """
+    pipeline = nodes[name].get("primitive_op", None)
+    if pipeline is None:
+        return True
+    if resume:
+        for succ in dag.successors(name):
+            target = nodes[succ].get("target", None)
+            if target is None:
+                return False
+            try:
+                arr = target.open() if hasattr(target, "open") else target
+                if arr.nchunks_initialized != arr.nchunks:
+                    return False
+            except FileNotFoundError:
+                return False
+        return True
+    return False
+
+
+def visit_nodes(dag, resume: bool | None = None) -> Iterator[tuple[str, dict]]:
+    """Yield (name, node-data) for op nodes in topological order."""
+    nodes = dict(dag.nodes(data=True))
+    for name in nx.topological_sort(dag):
+        if already_computed(name, dag, nodes, resume):
+            continue
+        yield name, nodes[name]
+
+
+def visit_node_generations(dag, resume: bool | None = None) -> Iterator[list]:
+    """Yield lists of (name, node-data) for ops in the same topological generation."""
+    nodes = dict(dag.nodes(data=True))
+    for generation in nx.topological_generations(dag):
+        gen = [
+            (name, nodes[name])
+            for name in generation
+            if not already_computed(name, dag, nodes, resume)
+        ]
+        if gen:
+            yield gen

@@ -1,0 +1,76 @@
+"""Executor protocol and observability event types.
+
+Reference parity: cubed/runtime/types.py:9-88.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+
+class DagExecutor:
+    """Protocol for plan executors: map each op's task function over its tasks."""
+
+    @property
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def execute_dag(self, dag, callbacks=None, array_names=None, resume=None, spec=None, **kwargs) -> None:
+        raise NotImplementedError
+
+
+Executor = DagExecutor
+
+
+@dataclass
+class TaskEndEvent:
+    """Metrics for a completed task."""
+
+    array_name: str
+    num_tasks: int = 1
+    task_create_tstamp: Optional[float] = None
+    function_start_tstamp: Optional[float] = None
+    function_end_tstamp: Optional[float] = None
+    task_result_tstamp: Optional[float] = None
+    peak_measured_mem_start: Optional[int] = None
+    peak_measured_mem_end: Optional[int] = None
+
+
+class Callback:
+    """Observer protocol for compute lifecycle events."""
+
+    def on_compute_start(self, event) -> None:
+        """Called when the computation is about to start; event has .dag, .resume."""
+
+    def on_compute_end(self, event) -> None:
+        """Called when the computation has finished; event has .dag."""
+
+    def on_operation_start(self, event) -> None:
+        """Called when an op begins; event has .name and .num_tasks."""
+
+    def on_task_end(self, event: TaskEndEvent) -> None:
+        """Called when one or more tasks of an op finish."""
+
+
+@dataclass
+class ComputeStartEvent:
+    dag: object
+    resume: Optional[bool] = None
+
+
+@dataclass
+class ComputeEndEvent:
+    dag: object
+
+
+@dataclass
+class OperationStartEvent:
+    name: str
+    num_tasks: int = 0
+
+
+def callbacks_on(callbacks: Optional[Sequence[Callback]], method: str, event) -> None:
+    if callbacks:
+        for cb in callbacks:
+            getattr(cb, method, lambda e: None)(event)

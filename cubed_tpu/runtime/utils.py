@@ -1,0 +1,54 @@
+"""Task execution instrumentation: wall time + peak host memory per task.
+
+Reference parity: cubed/runtime/utils.py:17-64.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from functools import partial
+from typing import Iterable, Iterator, Optional, Sequence
+
+from ..utils import peak_measured_mem
+from .types import Callback, TaskEndEvent
+
+
+def execute_with_stats(function, *args, **kwargs):
+    """Run a task function, returning (result, stats-dict)."""
+    peak_before = peak_measured_mem()
+    start = time.time()
+    result = function(*args, **kwargs)
+    end = time.time()
+    peak_after = peak_measured_mem()
+    return result, dict(
+        function_start_tstamp=start,
+        function_end_tstamp=end,
+        peak_measured_mem_start=peak_before,
+        peak_measured_mem_end=peak_after,
+    )
+
+
+def execution_stats(function):
+    """Decorator adding timing/memory stats to a task function's return value."""
+    return partial(execute_with_stats, function)
+
+
+def handle_callbacks(callbacks: Optional[Sequence[Callback]], stats: dict) -> None:
+    if not callbacks:
+        return
+    if "task_result_tstamp" not in stats:
+        stats = dict(stats, task_result_tstamp=time.time())
+    event = TaskEndEvent(**stats)
+    for cb in callbacks:
+        cb.on_task_end(event)
+
+
+def batched(iterable: Iterable, n: int) -> Iterator[list]:
+    """Yield successive lists of up to *n* items."""
+    it = iter(iterable)
+    while True:
+        batch = list(itertools.islice(it, n))
+        if not batch:
+            return
+        yield batch

@@ -1,0 +1,115 @@
+"""Resource specification carried by every array.
+
+Reference parity: cubed/spec.py:7-102. TPU additions: ``device_mem`` (per-chip
+HBM budget used by the TPU executor's residency planner) and ``mesh_shape``.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from typing import Any, Optional, Union
+
+from .utils import convert_to_bytes, memory_repr
+
+#: Defaults when no spec is given (reference: cubed/core/array.py:44-48)
+DEFAULT_ALLOWED_MEM = 200_000_000
+DEFAULT_RESERVED_MEM = 100_000_000
+
+
+class Spec:
+    """Specification of resources available to run a computation."""
+
+    def __init__(
+        self,
+        work_dir: Optional[str] = None,
+        allowed_mem: Union[int, str, None] = None,
+        reserved_mem: Union[int, str, None] = 0,
+        executor: Optional[Any] = None,
+        storage_options: Optional[dict] = None,
+        device_mem: Union[int, str, None] = None,
+        mesh_shape: Optional[tuple] = None,
+        executor_name: Optional[str] = None,
+        executor_options: Optional[dict] = None,
+    ):
+        self._work_dir = work_dir
+        self._reserved_mem = convert_to_bytes(reserved_mem or 0)
+        if allowed_mem is None:
+            self._allowed_mem = self._reserved_mem
+        else:
+            self._allowed_mem = convert_to_bytes(allowed_mem)
+        self._executor = executor
+        self._executor_name = executor_name
+        self._executor_options = executor_options
+        self._storage_options = storage_options
+        self._device_mem = convert_to_bytes(device_mem) if device_mem is not None else None
+        self._mesh_shape = mesh_shape
+
+    @property
+    def work_dir(self) -> Optional[str]:
+        """The directory (path or fsspec URL) for intermediate Zarr data."""
+        return self._work_dir
+
+    @property
+    def allowed_mem(self) -> int:
+        """Total memory (bytes) available to a worker for one task.
+
+        Plan-time guarantee: any op whose ``projected_mem`` exceeds this raises
+        before execution begins.
+        """
+        return self._allowed_mem
+
+    @property
+    def reserved_mem(self) -> int:
+        """Memory (bytes) reserved on a worker before any task runs."""
+        return self._reserved_mem
+
+    @property
+    def executor(self) -> Optional[Any]:
+        if self._executor is None and self._executor_name is not None:
+            from .runtime.create import create_executor
+
+            return create_executor(self._executor_name, self._executor_options)
+        return self._executor
+
+    @property
+    def storage_options(self) -> Optional[dict]:
+        return self._storage_options
+
+    @property
+    def device_mem(self) -> Optional[int]:
+        """Per-chip HBM budget for the TPU executor's residency planner."""
+        return self._device_mem
+
+    @property
+    def mesh_shape(self) -> Optional[tuple]:
+        return self._mesh_shape
+
+    def __repr__(self) -> str:
+        return (
+            f"Spec(work_dir={self._work_dir!r}, "
+            f"allowed_mem={memory_repr(self._allowed_mem)}, "
+            f"reserved_mem={memory_repr(self._reserved_mem)}, "
+            f"executor={self._executor!r}, storage_options={self._storage_options!r})"
+        )
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Spec):
+            return (
+                self.work_dir == other.work_dir
+                and self.allowed_mem == other.allowed_mem
+                and self.reserved_mem == other.reserved_mem
+                and self.executor == other.executor
+                and self.storage_options == other.storage_options
+            )
+        return False
+
+
+def spec_from_config(spec: Optional[Spec]) -> Spec:
+    """Fill in a default spec (temp work_dir, 200MB allowed / 100MB reserved)."""
+    if spec is not None:
+        return spec
+    return Spec(
+        work_dir=tempfile.gettempdir(),
+        allowed_mem=DEFAULT_ALLOWED_MEM,
+        reserved_mem=DEFAULT_RESERVED_MEM,
+    )

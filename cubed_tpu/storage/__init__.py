@@ -1,0 +1,17 @@
+from .store import ZarrV2Array, open_zarr_array  # noqa: F401
+from .zarr import (  # noqa: F401
+    LazyZarrArray,
+    lazy_empty,
+    lazy_full,
+    open_if_lazy_zarr_array,
+)
+from .virtual import (  # noqa: F401
+    VirtualEmptyArray,
+    VirtualFullArray,
+    VirtualInMemoryArray,
+    VirtualOffsetsArray,
+    virtual_empty,
+    virtual_full,
+    virtual_in_memory,
+    virtual_offsets,
+)

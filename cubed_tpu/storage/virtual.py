@@ -1,0 +1,149 @@
+"""Virtual arrays: inputs that are never materialized in storage but plug into
+the chunk-read path. Reference parity: cubed/storage/virtual.py:14-182."""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..chunks import blockdims_from_blockshape
+from ..utils import broadcast_trick
+
+#: Arrays at or under this size may be kept in memory and shipped with the plan
+#: (reference cubed/storage/virtual.py:105).
+MAX_IN_MEMORY_BYTES = 1_000_000
+
+
+def _normalize_key(key, shape):
+    if not isinstance(key, tuple):
+        key = (key,)
+    key = key + (slice(None),) * (len(shape) - len(key))
+    return tuple(
+        slice(*k.indices(s)) if isinstance(k, slice) else slice(int(k), int(k) + 1)
+        for k, s in zip(key, shape)
+    )
+
+
+class _VirtualBase:
+    """Common surface shared with ZarrV2Array so the read path is uniform."""
+
+    shape: tuple[int, ...]
+    dtype: np.dtype
+    chunks: tuple[int, ...]
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def size(self) -> int:
+        return prod(self.shape) if self.shape else 1
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * self.dtype.itemsize
+
+    def chunkset(self):
+        return blockdims_from_blockshape(self.shape, self.chunks)
+
+    def open(self):
+        return self
+
+
+class VirtualEmptyArray(_VirtualBase):
+    """Uninitialized array; reads return a stride-0 broadcast (no allocation)."""
+
+    def __init__(self, shape: Sequence[int], dtype: Any, chunks: Sequence[int]):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self.chunks = tuple(int(c) for c in chunks) if self.shape else ()
+
+    def __getitem__(self, key) -> np.ndarray:
+        sel = _normalize_key(key, self.shape)
+        shape = tuple(max(0, s.stop - s.start) for s in sel)
+        return broadcast_trick(np.empty)(shape, dtype=self.dtype)
+
+
+class VirtualFullArray(_VirtualBase):
+    """Constant-valued array; reads broadcast a single element."""
+
+    def __init__(self, shape, dtype, chunks, fill_value):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self.chunks = tuple(int(c) for c in chunks) if self.shape else ()
+        self.fill_value = fill_value
+
+    def __getitem__(self, key) -> np.ndarray:
+        sel = _normalize_key(key, self.shape)
+        shape = tuple(max(0, s.stop - s.start) for s in sel)
+        return broadcast_trick(np.full)(shape, self.fill_value, dtype=self.dtype)
+
+
+class VirtualOffsetsArray(_VirtualBase):
+    """Maps each (1,...,1)-shaped chunk to its linear block offset.
+
+    Appended as a hidden input to ``map_blocks`` calls that need ``block_id``:
+    the task reads its offset and unravels it. Reference parity:
+    cubed/storage/virtual.py:82-102.
+    """
+
+    def __init__(self, shape: Sequence[int]):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(np.int32)
+        self.chunks = (1,) * len(self.shape)
+
+    def __getitem__(self, key) -> np.ndarray:
+        sel = _normalize_key(key, self.shape)
+        idx = tuple(s.start for s in sel)
+        if any(s.stop - s.start != 1 for s in sel):
+            raise IndexError("VirtualOffsetsArray must be read one block at a time")
+        offset = int(np.ravel_multi_index(idx, self.shape)) if self.shape else 0
+        return np.full((1,) * len(self.shape), offset, dtype=self.dtype)
+
+
+class VirtualInMemoryArray(_VirtualBase):
+    """A small literal array carried with the plan (for ``asarray``)."""
+
+    def __init__(self, array: np.ndarray, chunks: Sequence[int], max_nbytes: int = MAX_IN_MEMORY_BYTES):
+        if array.nbytes > max_nbytes:
+            raise ValueError(
+                f"Size of in memory array is {array.nbytes} which exceeds maximum "
+                f"of {max_nbytes}. Consider loading the array from storage using "
+                f"`from_array`."
+            )
+        self.array = np.asarray(array)
+        self.shape = self.array.shape
+        self.dtype = self.array.dtype
+        self.chunks = tuple(int(c) for c in chunks) if self.shape else ()
+
+    def __getitem__(self, key) -> np.ndarray:
+        return self.array[key]
+
+    @property
+    def oindex(self):
+        class _O:
+            def __init__(self, a):
+                self.a = a
+
+            def __getitem__(self, key):
+                return self.a[np.ix_(*[np.atleast_1d(k) if not isinstance(k, slice) else np.arange(*k.indices(s)) for k, s in zip(key if isinstance(key, tuple) else (key,), self.a.shape)])]
+
+        return _O(self.array)
+
+
+def virtual_empty(shape, *, dtype, chunks, **kwargs) -> VirtualEmptyArray:
+    return VirtualEmptyArray(shape, dtype, chunks)
+
+
+def virtual_full(shape, fill_value, *, dtype, chunks, **kwargs) -> VirtualFullArray:
+    return VirtualFullArray(shape, dtype, chunks, fill_value)
+
+
+def virtual_offsets(shape) -> VirtualOffsetsArray:
+    return VirtualOffsetsArray(shape)
+
+
+def virtual_in_memory(array, chunks) -> VirtualInMemoryArray:
+    return VirtualInMemoryArray(array, chunks)

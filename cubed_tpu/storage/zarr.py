@@ -1,0 +1,99 @@
+"""Lazy Zarr arrays: metadata creation deferred until the plan-wide
+``create-arrays`` op runs. Reference parity: cubed/storage/zarr.py:8-103."""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..chunks import blockdims_from_blockshape
+from .store import ZarrV2Array, open_zarr_array
+
+
+class LazyZarrArray:
+    """A Zarr array template that has not yet been written to storage.
+
+    Carries shape/dtype/chunks/store so plan construction is pure metadata;
+    ``create()`` writes the store-level metadata and ``open()`` returns the
+    concrete array (which must have been created first).
+    """
+
+    def __init__(
+        self,
+        store: str,
+        shape: Sequence[int],
+        dtype: Any,
+        chunks: Sequence[int],
+        fill_value: Any = None,
+        storage_options: Optional[dict] = None,
+    ):
+        self.store = str(store)
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self.chunks = tuple(int(c) for c in chunks)
+        self.fill_value = fill_value
+        self.storage_options = storage_options
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def size(self) -> int:
+        return prod(self.shape) if self.shape else 1
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * self.dtype.itemsize
+
+    def chunkset(self) -> tuple[tuple[int, ...], ...]:
+        return blockdims_from_blockshape(self.shape, self.chunks)
+
+    def create(self, mode: str = "w-") -> ZarrV2Array:
+        """Write the array metadata to storage and return the open array.
+
+        Uses append-like semantics ("a") during plan execution so resumed runs
+        keep previously computed chunks (reference cubed/core/plan.py:430-432).
+        """
+        return open_zarr_array(
+            self.store,
+            mode="a" if mode in ("a", "w-") else mode,
+            shape=self.shape,
+            dtype=self.dtype,
+            chunks=self.chunks,
+            fill_value=self.fill_value,
+            storage_options=self.storage_options,
+        )
+
+    def open(self) -> ZarrV2Array:
+        return open_zarr_array(self.store, mode="r", storage_options=self.storage_options)
+
+    def __repr__(self) -> str:
+        return f"LazyZarrArray<{self.store}, shape={self.shape}, dtype={self.dtype}, chunks={self.chunks}>"
+
+
+def lazy_empty(
+    shape: Sequence[int], *, dtype: Any, chunks: Sequence[int], store: str, **kwargs
+) -> LazyZarrArray:
+    return LazyZarrArray(store, shape, dtype, chunks, **kwargs)
+
+
+def lazy_full(
+    shape: Sequence[int],
+    fill_value: Any,
+    *,
+    dtype: Any,
+    chunks: Sequence[int],
+    store: str,
+    **kwargs,
+) -> LazyZarrArray:
+    return LazyZarrArray(store, shape, dtype, chunks, fill_value=fill_value, **kwargs)
+
+
+def open_if_lazy_zarr_array(array):
+    """Resolve a LazyZarrArray to its concrete store; pass others through."""
+    if isinstance(array, LazyZarrArray):
+        return array.open()
+    return array

@@ -1,0 +1,296 @@
+"""General utilities: byte-string parsing, chunk math, nested-structure helpers.
+
+Semantics follow the reference (cubed/utils.py) but are reimplemented from scratch
+for a TPU-first stack: memory accounting models HBM tiles rather than worker RSS.
+Reference parity: cubed/utils.py:92-312.
+"""
+
+from __future__ import annotations
+
+import itertools
+import platform
+import re
+import sys
+from dataclasses import dataclass
+from math import prod
+from operator import add
+from pathlib import Path
+from posixpath import join as _urljoin
+from resource import RUSAGE_SELF, getrusage
+from typing import Any, Iterable, Iterator, Sequence
+from urllib.parse import urlsplit, urlunsplit
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Byte-size parsing and formatting
+# ---------------------------------------------------------------------------
+
+_BYTE_UNITS = {
+    "": 1,
+    "B": 1,
+    "KB": 10**3,
+    "MB": 10**6,
+    "GB": 10**9,
+    "TB": 10**12,
+    "PB": 10**15,
+    "KIB": 2**10,
+    "MIB": 2**20,
+    "GIB": 2**30,
+    "TIB": 2**40,
+    "PIB": 2**50,
+    # single-letter suffixes are binary, matching common usage ("100M")
+    "K": 2**10,
+    "M": 2**20,
+    "G": 2**30,
+    "T": 2**40,
+    "P": 2**50,
+}
+
+_BYTES_RE = re.compile(r"^\s*([0-9]*\.?[0-9]+)\s*([A-Za-z]*)\s*$")
+
+
+def convert_to_bytes(value: int | float | str | None) -> int | None:
+    """Parse a human byte string (``"2GB"``, ``"100MiB"``, ``"1_000"``) to an int.
+
+    Ints/floats pass through (floats must be integral). Reference parity:
+    cubed/utils.py:201-258.
+    """
+    if value is None:
+        return None
+    if isinstance(value, (int, np.integer)):
+        if value < 0:
+            raise ValueError(f"Invalid byte value: {value!r} (negative)")
+        return int(value)
+    if isinstance(value, float):
+        if not value.is_integer() or value < 0:
+            raise ValueError(f"Invalid byte value: {value!r}")
+        return int(value)
+    if isinstance(value, str):
+        m = _BYTES_RE.match(value.replace("_", ""))
+        if not m:
+            raise ValueError(f"Invalid byte string: {value!r}")
+        number, unit = m.groups()
+        unit = unit.upper()
+        if unit not in _BYTE_UNITS:
+            raise ValueError(f"Invalid byte unit {unit!r} in {value!r}")
+        result = float(number) * _BYTE_UNITS[unit]
+        if not float(result).is_integer():
+            raise ValueError(f"Byte string {value!r} is not an integral byte count")
+        return int(result)
+    raise TypeError(f"Cannot convert {type(value)} to bytes")
+
+
+def memory_repr(num: int | float) -> str:
+    """Render a byte count human-readably (``1.5 GB``)."""
+    if num < 1000:
+        return f"{int(num)} bytes"
+    for unit in ("KB", "MB", "GB", "TB", "PB"):
+        num /= 1000.0
+        if num < 1000.0:
+            return f"{num:3.1f} {unit}"
+    return f"{num:3.1f} EB"
+
+
+# ---------------------------------------------------------------------------
+# Chunk math
+# ---------------------------------------------------------------------------
+
+
+def itemsize(dtype) -> int:
+    """Bytes per element for a dtype (numpy or jax)."""
+    return np.dtype(dtype).itemsize
+
+
+def chunk_memory(dtype, chunksize: Sequence[int]) -> int:
+    """Bytes of memory for one chunk of the given dtype and shape."""
+    return itemsize(dtype) * prod(int(c) for c in chunksize)
+
+
+def array_memory(dtype, shape: Sequence[int]) -> int:
+    return itemsize(dtype) * prod(int(s) for s in shape)
+
+
+def to_chunksize(chunkset: tuple[tuple[int, ...], ...]) -> tuple[int, ...]:
+    """Collapse a per-dim tuple-of-block-sizes to a single chunk shape.
+
+    Requires regular chunking: in each dimension all blocks equal except a
+    possibly-smaller final block. Reference parity: cubed/utils.py (to_chunksize).
+    """
+    if not _check_regular_chunks(chunkset):
+        raise ValueError(f"Array must have regular chunks, but found chunks={chunkset}")
+    return tuple(c[0] if len(c) > 0 else 1 for c in chunkset)
+
+
+def _check_regular_chunks(chunkset: tuple[tuple[int, ...], ...]) -> bool:
+    """True if every dim's blocks are uniform except a possibly-smaller last block."""
+    for chunks in chunkset:
+        if len(chunks) == 0:
+            continue
+        if len(chunks) == 1:
+            continue
+        if len(set(chunks[:-1])) > 1:
+            return False
+        if chunks[-1] > chunks[0]:
+            return False
+    return True
+
+
+def get_item(chunks: tuple[tuple[int, ...], ...], idx: tuple[int, ...]) -> tuple[slice, ...]:
+    """Convert a block index into the tuple of slices selecting that block."""
+    starts = tuple(tuple(accumulate_prepend_zero(c)) for c in chunks)
+    return tuple(
+        slice(start[i], start[i] + c[i]) for c, start, i in zip(chunks, starts, idx)
+    )
+
+
+def accumulate_prepend_zero(seq: Sequence[int]) -> list[int]:
+    out = [0]
+    for s in seq:
+        out.append(out[-1] + s)
+    return out[:-1]
+
+
+def offset_to_block_id(offset: int, numblocks: Sequence[int]) -> tuple[int, ...]:
+    """Linear offset -> nd block index (C order)."""
+    return tuple(int(i) for i in np.unravel_index(offset, tuple(numblocks)))
+
+
+def block_id_to_offset(block_id: Sequence[int], numblocks: Sequence[int]) -> int:
+    """nd block index -> linear offset (C order)."""
+    return int(np.ravel_multi_index(tuple(block_id), tuple(numblocks)))
+
+
+def chunk_starts(chunks_1d: Sequence[int]) -> list[int]:
+    return accumulate_prepend_zero(chunks_1d)
+
+
+# ---------------------------------------------------------------------------
+# Paths
+# ---------------------------------------------------------------------------
+
+
+def join_path(dir_url: str, child_path: str) -> str:
+    """Join a path to a directory that may be a filesystem path or a URL."""
+    parts = urlsplit(str(dir_url))
+    if parts.scheme in ("", "file"):
+        p = Path(str(dir_url).replace("file://", "")) / child_path
+        return str(p)
+    return urlunsplit(
+        (parts.scheme, parts.netloc, _urljoin(parts.path, child_path), parts.query, parts.fragment)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host memory measurement (for the CPU oracle executor; TPU path uses HBM stats)
+# ---------------------------------------------------------------------------
+
+
+def peak_measured_mem() -> int:
+    """Peak RSS of this process in bytes (getrusage ru_maxrss)."""
+    ru_maxrss = getrusage(RUSAGE_SELF).ru_maxrss
+    # ru_maxrss is KiB on Linux, bytes on macOS
+    if platform.system() == "Darwin":
+        return ru_maxrss
+    return ru_maxrss * 1024
+
+
+# ---------------------------------------------------------------------------
+# Nested-structure helpers
+# ---------------------------------------------------------------------------
+
+
+def split_into(iterable: Iterable, sizes: Iterable[int]) -> Iterator[list]:
+    """Split *iterable* into sublists of the given sizes; ``None`` = the rest."""
+    it = iter(iterable)
+    for size in sizes:
+        if size is None:
+            yield list(it)
+            return
+        yield list(itertools.islice(it, size))
+
+
+def map_nested(func, seq):
+    """Apply *func* to every non-list element of an arbitrarily nested list."""
+    if isinstance(seq, list):
+        return [map_nested(func, item) for item in seq]
+    return func(seq)
+
+
+def flatten_nested(seq) -> Iterator:
+    if isinstance(seq, (list, tuple)):
+        for item in seq:
+            yield from flatten_nested(item)
+    else:
+        yield seq
+
+
+# ---------------------------------------------------------------------------
+# Broadcast trick: constant-chunk arrays with zero storage
+# ---------------------------------------------------------------------------
+
+
+def broadcast_trick(func):
+    """Wrap a numpy creation function so the result is a stride-0 broadcast.
+
+    ``ones((1000,1000))`` allocates one element and broadcasts it, so virtual
+    full/empty arrays cost no memory until written to. Reference parity:
+    cubed/utils.py:296-312.
+    """
+
+    def wrapper(shape, *args, **kwargs):
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        meta = func((), *args, **kwargs)
+        return np.broadcast_to(meta, shape)
+
+    wrapper.__name__ = getattr(func, "__name__", "broadcast_trick")
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# Caller-stack provenance for plan nodes
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class StackSummary:
+    """A lightweight record of one frame of the user call stack."""
+
+    filename: str
+    lineno: int
+    name: str
+    array_names_to_variable_names: dict[str, str]
+
+    def is_cubed(self) -> bool:
+        normalized = self.filename.replace("\\", "/")
+        return "/cubed_tpu/" in normalized or normalized.endswith("cubed_tpu")
+
+
+def extract_stack_summaries(frame, limit: int = 10) -> list[StackSummary]:
+    """Walk the caller stack, mapping internal array names to user variable names.
+
+    Inspects each frame's locals for framework arrays so ``visualize()`` can label
+    op nodes with the user's own variable names. Reference parity:
+    cubed/utils.py:128-198.
+    """
+    summaries: list[StackSummary] = []
+    while frame is not None and len(summaries) < limit:
+        name_map = {}
+        try:
+            for var, val in frame.f_locals.items():
+                nm = getattr(val, "name", None)
+                if nm is not None and type(nm) is str and hasattr(val, "zarray_maybe_lazy"):
+                    name_map[nm] = var
+        except Exception:
+            pass
+        summaries.append(
+            StackSummary(
+                filename=frame.f_code.co_filename,
+                lineno=frame.f_lineno,
+                name=frame.f_code.co_name,
+                array_names_to_variable_names=name_map,
+            )
+        )
+        frame = frame.f_back
+    summaries.reverse()
+    return summaries

@@ -1,0 +1,230 @@
+"""Core integration tests, executor-parametrized.
+
+Reference parity: cubed/tests/test_core.py (behavioral).
+"""
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.core.optimization import fuse_all_optimize_dag, simple_optimize_dag
+
+from .utils import TaskCounter, all_executors
+
+
+@pytest.fixture(params=all_executors(), ids=lambda e: e.name)
+def executor(request):
+    return request.param
+
+
+def test_regular_chunks(spec):
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    assert a.chunks == ((2, 2, 2), (2, 2, 2))
+    assert a.numblocks == (3, 3)
+    assert a.npartitions == 9
+
+
+def test_ragged_chunks(spec):
+    a = xp.ones((7, 5), chunks=(3, 2), spec=spec)
+    assert a.chunks == ((3, 3, 1), (2, 2, 1))
+
+
+def test_add(spec, executor):
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    b = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    c = xp.add(a, b)
+    assert np.array_equal(c.compute(executor=executor), np.full((6, 6), 2.0))
+
+
+def test_add_ragged(spec, executor):
+    an = np.arange(35.0).reshape(7, 5)
+    a = ct.from_array(an, chunks=(3, 2), spec=spec)
+    b = ct.from_array(an, chunks=(3, 2), spec=spec)
+    c = xp.add(a, b)
+    assert np.allclose(c.compute(executor=executor), an + an)
+
+
+def test_add_different_chunks(spec, executor):
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = ct.from_array(an, chunks=(3, 3), spec=spec)
+    c = xp.add(a, b)
+    assert np.allclose(c.compute(executor=executor), an + an)
+
+
+def test_add_scalar(spec, executor):
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    c = a + 5.0
+    assert np.allclose(c.compute(executor=executor), an + 5.0)
+
+
+def test_broadcast(spec, executor):
+    an = np.arange(36.0).reshape(6, 6)
+    bn = np.arange(6.0)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = ct.from_array(bn, chunks=(2,), spec=spec)
+    c = xp.add(a, b)
+    assert np.allclose(c.compute(executor=executor), an + bn)
+
+
+def test_sum(spec, executor):
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    assert np.allclose(xp.sum(a).compute(executor=executor), an.sum())
+
+
+def test_sum_axis(spec, executor):
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    assert np.allclose(xp.sum(a, axis=0).compute(executor=executor), an.sum(axis=0))
+    assert np.allclose(xp.sum(a, axis=1).compute(executor=executor), an.sum(axis=1))
+
+
+def test_mean_keepdims(spec, executor):
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    assert np.allclose(
+        xp.mean(a, axis=1, keepdims=True).compute(executor=executor),
+        an.mean(axis=1, keepdims=True),
+    )
+
+
+def test_fused_add_sum(spec, executor):
+    a = xp.ones((10, 10), chunks=(3, 3), spec=spec)
+    b = xp.ones((10, 10), chunks=(3, 3), spec=spec)
+    s = xp.sum(xp.add(a, b))
+    assert float(s.compute(executor=executor)) == 200.0
+
+
+def test_multiple_outputs(spec, executor):
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = xp.add(a, a)
+    c = xp.multiply(a, a)
+    rb, rc = ct.compute(b, c, executor=executor)
+    assert np.allclose(rb, an + an)
+    assert np.allclose(rc, an * an)
+
+
+def test_from_zarr_to_zarr(spec, executor, tmp_path):
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    store = str(tmp_path / "out.zarr")
+    ct.to_zarr(xp.add(a, 1.0), store, executor=executor)
+    b = ct.from_zarr(store, spec=spec)
+    assert np.allclose(b.compute(executor=executor), an + 1.0)
+
+
+def test_rechunk(spec, executor):
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = a.rechunk((3, 3))
+    assert b.chunksize == (3, 3)
+    assert np.allclose(b.compute(executor=executor), an)
+
+
+def test_rechunk_staged(executor, tmp_path):
+    # tight memory budget forces the two-pass (intermediate) rechunk
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem=20000, reserved_mem=0)
+    an = np.arange(900.0).reshape(30, 30)
+    a = ct.from_array(an, chunks=(30, 2), spec=spec)
+    b = a.rechunk((2, 30))
+    assert np.allclose(b.compute(executor=executor), an)
+
+
+def test_compute_is_idempotent(spec, executor):
+    a = xp.ones((4, 4), chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1)
+    assert np.array_equal(b.compute(executor=executor), np.full((4, 4), 2.0))
+    assert np.array_equal(b.compute(executor=executor), np.full((4, 4), 2.0))
+
+
+def test_plan_scaling(spec):
+    # plan size is O(ops); a long chain must build fast and count tasks
+    a = xp.ones((4, 4), chunks=(2, 2), spec=spec)
+    for _ in range(50):
+        a = xp.add(a, 1)
+    assert a.plan.num_tasks(optimize_graph=False) > 0
+
+
+def test_callbacks(spec, executor):
+    counter = TaskCounter()
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1)
+    b.compute(executor=executor, callbacks=[counter], optimize_graph=False)
+    assert counter.value > 0
+
+
+def test_resume(spec):
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1)
+    c = xp.add(b, 1)
+    counter = TaskCounter()
+    c.compute(callbacks=[counter], optimize_graph=False)
+    n_first = counter.value
+    counter2 = TaskCounter()
+    c.compute(callbacks=[counter2], optimize_graph=False, resume=True)
+    # everything already computed -> no (or far fewer) tasks
+    assert counter2.value < n_first
+
+
+def test_visualize(spec, tmp_path):
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1)
+    out = b.visualize(filename=str(tmp_path / "plan"))
+    import os
+
+    assert os.path.exists(out)
+
+
+def test_projected_mem_exceeded(tmp_path):
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem=1000, reserved_mem=0)
+    a = xp.ones((100, 100), chunks=(100, 100), spec=spec)
+    with pytest.raises(ValueError, match="exceeds allowed_mem"):
+        xp.add(a, a)
+
+
+def test_spec_mismatch(tmp_path):
+    s1 = ct.Spec(work_dir=str(tmp_path), allowed_mem=100_000_000)
+    s2 = ct.Spec(work_dir=str(tmp_path), allowed_mem=200_000_000)
+    a = xp.ones((4, 4), chunks=(2, 2), spec=s1)
+    b = xp.ones((4, 4), chunks=(2, 2), spec=s2)
+    with pytest.raises(ValueError, match="same spec"):
+        xp.add(a, b)
+
+
+def test_optimization_fuses_map_chain(spec):
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1)
+    c = xp.add(b, 1)
+    unopt = c.plan.num_tasks(optimize_graph=False)
+    opt = c.plan.num_tasks(optimize_graph=True)
+    assert opt < unopt
+    assert np.array_equal(c.compute(), np.full((6, 6), 3.0))
+
+
+def test_reduction_multiple_rounds(spec, executor):
+    an = np.ones((64, 4))
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    s = xp.sum(a, axis=0, split_every=2)
+    assert np.allclose(s.compute(executor=executor), an.sum(axis=0))
+
+
+def test_merge_chunks(spec, executor):
+    from cubed_tpu.core.ops import merge_chunks
+
+    an = np.arange(100.0).reshape(10, 10)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = merge_chunks(a, (4, 4))
+    assert b.chunksize == (4, 4)
+    assert np.allclose(b.compute(executor=executor), an)
+
+
+def test_unify_chunks_applies(spec, executor):
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    b = ct.from_array(an, chunks=(3, 2), spec=spec)
+    c = xp.add(a, b)
+    assert np.allclose(c.compute(executor=executor), an + an)

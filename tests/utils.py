@@ -1,0 +1,61 @@
+"""Shared test helpers: executor lists and a task-counting callback.
+
+Reference parity: cubed/tests/utils.py:14-103.
+"""
+
+from __future__ import annotations
+
+import platform
+
+from cubed_tpu.runtime.types import Callback
+
+
+def all_executors():
+    from cubed_tpu.runtime.executors.python import PythonDagExecutor
+
+    executors = [PythonDagExecutor()]
+    try:
+        from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+
+        if platform.system() != "Windows":
+            executors.append(AsyncPythonDagExecutor())
+    except ImportError:
+        pass
+    try:
+        from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+        executors.append(JaxExecutor())
+    except ImportError:
+        pass
+    return executors
+
+
+def main_executors():
+    return all_executors()
+
+
+class TaskCounter(Callback):
+    def __init__(self):
+        self.value = 0
+        self.events = []
+
+    def on_compute_start(self, event):
+        self.value = 0
+
+    def on_task_end(self, event):
+        self.events.append(event)
+        if event.task_create_tstamp is not None:
+            assert (
+                event.task_result_tstamp
+                >= event.function_end_tstamp
+                >= event.function_start_tstamp
+                >= event.task_create_tstamp
+                > 0
+            )
+        self.value += event.num_tasks
+
+
+def execute_pipeline(primitive_op, executor=None):
+    """Run a single primitive op outside a plan (unit-test harness)."""
+    for m in primitive_op.pipeline.mappable:
+        primitive_op.pipeline.function(m, config=primitive_op.pipeline.config)
