@@ -150,6 +150,7 @@ def _store_op(x: CoreArray, store, storage_options) -> CoreArray:
         dtype=x.dtype,
         target_store=str(store),
         storage_options=storage_options,
+        shape_invariant=True,
     )
 
 
@@ -300,7 +301,9 @@ def elemwise(func: Callable, *args: CoreArray, dtype=None) -> CoreArray:
         nd = getattr(a, "ndim", 0)
         # trailing dims align rightmost (broadcasting); 0-d arrays use ()
         blockwise_args.extend([a, tuple(range(nd))[::-1]])
-    return blockwise(func, expr_inds, *blockwise_args, dtype=dtype)
+    return blockwise(
+        func, expr_inds, *blockwise_args, dtype=dtype, shape_invariant=True
+    )
 
 
 def map_blocks(

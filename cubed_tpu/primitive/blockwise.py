@@ -69,6 +69,11 @@ class BlockwiseSpec:
     num_input_blocks: tuple[int, ...]
     reads_map: Dict[str, CubedArrayProxy]
     write: CubedArrayProxy
+    #: True when ``function`` commutes with chunking (pure elementwise /
+    #: broadcasting kernels): applying it to whole arrays equals applying it
+    #: per chunk. The TPU executor uses this to run the entire (fused) kernel
+    #: as ONE XLA program over HBM-resident arrays.
+    shape_invariant: bool = False
 
 
 def get_chunk(arr, chunkset, block_idx: tuple[int, ...]):
@@ -221,6 +226,7 @@ def blockwise(
     extra_projected_mem: int = 0,
     extra_func_kwargs: Optional[Dict] = None,
     fusable: bool = True,
+    shape_invariant: bool = False,
     storage_options: Optional[dict] = None,
     **kwargs,
 ) -> PrimitiveOperation:
@@ -263,6 +269,7 @@ def blockwise(
         out_name=out_name,
         extra_projected_mem=extra_projected_mem,
         fusable=fusable,
+        shape_invariant=shape_invariant,
         storage_options=storage_options,
     )
 
@@ -292,6 +299,7 @@ def general_blockwise(
     extra_projected_mem: int = 0,
     num_input_blocks: Optional[tuple[int, ...]] = None,
     fusable: bool = True,
+    shape_invariant: bool = False,
     storage_options: Optional[dict] = None,
 ) -> PrimitiveOperation:
     """Build a PrimitiveOperation for an explicit block function."""
@@ -340,6 +348,7 @@ def general_blockwise(
         num_input_blocks=num_input_blocks or (1,) * len(arrays),
         reads_map=reads_map,
         write=write,
+        shape_invariant=shape_invariant,
     )
     pipeline = CubedPipeline(apply_blockwise, gensym("blockwise"), mappable, spec)
     return PrimitiveOperation(
@@ -502,6 +511,8 @@ def fuse_multiple(
         num_input_blocks=tuple(num_input_blocks) or spec.num_input_blocks,
         reads_map=reads_map,
         write=spec.write,
+        shape_invariant=spec.shape_invariant
+        and all(ps is None or ps.shape_invariant for ps in pred_specs),
     )
     pipeline = CubedPipeline(
         apply_blockwise, gensym("fused"), op.pipeline.mappable, fused_spec

@@ -1,0 +1,545 @@
+"""The JAX/TPU executor: HBM-resident execution of the plan.
+
+Design (SURVEY.md section 7; north star in BASELINE.json):
+
+- **Residency.** Arrays live as ``jax.Array``s in HBM, keyed by their target
+  store path. Zarr is only touched at plan boundaries: sources are loaded once,
+  and requested outputs are flushed at the end. Intermediates never hit
+  storage (the reference pays a full storage round-trip per op).
+- **Whole-array fast path.** Ops whose kernel is shape-invariant (elementwise /
+  broadcasting chains, including everything the optimizer fused) and whose
+  block mapping is 1:1-with-broadcast run as ONE jitted call on whole resident
+  arrays — XLA fuses the entire chain; intermediates stay in registers/HBM.
+- **Chunked fallback.** Any other op (tree-reduce combines, map_direct,
+  index, reshape, block_id kernels) runs per output chunk: inputs are sliced
+  from resident arrays on device (XLA slice, no host transfer), the chunk
+  kernel is jitted once per shape, and results assemble by concatenation.
+- **Rechunk is free.** Resident arrays are whole arrays, so a rechunk op is
+  pure metadata (an alias). Under a device mesh the corresponding physical
+  movement is a resharding (``device_put`` with a new NamedSharding), which
+  XLA lowers to all-to-all over ICI — not a storage round-trip.
+- **Mesh / SPMD.** With ``mesh`` set, resident arrays are placed with a
+  ``NamedSharding`` over the chunk grid's largest dim and whole-array kernels
+  run under that sharding; XLA's partitioner inserts the collectives
+  (psum trees for reductions riding ICI).
+- **Spill path.** If HBM residency would exceed ``device_mem``, least-recently
+  used arrays are flushed to their Zarr targets and dropped; reads fall back
+  to storage. This keeps the bounded-memory story for arrays larger than HBM.
+
+Reference parity: replaces cubed's serverless executors
+(cubed/runtime/executors/*) with a device-mesh substrate.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import math
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from ...chunks import blockdims_from_blockshape
+from ...primitive.blockwise import BlockwiseSpec, apply_blockwise
+from ...primitive.rechunk import copy_read_to_write
+from ...core.plan import create_zarr_array
+from ...storage.store import ZarrV2Array
+from ...storage.virtual import (
+    VirtualEmptyArray,
+    VirtualFullArray,
+    VirtualInMemoryArray,
+    VirtualOffsetsArray,
+)
+from ...storage.zarr import LazyZarrArray
+from ...utils import get_item
+from ..pipeline import visit_nodes
+from ..types import (
+    Callback,
+    DagExecutor,
+    OperationStartEvent,
+    TaskEndEvent,
+    callbacks_on,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+class _Resident:
+    """An HBM-resident array (or dict-of-arrays pytree) plus bookkeeping."""
+
+    __slots__ = ("value", "nbytes", "last_used", "target")
+
+    def __init__(self, value, nbytes: int, target):
+        self.value = value
+        self.nbytes = nbytes
+        self.last_used = time.monotonic()
+        self.target = target
+
+    def touch(self):
+        self.last_used = time.monotonic()
+
+
+def _value_nbytes(value) -> int:
+    if isinstance(value, dict):
+        return sum(_value_nbytes(v) for v in value.values())
+    return int(np.prod(value.shape)) * value.dtype.itemsize if value.shape else value.dtype.itemsize
+
+
+class JaxExecutor(DagExecutor):
+    """Executes the plan with HBM residency on the default jax backend.
+
+    Parameters
+    ----------
+    mesh : jax.sharding.Mesh | None
+        Shard resident arrays and whole-array kernels over this mesh.
+    device_mem : int | None
+        HBM residency budget in bytes (default: 75% of one device's memory,
+        times the number of mesh devices when sharded).
+    """
+
+    def __init__(self, mesh=None, device_mem: Optional[int] = None, **kwargs):
+        self.mesh = mesh
+        self.device_mem = device_mem
+        self.kwargs = kwargs
+
+    @property
+    def name(self) -> str:
+        return "jax"
+
+    # ------------------------------------------------------------------
+
+    def _budget(self) -> int:
+        if self.device_mem is not None:
+            return self.device_mem
+        jax = _jax()
+        try:
+            stats = jax.devices()[0].memory_stats()
+            per_device = int(stats["bytes_limit"] * 0.75)
+        except Exception:
+            per_device = 8 * 2**30  # CPU/virtual devices: pick a sane default
+        n = len(self.mesh.devices.flat) if self.mesh is not None else 1
+        return per_device * n
+
+    def _sharding_for(self, shape: tuple[int, ...]):
+        """NamedSharding partitioning the largest dim over all mesh axes."""
+        if self.mesh is None or not shape:
+            return None
+        jax = _jax()
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        axis_names = tuple(self.mesh.axis_names)
+        total = math.prod(self.mesh.axis_sizes)
+        # choose the largest dim divisible by the mesh size; else replicate
+        order = sorted(range(len(shape)), key=lambda i: -shape[i])
+        for dim in order:
+            if shape[dim] % total == 0 and shape[dim] > 0:
+                spec = [None] * len(shape)
+                spec[dim] = axis_names if len(axis_names) > 1 else axis_names[0]
+                return NamedSharding(self.mesh, PartitionSpec(*spec))
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def _device_put(self, value, shape):
+        jax = _jax()
+        sharding = self._sharding_for(shape)
+        if sharding is not None:
+            if isinstance(value, dict):
+                return {k: jax.device_put(v, self._sharding_for(v.shape)) for k, v in value.items()}
+            return jax.device_put(value, sharding)
+        if isinstance(value, dict):
+            return {k: jax.device_put(v) for k, v in value.items()}
+        return jax.device_put(value)
+
+    # ------------------------------------------------------------------
+
+    def execute_dag(
+        self,
+        dag,
+        callbacks: Optional[list[Callback]] = None,
+        array_names=None,
+        resume=None,
+        spec=None,
+        **kwargs,
+    ) -> None:
+        jax = _jax()
+        resident: Dict[str, _Resident] = {}
+        budget = self._budget()
+
+        # map array-node name -> target, to know what must be flushed
+        requested_stores = set()
+        node_targets = {}
+        for name, d in dag.nodes(data=True):
+            if d.get("type") == "array" and d.get("target") is not None:
+                node_targets[name] = d["target"]
+                if array_names is None or name in array_names:
+                    t = d["target"]
+                    if isinstance(t, (LazyZarrArray, ZarrV2Array)):
+                        requested_stores.add(str(t.store))
+
+        for name, node in visit_nodes(dag, resume=resume):
+            primitive_op = node["primitive_op"]
+            pipeline = primitive_op.pipeline
+            callbacks_on(
+                callbacks, "on_operation_start",
+                OperationStartEvent(name, primitive_op.num_tasks),
+            )
+            t0 = time.time()
+            if pipeline.function is apply_blockwise:
+                self._exec_blockwise(primitive_op, resident, budget)
+            elif pipeline.function is copy_read_to_write:
+                self._exec_rechunk(primitive_op, resident, budget)
+            elif pipeline.function is create_zarr_array:
+                # create metadata only for arrays that will actually be
+                # persisted; residency replaces the rest
+                for lazy in pipeline.mappable:
+                    if str(lazy.store) in requested_stores:
+                        lazy.create(mode="a")
+            else:  # pragma: no cover - unknown pipeline type: run it as-is
+                for m in pipeline.mappable:
+                    pipeline.function(m, config=pipeline.config)
+            t1 = time.time()
+            callbacks_on(
+                callbacks, "on_task_end",
+                TaskEndEvent(
+                    array_name=name,
+                    num_tasks=primitive_op.num_tasks,
+                    task_create_tstamp=t0,
+                    function_start_tstamp=t0,
+                    function_end_tstamp=t1,
+                    task_result_tstamp=t1,
+                ),
+            )
+
+        # flush requested outputs that are still resident
+        for store, res in list(resident.items()):
+            if store in requested_stores:
+                self._flush(res)
+
+    # ------------------------------------------------------------------
+    # blockwise
+    # ------------------------------------------------------------------
+
+    def _exec_blockwise(self, op, resident: Dict[str, _Resident], budget: int) -> None:
+        jax = _jax()
+        spec: BlockwiseSpec = op.pipeline.config
+        target = spec.write.array  # LazyZarrArray (or concrete for store ops)
+        out_shape = tuple(target.shape)
+        out_store = str(target.store)
+
+        inputs = self._whole_inputs(spec, resident)
+
+        value = None
+        if spec.shape_invariant and not getattr(spec.function, "needs_block_id", False):
+            mapping = self._probe_one_to_one(spec, op)
+            if mapping and inputs is not None:
+                try:
+                    fn = jax.jit(spec.function)
+                    full = [inputs[n] for n in mapping]
+                    value = fn(*full)
+                    if not isinstance(value, dict) and tuple(value.shape) != out_shape:
+                        value = None  # kernel wasn't truly shape-invariant
+                except Exception:
+                    logger.exception("whole-array path failed; falling back")
+                    value = None
+
+        if value is None:
+            value = self._exec_chunked(op, spec, resident)
+
+        self._admit(resident, out_store, value, target, budget)
+
+    def _whole_inputs(self, spec: BlockwiseSpec, resident) -> Optional[Dict[str, Any]]:
+        """Whole arrays for every input, from residency or storage."""
+        jax = _jax()
+        out = {}
+        for name, proxy in spec.reads_map.items():
+            arr = proxy.array
+            key = str(getattr(arr, "store", id(arr)))
+            if key in resident:
+                resident[key].touch()
+                out[name] = resident[key].value
+            elif isinstance(arr, VirtualFullArray):
+                out[name] = jax.numpy.full(arr.shape, arr.fill_value, dtype=arr.dtype)
+            elif isinstance(arr, VirtualEmptyArray):
+                out[name] = jax.numpy.zeros(arr.shape, dtype=arr.dtype)
+            elif isinstance(arr, VirtualInMemoryArray):
+                out[name] = self._device_put(jax.numpy.asarray(arr.array), arr.shape)
+            elif isinstance(arr, VirtualOffsetsArray):
+                return None  # block-id arrays have no whole-array meaning
+            elif isinstance(arr, ZarrV2Array):
+                data = arr[...] if arr.shape else arr[()]
+                if data.dtype.fields is not None:
+                    out[name] = {
+                        k: self._device_put(np.ascontiguousarray(data[k]), data.shape)
+                        for k in data.dtype.names
+                    }
+                else:
+                    out[name] = self._device_put(data, data.shape)
+            elif isinstance(arr, LazyZarrArray):
+                try:
+                    concrete = arr.open()
+                except FileNotFoundError:
+                    return None
+                data = concrete[...] if concrete.shape else concrete[()]
+                out[name] = self._device_put(data, data.shape)
+            else:
+                return None
+        return out
+
+    def _probe_one_to_one(self, spec: BlockwiseSpec, op) -> Optional[list[str]]:
+        """Check the block mapping is 1:1 (with broadcast-clamp) and return the
+        per-argument input names in order."""
+        mappable = op.pipeline.mappable
+        try:
+            keys = list(itertools.islice(iter(mappable), 0, 3))
+        except TypeError:
+            return None
+        if not keys:
+            return None
+        names: Optional[list[str]] = None
+        for out_key in keys:
+            try:
+                structure = spec.block_function(out_key)
+            except Exception:
+                return None
+            out_coords = out_key[1:]
+            cur = []
+            for entry in structure:
+                if not (isinstance(entry, tuple) and entry and isinstance(entry[0], str)):
+                    return None  # contraction/iterator: not 1:1
+                name, coords = entry[0], entry[1:]
+                proxy = spec.reads_map.get(name)
+                if proxy is None:
+                    return None
+                arr = proxy.array
+                nb = (
+                    tuple(
+                        len(c)
+                        for c in blockdims_from_blockshape(arr.shape, proxy.chunks)
+                    )
+                    if arr.shape
+                    else ()
+                )
+                # coords must equal out coords (rightmost-aligned) or clamp to
+                # 0 on broadcast dims
+                oc = out_coords[len(out_coords) - len(coords):]
+                for c, o, n in zip(coords, oc, nb):
+                    if c != o and not (c == 0 and n == 1):
+                        return None
+                cur.append(name)
+            if names is None:
+                names = cur
+            elif names != cur:
+                return None
+        return names
+
+    # ------------------------------------------------------------------
+
+    def _exec_chunked(self, op, spec: BlockwiseSpec, resident):
+        """Per-output-chunk execution with on-device slicing."""
+        jax = _jax()
+        target = spec.write.array
+        out_shape = tuple(target.shape)
+        chunkset = (
+            blockdims_from_blockshape(out_shape, spec.write.chunks)
+            if out_shape
+            else ()
+        )
+        nb = tuple(len(c) for c in chunkset)
+        needs_block_id = getattr(spec.function, "needs_block_id", False)
+
+        jitted = _JitCache(spec.function)
+
+        chunk_grid: Dict[tuple, Any] = {}
+        for out_key in op.pipeline.mappable:
+            out_coords = tuple(out_key[1:])
+            structure = spec.block_function(out_key)
+            args = [self._resolve(entry, spec, resident) for entry in structure]
+            if needs_block_id:
+                result = spec.function(*args, block_id=out_coords)
+            else:
+                result = jitted(*args)
+            chunk_grid[out_coords] = result
+
+        if not out_shape:
+            return chunk_grid[()]
+        return _assemble(chunk_grid, nb)
+
+    def _resolve(self, entry, spec: BlockwiseSpec, resident):
+        """Resolve a key structure to device chunks (sliced from residents)."""
+        from ...primitive.blockwise import PredArgs, PredKeys, _is_key
+
+        if isinstance(entry, PredKeys):
+            return PredArgs([self._resolve(e, spec, resident) for e in entry])
+        if isinstance(entry, (list, tuple)) and not _is_key(entry):
+            return [self._resolve(e, spec, resident) for e in entry]
+        if isinstance(entry, Iterator):
+            return (self._resolve(e, spec, resident) for e in entry)
+        name, coords = entry[0], tuple(entry[1:])
+        proxy = spec.reads_map[name]
+        arr = proxy.array
+        key = str(getattr(arr, "store", id(arr)))
+        if key in resident:
+            res = resident[key]
+            res.touch()
+            chunkset = (
+                blockdims_from_blockshape(arr.shape, proxy.chunks) if arr.shape else ()
+            )
+            sel = get_item(chunkset, coords) if arr.shape else ()
+            value = res.value
+            if isinstance(value, dict):
+                return {k: v[sel] for k, v in value.items()}
+            return value[sel]
+        # storage / virtual fallback (host read + device transfer)
+        from ...primitive.blockwise import get_chunk
+
+        opened = proxy.open()
+        chunkset = (
+            blockdims_from_blockshape(opened.shape, proxy.chunks)
+            if opened.shape
+            else ()
+        )
+        return get_chunk(opened, chunkset, coords)
+
+    # ------------------------------------------------------------------
+    # rechunk: resident alias / storage fallback
+    # ------------------------------------------------------------------
+
+    def _exec_rechunk(self, op, resident: Dict[str, _Resident], budget: int) -> None:
+        config = op.pipeline.config  # CubedCopySpec
+        src = config.read.array
+        dst = config.write.array
+        src_key = str(getattr(src, "store", id(src)))
+        dst_key = str(dst.store)
+
+        if src_key in resident:
+            # chunking is metadata; the resident value is the whole array
+            res = resident[src_key]
+            res.touch()
+            self._admit(resident, dst_key, res.value, dst, budget)
+            return
+
+        # source lives in storage: load whole if it fits, else host-side copy
+        try:
+            opened = src.open() if hasattr(src, "open") else src
+        except FileNotFoundError:
+            opened = None
+        if opened is not None and opened.nbytes < budget // 2:
+            data = opened[...] if opened.shape else opened[()]
+            if data.dtype.fields is not None:
+                value = {
+                    k: self._device_put(np.ascontiguousarray(data[k]), data.shape)
+                    for k in data.dtype.names
+                }
+            else:
+                value = self._device_put(data, data.shape)
+            self._admit(resident, dst_key, value, dst, budget)
+        else:
+            # bounded host-side copy (the spill path)
+            for m in op.pipeline.mappable:
+                op.pipeline.function(m, config=config)
+
+    # ------------------------------------------------------------------
+    # residency bookkeeping
+    # ------------------------------------------------------------------
+
+    def _admit(self, resident, store: str, value, target, budget: int) -> None:
+        nbytes = _value_nbytes(value)
+        self._evict(resident, budget - nbytes, exclude=store)
+        resident[store] = _Resident(value, nbytes, target)
+
+    def _evict(self, resident, budget: int, exclude: Optional[str] = None) -> None:
+        total = sum(r.nbytes for r in resident.values())
+        if total <= budget:
+            return
+        for store, res in sorted(resident.items(), key=lambda kv: kv[1].last_used):
+            if store == exclude:
+                continue
+            self._flush(res)
+            del resident[store]
+            total -= res.nbytes
+            if total <= budget:
+                return
+
+    def _flush(self, res: _Resident) -> None:
+        """Write a resident array to its Zarr target, chunk by chunk."""
+        target = res.target
+        if isinstance(target, LazyZarrArray):
+            concrete = target.create(mode="a")
+        elif isinstance(target, ZarrV2Array):
+            concrete = target
+        else:
+            return
+        value = res.value
+        shape = tuple(concrete.shape)
+        if not shape:
+            if isinstance(value, dict):
+                rec = np.empty((), dtype=concrete.dtype)
+                for k in concrete.dtype.names:
+                    rec[k] = np.asarray(value[k])
+                concrete[()] = rec
+            else:
+                concrete[()] = np.asarray(value)
+            return
+        chunkset = blockdims_from_blockshape(shape, concrete.chunks)
+        for idx in itertools.product(*(range(len(c)) for c in chunkset)):
+            sel = get_item(chunkset, idx)
+            if isinstance(value, dict):
+                fields = {k: np.asarray(v[sel]) for k, v in value.items()}
+                first = next(iter(fields.values()))
+                rec = np.empty(first.shape, dtype=concrete.dtype)
+                for k in concrete.dtype.names:
+                    rec[k] = fields[k]
+                concrete[sel] = rec
+            else:
+                concrete[sel] = np.asarray(value[sel])
+
+
+class _JitCache:
+    """jit a chunk kernel lazily, falling back to eager on trace failure."""
+
+    def __init__(self, function):
+        self.function = function
+        self._jitted = None
+        self._use_eager = False
+
+    def __call__(self, *args):
+        # iterators / nested lists can't be jitted as-is; run eagerly
+        if self._use_eager or any(
+            isinstance(a, Iterator) or isinstance(a, list) for a in args
+        ):
+            return self.function(*args)
+        jax = _jax()
+        if self._jitted is None:
+            self._jitted = jax.jit(self.function)
+        try:
+            return self._jitted(*args)
+        except Exception:
+            self._use_eager = True
+            return self.function(*args)
+
+
+def _assemble(chunk_grid: Dict[tuple, Any], nb: tuple[int, ...]):
+    """Assemble a grid of device chunks into one array by axis-wise concat."""
+    jax = _jax()
+    jnp = jax.numpy
+
+    def concat(vals, axis):
+        if isinstance(vals[0], dict):
+            return {k: concat([v[k] for v in vals], axis) for k in vals[0]}
+        if len(vals) == 1:
+            return vals[0]
+        return jnp.concatenate(vals, axis=axis)
+
+    def build(prefix: tuple, axis: int):
+        if axis == len(nb):
+            return chunk_grid[prefix]
+        vals = [build(prefix + (i,), axis + 1) for i in range(nb[axis])]
+        return concat(vals, axis)
+
+    return build((), 0)

@@ -1,0 +1,236 @@
+"""Threaded async executor: completion-ordered fan-out with retries,
+speculative straggler backups, and optional batched submission.
+
+Reference parity: cubed/runtime/executors/python_async.py and the generic
+async_map_unordered core (cubed/runtime/executors/asyncio.py:11-102),
+reimplemented on concurrent.futures without aiostream.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import time
+from typing import Callable, Dict, Iterable, Optional
+
+from ..backup import should_launch_backup
+from ..pipeline import visit_node_generations, visit_nodes
+from ..types import (
+    Callback,
+    DagExecutor,
+    OperationStartEvent,
+    callbacks_on,
+)
+from ..utils import batched, execute_with_stats, handle_callbacks
+
+logger = logging.getLogger(__name__)
+
+#: reference default: 2 retries = 3 attempts (cubed/runtime/executors/python_async.py:30)
+DEFAULT_RETRIES = 2
+
+
+def map_unordered(
+    executor: concurrent.futures.Executor,
+    function: Callable,
+    inputs: Iterable,
+    retries: int = DEFAULT_RETRIES,
+    use_backups: bool = False,
+    batch_size: Optional[int] = None,
+    callbacks=None,
+    array_name: Optional[str] = None,
+    **kwargs,
+) -> None:
+    """Run function over inputs, handling completion order, retries, backups."""
+    if batch_size is None:
+        _map_unordered_batch(
+            executor, function, list(inputs), retries, use_backups,
+            callbacks, array_name, **kwargs,
+        )
+    else:
+        for batch in batched(inputs, batch_size):
+            _map_unordered_batch(
+                executor, function, batch, retries, use_backups,
+                callbacks, array_name, **kwargs,
+            )
+
+
+def _map_unordered_batch(
+    executor,
+    function,
+    inputs: list,
+    retries: int,
+    use_backups: bool,
+    callbacks,
+    array_name,
+    **kwargs,
+) -> None:
+    attempts: Dict[int, int] = {i: 0 for i in range(len(inputs))}
+    start_times: Dict[object, float] = {}
+    end_times: Dict[object, float] = {}
+    create_times: Dict[int, float] = {}
+    # future -> (input index, is_backup)
+    pending: Dict[concurrent.futures.Future, tuple[int, bool]] = {}
+    backups: Dict[int, list[concurrent.futures.Future]] = {}
+    done_inputs: set[int] = set()
+
+    def submit(i: int, is_backup: bool = False):
+        create_times.setdefault(i, time.time())
+        fut = executor.submit(execute_with_stats, function, inputs[i], **kwargs)
+        start_times[fut] = time.time()
+        pending[fut] = (i, is_backup)
+        if is_backup:
+            backups.setdefault(i, []).append(fut)
+        return fut
+
+    for i in range(len(inputs)):
+        submit(i)
+
+    while pending:
+        done, _ = concurrent.futures.wait(
+            list(pending), timeout=2, return_when=concurrent.futures.FIRST_COMPLETED
+        )
+        now = time.time()
+        for fut in done:
+            i, is_backup = pending.pop(fut)
+            end_times[fut] = now
+            if i in done_inputs:
+                continue  # a twin already won
+            try:
+                _, stats = fut.result()
+            except Exception:
+                attempts[i] += 1
+                # suppress if a backup twin is still running
+                twins = [f for f in pending if pending[f][0] == i]
+                if twins:
+                    continue
+                if attempts[i] > retries:
+                    # cancel all remaining work and re-raise
+                    for f in pending:
+                        f.cancel()
+                    raise
+                logger.info("retrying input %s (attempt %d)", i, attempts[i] + 1)
+                submit(i)
+                continue
+            done_inputs.add(i)
+            # cancel the losing twin(s)
+            for f in list(pending):
+                if pending[f][0] == i:
+                    f.cancel()
+                    del pending[f]
+            handle_callbacks(
+                callbacks,
+                dict(stats, array_name=array_name, task_create_tstamp=create_times[i]),
+            )
+        if use_backups:
+            for fut, (i, is_backup) in list(pending.items()):
+                if is_backup or i in done_inputs or i in backups:
+                    continue
+                if should_launch_backup(fut, now, start_times, end_times):
+                    logger.info("launching backup for input %s", i)
+                    submit(i, is_backup=True)
+
+
+class AsyncPythonDagExecutor(DagExecutor):
+    """ThreadPool executor with retries, backups and generation parallelism."""
+
+    def __init__(
+        self,
+        max_workers: Optional[int] = None,
+        retries: int = DEFAULT_RETRIES,
+        use_backups: bool = False,
+        batch_size: Optional[int] = None,
+        compute_arrays_in_parallel: bool = False,
+        **kwargs,
+    ):
+        self.max_workers = max_workers
+        self.retries = retries
+        self.use_backups = use_backups
+        self.batch_size = batch_size
+        self.compute_arrays_in_parallel = compute_arrays_in_parallel
+        self.kwargs = kwargs
+
+    @property
+    def name(self) -> str:
+        return "threads"
+
+    def execute_dag(
+        self,
+        dag,
+        callbacks=None,
+        array_names=None,
+        resume=None,
+        spec=None,
+        retries: Optional[int] = None,
+        use_backups: Optional[bool] = None,
+        batch_size: Optional[int] = None,
+        compute_arrays_in_parallel: Optional[bool] = None,
+        **kwargs,
+    ) -> None:
+        retries = self.retries if retries is None else retries
+        use_backups = self.use_backups if use_backups is None else use_backups
+        batch_size = self.batch_size if batch_size is None else batch_size
+        if compute_arrays_in_parallel is None:
+            compute_arrays_in_parallel = self.compute_arrays_in_parallel
+
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.max_workers
+        ) as pool:
+            if compute_arrays_in_parallel:
+                # ops in the same topological generation interleave their tasks
+                for generation in visit_node_generations(dag, resume=resume):
+                    merged = []
+                    for name, node in generation:
+                        primitive_op = node["primitive_op"]
+                        callbacks_on(
+                            callbacks, "on_operation_start",
+                            OperationStartEvent(name, primitive_op.num_tasks),
+                        )
+                        pipeline = primitive_op.pipeline
+                        for m in pipeline.mappable:
+                            merged.append((name, pipeline, m))
+                    # run the merged generation
+                    self._run_tasks(pool, merged, retries, use_backups, batch_size, callbacks)
+            else:
+                for name, node in visit_nodes(dag, resume=resume):
+                    primitive_op = node["primitive_op"]
+                    pipeline = primitive_op.pipeline
+                    callbacks_on(
+                        callbacks, "on_operation_start",
+                        OperationStartEvent(name, primitive_op.num_tasks),
+                    )
+                    map_unordered(
+                        pool,
+                        pipeline.function,
+                        pipeline.mappable,
+                        retries=retries,
+                        use_backups=use_backups,
+                        batch_size=batch_size,
+                        callbacks=callbacks,
+                        array_name=name,
+                        config=pipeline.config,
+                    )
+
+    def _run_tasks(self, pool, merged, retries, use_backups, batch_size, callbacks):
+        def run_one(item):
+            name, pipeline, m = item
+            return pipeline.function(m, config=pipeline.config)
+
+        # reuse map_unordered by currying per-item functions
+        inputs = list(range(len(merged)))
+
+        def fn(i):
+            name, pipeline, m = merged[i]
+            return pipeline.function(m, config=pipeline.config)
+
+        names = [m[0] for m in merged]
+
+        map_unordered(
+            pool,
+            fn,
+            inputs,
+            retries=retries,
+            use_backups=use_backups,
+            batch_size=batch_size,
+            callbacks=callbacks,
+            array_name=names[0] if names else None,
+        )
