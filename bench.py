@@ -1,0 +1,118 @@
+"""Benchmark: BASELINE.json config 1 shape — ``(a + b).sum()`` on 5000x5000
+float64 with (1000,1000) chunks, arrays produced by the distributed RNG (the
+reference's canonical lithops-add-random workload: data is generated inside
+tasks, not transferred from the client).
+
+Compares the JaxExecutor on the real TPU chip against the single-process
+numpy-backend PythonDagExecutor (the reference's baseline executor semantics)
+running the identical plan in a subprocess.
+
+Prints ONE JSON line: {"metric", "value" (GB/s/chip of array data processed on
+the TPU path), "unit", "vs_baseline" (speedup over the numpy executor)}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+N = 5000
+CHUNK = 1000
+#: array bytes flowing through the fused kernel: generate a + generate b +
+#: add (2 reads + 1 materialized sum input)
+WORK_BYTES = 3 * N * N * 8
+
+WORKLOAD = r"""
+import json, sys, tempfile, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+import cubed_tpu.random
+
+spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="4GB")
+
+def build():
+    a = cubed_tpu.random.random(({n}, {n}), chunks=({c}, {c}), spec=spec)
+    b = cubed_tpu.random.random(({n}, {n}), chunks=({c}, {c}), spec=spec)
+    return xp.sum(xp.add(a, b))
+
+# warmup (plan construction + any compilation)
+build().compute()
+s = build()
+t0 = time.perf_counter()
+val = s.compute()
+t1 = time.perf_counter()
+print(json.dumps({{"elapsed": t1 - t0, "value": float(val)}}))
+"""
+
+
+def run_baseline() -> dict:
+    env = dict(os.environ, CUBED_TPU_BACKEND="numpy")
+    script = WORKLOAD.format(
+        repo=os.path.dirname(os.path.abspath(__file__)), n=N, c=CHUNK
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True, text=True,
+        timeout=1800,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"baseline failed: {out.stderr[-2000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_tpu() -> dict:
+    import cubed_tpu as ct
+    import cubed_tpu.array_api as xp
+    import cubed_tpu.random
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="4GB")
+    executor = JaxExecutor()
+
+    def build():
+        a = cubed_tpu.random.random((N, N), chunks=(CHUNK, CHUNK), spec=spec)
+        b = cubed_tpu.random.random((N, N), chunks=(CHUNK, CHUNK), spec=spec)
+        return xp.sum(xp.add(a, b))
+
+    # warmup: same structure, compiles the kernels
+    build().compute(executor=executor)
+
+    s = build()
+    t0 = time.perf_counter()
+    val = s.compute(executor=executor)
+    t1 = time.perf_counter()
+    # sanity: mean of uniform+uniform is ~1.0
+    mean = float(val) / (N * N)
+    assert 0.95 < mean < 1.05, mean
+    return {"elapsed": t1 - t0, "value": float(val)}
+
+
+def main() -> None:
+    tpu = run_tpu()
+    try:
+        baseline = run_baseline()
+        vs_baseline = baseline["elapsed"] / tpu["elapsed"]
+    except Exception as e:
+        print(f"baseline run failed: {e}", file=sys.stderr)
+        vs_baseline = None
+
+    gbps = WORK_BYTES / tpu["elapsed"] / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": "add_random_sum_5000x5000_f64_throughput",
+                "value": round(gbps, 3),
+                "unit": "GB/s/chip",
+                "vs_baseline": round(vs_baseline, 3) if vs_baseline is not None else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,21 @@ if BACKEND == "jax":
     if os.environ.get("CUBED_TPU_ENABLE_X64", "1") == "1":
         jax.config.update("jax_enable_x64", True)
 
+    # Every plan builds fresh kernel closures, which defeats jax's in-process
+    # jit cache; the persistent (HLO-keyed) compilation cache makes repeat
+    # compiles of structurally identical kernels ~100x cheaper.
+    if os.environ.get("CUBED_TPU_COMPILATION_CACHE", "1") == "1":
+        cache_dir = os.environ.get(
+            "CUBED_TPU_COMPILATION_CACHE_DIR",
+            os.path.expanduser("~/.cache/cubed_tpu_xla"),
+        )
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        except Exception:
+            pass
+
     import jax.numpy as namespace  # noqa: F401
 
     def backend_array_to_numpy_array(arr) -> np.ndarray:

@@ -389,8 +389,15 @@ def map_blocks(
         offsets = _offsets_array_for(x)
         numblocks = x.numblocks
 
+        supports_offset = getattr(func, "supports_offset", False)
+
         def func_with_block_id(*chunk_args, **kw):
             *real, offset = chunk_args
+            if supports_offset:
+                # trace-friendly: hand the (possibly traced) scalar offset to
+                # the kernel; it unravels on device — the op stays jittable
+                # and vmappable (no host sync per task)
+                return func(*real, offset=offset, numblocks=numblocks, **kw)
             block_id = offset_to_block_id(int(np.asarray(offset).ravel()[0]), numblocks)
             return func(*real, block_id=block_id, **kw)
 
@@ -787,7 +794,7 @@ def reduction(
     while any(result.numblocks[ax] > 1 for ax in axis):
         result = partial_reduce(
             result,
-            partial(_combine_reduce, combine_func=combine_func, axis=axis, kw=kw),
+            _StreamingCombine(combine_func, axis, kw),
             split_every={ax: split for ax in axis},
             dtype=intermediate_dtype,
         )
@@ -815,16 +822,38 @@ def _initial_reduce(chunk, *, func, axis, kw):
     return func(chunk, axis=axis, keepdims=True, **kw)
 
 
-def _combine_reduce(chunks_iter, *, combine_func, axis, kw):
-    """Accumulate streamed chunks pairwise: concat along axes then combine."""
-    acc = None
-    for chunk in chunks_iter:
-        if acc is None:
-            acc = chunk
-        else:
-            merged = _concat_pytree(acc, chunk, axis[0] if len(axis) == 1 else axis)
-            acc = combine_func(merged, axis=axis, keepdims=True, **kw)
-    return acc
+class _StreamingCombine:
+    """Combine a group of blocks along reduced axes.
+
+    Called with an *iterator* of chunks it accumulates pairwise (bounded
+    memory: one concat buffer regardless of group size — the oracle executors'
+    path). ``combine_region`` combines a single merged contiguous region in
+    one shot — the TPU executor uses it to turn a whole group into one jitted
+    reduction with no streaming dispatches. Both paths require the combine to
+    be associative+commutative over the reduced axes, which reduction
+    combiners are by contract.
+    """
+
+    __name__ = "partial_reduce"
+
+    def __init__(self, combine_func, axis: tuple, kw: dict):
+        self.combine_func = combine_func
+        self.axis = axis
+        self.kw = kw
+
+    def __call__(self, chunks_iter):
+        acc = None
+        axis = self.axis
+        for chunk in chunks_iter:
+            if acc is None:
+                acc = chunk
+            else:
+                merged = _concat_pytree(acc, chunk, axis[0] if len(axis) == 1 else axis)
+                acc = self.combine_func(merged, axis=axis, keepdims=True, **self.kw)
+        return acc
+
+    def combine_region(self, region):
+        return self.combine_func(region, axis=self.axis, keepdims=True, **self.kw)
 
 
 def _concat_pytree(a, b, axis):
@@ -914,21 +943,33 @@ def arg_reduction(
         abs_i = i + int(starts[block_id[axis]])
         return {"i": nxp.asarray(abs_i, dtype=np.int64), "v": v}
 
-    def combine(chunks_iter, axis=None, keepdims=True, **kw):
-        acc = None
-        ax = axis[0] if isinstance(axis, tuple) else axis
-        for chunk in chunks_iter:
-            if acc is None:
-                acc = chunk
-            else:
-                iv = nxp.concatenate([acc["i"], chunk["i"]], axis=ax)
-                vv = nxp.concatenate([acc["v"], chunk["v"]], axis=ax)
-                local = func(vv, axis=ax, keepdims=True)
-                acc = {
-                    "i": nxp.take_along_axis(iv, local, axis=ax),
-                    "v": cmp_func(vv, axis=ax, keepdims=True),
-                }
-        return acc
+    class _ArgCombine:
+        __name__ = "arg_combine"
+
+        def __init__(self, ax):
+            self.ax = ax
+
+        def combine_region(self, region):
+            ax = self.ax
+            local = func(region["v"], axis=ax, keepdims=True)
+            return {
+                "i": nxp.take_along_axis(region["i"], local, axis=ax),
+                "v": cmp_func(region["v"], axis=ax, keepdims=True),
+            }
+
+        def __call__(self, chunks_iter):
+            acc = None
+            ax = self.ax
+            for chunk in chunks_iter:
+                if acc is None:
+                    acc = chunk
+                else:
+                    merged = {
+                        "i": nxp.concatenate([acc["i"], chunk["i"]], axis=ax),
+                        "v": nxp.concatenate([acc["v"], chunk["v"]], axis=ax),
+                    }
+                    acc = self.combine_region(merged)
+            return acc
 
     intermediate_dtype = np.dtype([("i", np.int64), ("v", x.dtype)])
 
@@ -944,7 +985,7 @@ def arg_reduction(
     while result.numblocks[axis] > 1:
         result = partial_reduce(
             result,
-            partial(combine, axis=(axis,)),
+            _ArgCombine(axis),
             split_every={axis: split},
             dtype=intermediate_dtype,
         )

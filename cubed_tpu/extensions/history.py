@@ -1,0 +1,93 @@
+"""HistoryCallback: record plan-time projections and per-task measurements,
+write CSVs, and compute projected-memory utilization.
+
+Reference parity: cubed/extensions/history.py:11-103.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+from ..runtime.types import Callback, TaskEndEvent
+
+
+@dataclass
+class PlanRow:
+    array_name: str
+    op_name: str
+    projected_mem: int
+    reserved_mem: int
+    num_tasks: int
+
+
+class HistoryCallback(Callback):
+    def __init__(self, history_dir: str = "history"):
+        self.history_dir = history_dir
+        self.plan: list[PlanRow] = []
+        self.events: list[TaskEndEvent] = []
+
+    def on_compute_start(self, event) -> None:
+        self.plan = []
+        self.events = []
+        for name, d in event.dag.nodes(data=True):
+            if d.get("type") == "op" and d.get("primitive_op") is not None:
+                op = d["primitive_op"]
+                self.plan.append(
+                    PlanRow(
+                        array_name=name,
+                        op_name=d.get("op_name", ""),
+                        projected_mem=op.projected_mem,
+                        reserved_mem=op.reserved_mem,
+                        num_tasks=op.num_tasks,
+                    )
+                )
+
+    def on_task_end(self, event: TaskEndEvent) -> None:
+        self.events.append(event)
+
+    def on_compute_end(self, event) -> None:
+        ts = int(time.time())
+        os.makedirs(self.history_dir, exist_ok=True)
+        self._write_csv(
+            os.path.join(self.history_dir, f"plan-{ts}.csv"),
+            [asdict(r) for r in self.plan],
+        )
+        self._write_csv(
+            os.path.join(self.history_dir, f"events-{ts}.csv"),
+            [asdict(e) for e in self.events],
+        )
+        stats = self.stats()
+        if stats:
+            self._write_csv(os.path.join(self.history_dir, f"stats-{ts}.csv"), stats)
+
+    def stats(self) -> list[dict]:
+        """Join plan projections against measured peaks per op."""
+        peak_by_array: dict[str, int] = {}
+        for e in self.events:
+            if e.peak_measured_mem_end is not None:
+                peak_by_array[e.array_name] = max(
+                    peak_by_array.get(e.array_name, 0), e.peak_measured_mem_end
+                )
+        rows = []
+        for r in self.plan:
+            peak = peak_by_array.get(r.array_name)
+            row = asdict(r)
+            row["peak_measured_mem"] = peak
+            row["projected_mem_utilization"] = (
+                peak / r.projected_mem if peak and r.projected_mem else None
+            )
+            rows.append(row)
+        return rows
+
+    @staticmethod
+    def _write_csv(path: str, rows: list[dict]) -> None:
+        if not rows:
+            return
+        with open(path, "w", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+            writer.writeheader()
+            writer.writerows(rows)

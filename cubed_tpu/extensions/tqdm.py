@@ -1,0 +1,65 @@
+"""TqdmProgressBar: one progress bar per op, updated on task end.
+
+Reference parity: cubed/extensions/tqdm.py:10-55. Falls back to a plain
+line-printing bar when tqdm is unavailable.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict
+
+from ..runtime.types import Callback, TaskEndEvent
+
+
+class _PlainBar:
+    def __init__(self, desc: str, total: int):
+        self.desc = desc
+        self.total = total
+        self.n = 0
+
+    def update(self, n: int = 1):
+        self.n += n
+        pct = 100.0 * self.n / self.total if self.total else 100.0
+        sys.stderr.write(f"\r{self.desc}: {self.n}/{self.total} ({pct:.0f}%)")
+        if self.n >= self.total:
+            sys.stderr.write("\n")
+        sys.stderr.flush()
+
+    def close(self):
+        pass
+
+
+class TqdmProgressBar(Callback):
+    def __init__(self, **tqdm_kwargs):
+        self.tqdm_kwargs = tqdm_kwargs
+        self.bars: Dict[str, object] = {}
+
+    def on_compute_start(self, event) -> None:
+        self.bars = {}
+        try:
+            from tqdm.auto import tqdm  # noqa: F401
+
+            self._tqdm = tqdm
+        except ImportError:
+            self._tqdm = None
+        i = 0
+        for name, d in event.dag.nodes(data=True):
+            if d.get("type") == "op" and d.get("primitive_op") is not None:
+                total = d["primitive_op"].num_tasks
+                if self._tqdm is not None:
+                    self.bars[name] = self._tqdm(
+                        desc=name, total=total, position=i, **self.tqdm_kwargs
+                    )
+                else:
+                    self.bars[name] = _PlainBar(name, total)
+                i += 1
+
+    def on_task_end(self, event: TaskEndEvent) -> None:
+        bar = self.bars.get(event.array_name)
+        if bar is not None:
+            bar.update(event.num_tasks)
+
+    def on_compute_end(self, event) -> None:
+        for bar in self.bars.values():
+            bar.close()

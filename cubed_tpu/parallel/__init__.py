@@ -1,0 +1,1 @@
+from .mesh import make_mesh, sharding_for_chunks  # noqa: F401

@@ -1,0 +1,85 @@
+"""Device-mesh utilities: the substrate that replaces the reference's
+serverless worker pools (cubed/runtime/executors/*) with TPU chips.
+
+The chunk grid of each whole-array op is the unit of parallelism in the
+reference (one task per output chunk, communicating through object storage).
+Here the same grid is laid over a ``jax.sharding.Mesh``: each chip owns a tile
+of the grid resident in HBM, XLA inserts the collectives (reduction trees over
+ICI, all-to-all for resharding) that the reference realizes as storage
+round-trips. Multi-host meshes extend the same mapping over DCN.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Optional[Sequence[str]] = None,
+    devices=None,
+):
+    """Create a Mesh over the available devices.
+
+    Default: a 1-d ``("data",)`` mesh over all devices — chunk-grid
+    parallelism is data parallelism over the grid. Pass an n-d shape (e.g.
+    ``(4, 2)`` with ``("data", "model")``) for hybrid layouts.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = (n,)
+    if axis_names is None:
+        axis_names = ("data", "model", "seq", "expert")[: len(shape)]
+    if math.prod(shape) != n:
+        raise ValueError(f"mesh shape {shape} does not match {n} devices")
+    dev_array = np.asarray(devices).reshape(tuple(shape))
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def sharding_for_chunks(
+    mesh,
+    chunkset: Sequence[Sequence[int]],
+    shape: Sequence[int],
+):
+    """A NamedSharding laying the chunk grid over the mesh.
+
+    Mesh axes are assigned greedily to the array dims with the most blocks, so
+    the per-chip tile boundary coincides with chunk boundaries where possible
+    (tasks never straddle chips).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if not shape:
+        return NamedSharding(mesh, PartitionSpec())
+    nb = [len(c) for c in chunkset]
+    spec: list = [None] * len(shape)
+    axes = list(zip(mesh.axis_names, mesh.devices.shape))
+    # dims by descending block count
+    for dim in sorted(range(len(shape)), key=lambda d: -nb[d]):
+        if not axes:
+            break
+        name, size = axes[0]
+        if shape[dim] % size == 0 and nb[dim] >= size:
+            spec[dim] = name
+            axes.pop(0)
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def reshard(x, mesh, chunkset, shape):
+    """Move an array to the sharding implied by a (new) chunk grid.
+
+    Under jit this is the in-HBM rechunk: XLA lowers the layout change to
+    collective permutes / all-to-all over ICI instead of the reference's
+    storage round-trip (SURVEY.md section 3.3).
+    """
+    import jax
+
+    return jax.device_put(x, sharding_for_chunks(mesh, chunkset, shape))

@@ -2,9 +2,13 @@
 
 The reference keys a Philox generator by ``root_seed + linear block offset``
 (cubed/random.py:13-36); the TPU-native equivalent is the jax threefry PRNG
-with ``jax.random.fold_in(key, block_offset)`` — the same per-block
-determinism contract (reproducible regardless of which worker/chip computes
-which block), expressed with the native counter-based PRNG.
+with ``jax.random.fold_in(key, root_seed + block_offset)`` — the same
+per-block determinism contract (reproducible regardless of which worker/chip
+computes which block), expressed with the native counter-based PRNG.
+
+The seed rides the offsets *data* (VirtualOffsetsArray base) so the kernel's
+HLO is identical for every plan — one persistent-cache compile serves all
+random arrays of a given chunk shape.
 """
 
 from __future__ import annotations
@@ -15,39 +19,61 @@ import numpy as np
 
 from .backend_array_api import BACKEND, nxp
 from .chunks import normalize_chunks
-from .core.ops import map_blocks
-from .array_api.creation_functions import empty
-from .utils import block_id_to_offset
+from .core.ops import general_blockwise, new_array
+from .core.plan import Plan, gensym
+from .spec import spec_from_config
+from .storage.virtual import virtual_empty, VirtualOffsetsArray
+from .utils import to_chunksize
 
 
 def random(size, *, diagnostics=None, chunks=None, spec=None):
     """Uniform [0, 1) float64 array with per-block reproducible randomness."""
     shape = (size,) if isinstance(size, int) else tuple(size)
-    dtype = np.float64
+    dtype = np.dtype(np.float64)
+    spec = spec_from_config(spec)
     chunks = normalize_chunks(chunks, shape, dtype=dtype)
     numblocks = tuple(len(c) for c in chunks)
-    root_seed = pyrandom.getrandbits(32)
+    root_seed = pyrandom.getrandbits(30)
 
-    return map_blocks(
-        _RandomBlock(root_seed, numblocks),
-        empty(shape, dtype=dtype, chunks=chunks, spec=spec),
+    # hidden inputs: a shape template (virtual, zero-cost) and the seeded
+    # offsets array feeding per-block keys
+    template_t = virtual_empty(shape, dtype=dtype, chunks=to_chunksize(chunks) if shape else ())
+    t_name = gensym("template")
+    t_plan = Plan._new(t_name, "template", template_t, None, True)
+    template = new_array(t_name, template_t, spec, t_plan)
+
+    offsets_t = VirtualOffsetsArray(numblocks, base=root_seed)
+    o_name = gensym("seeds")
+    o_plan = Plan._new(o_name, "seeds", offsets_t, None, True)
+    offsets = new_array(o_name, offsets_t, spec, o_plan)
+
+    ndim = len(shape)
+
+    def block_function(out_key):
+        coords = out_key[1:]
+        return ((t_name, *coords), (o_name, *coords))
+
+    return general_blockwise(
+        _random_block,
+        block_function,
+        template,
+        offsets,
+        shape=shape,
         dtype=dtype,
+        chunks=chunks,
+        op_name="random",
     )
 
 
-class _RandomBlock:
-    __name__ = "random_block"
+def _random_block(chunk, seeded_offset):
+    """One random block; ``seeded_offset`` is data, so the HLO has no
+    per-plan constants."""
+    if BACKEND == "jax":
+        import jax
 
-    def __init__(self, root_seed: int, numblocks):
-        self.root_seed = root_seed
-        self.numblocks = numblocks
-
-    def __call__(self, chunk, block_id=None):
-        offset = block_id_to_offset(block_id, self.numblocks) if block_id else 0
-        if BACKEND == "jax":
-            import jax
-
-            key = jax.random.fold_in(jax.random.key(self.root_seed), offset)
-            return jax.random.uniform(key, chunk.shape, dtype=np.float64)
-        rng = np.random.Generator(np.random.Philox(seed=self.root_seed + offset))
-        return rng.random(chunk.shape, dtype=np.float64)
+        off = seeded_offset.ravel()[0]
+        key = jax.random.fold_in(jax.random.key(0), off)
+        return jax.random.uniform(key, chunk.shape, dtype=np.float64)
+    off = int(np.asarray(seeded_offset).ravel()[0])
+    rng = np.random.Generator(np.random.Philox(seed=off))
+    return rng.random(chunk.shape, dtype=np.float64)

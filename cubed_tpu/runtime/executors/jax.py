@@ -145,6 +145,18 @@ class JaxExecutor(DagExecutor):
                 return NamedSharding(self.mesh, PartitionSpec(*spec))
         return NamedSharding(self.mesh, PartitionSpec())
 
+    def _full(self, shape, fill_value, dtype):
+        """Materialize a constant array, sharded over the mesh if present."""
+        jax = _jax()
+        sharding = self._sharding_for(tuple(shape))
+        if sharding is not None:
+            fn = jax.jit(
+                lambda: jax.numpy.full(shape, fill_value, dtype=dtype),
+                out_shardings=sharding,
+            )
+            return fn()
+        return jax.numpy.full(shape, fill_value, dtype=dtype)
+
     def _device_put(self, value, shape):
         jax = _jax()
         sharding = self._sharding_for(shape)
@@ -248,6 +260,13 @@ class JaxExecutor(DagExecutor):
                     logger.exception("whole-array path failed; falling back")
                     value = None
 
+        if value is None and not getattr(spec.function, "needs_block_id", False):
+            try:
+                value = self._exec_batched(op, spec, resident)
+            except Exception:
+                logger.exception("batched path failed; falling back")
+                value = None
+
         if value is None:
             value = self._exec_chunked(op, spec, resident)
 
@@ -264,11 +283,11 @@ class JaxExecutor(DagExecutor):
                 resident[key].touch()
                 out[name] = resident[key].value
             elif isinstance(arr, VirtualFullArray):
-                out[name] = jax.numpy.full(arr.shape, arr.fill_value, dtype=arr.dtype)
+                out[name] = self._full(arr.shape, arr.fill_value, arr.dtype)
             elif isinstance(arr, VirtualEmptyArray):
-                out[name] = jax.numpy.zeros(arr.shape, dtype=arr.dtype)
+                out[name] = self._full(arr.shape, 0, arr.dtype)
             elif isinstance(arr, VirtualInMemoryArray):
-                out[name] = self._device_put(jax.numpy.asarray(arr.array), arr.shape)
+                out[name] = self._device_put(np.asarray(arr.array), arr.shape)
             elif isinstance(arr, VirtualOffsetsArray):
                 return None  # block-id arrays have no whole-array meaning
             elif isinstance(arr, ZarrV2Array):
@@ -339,6 +358,154 @@ class JaxExecutor(DagExecutor):
         return names
 
     # ------------------------------------------------------------------
+    # batched: ALL tasks of a uniform-grid op in ONE vmapped XLA dispatch
+    # ------------------------------------------------------------------
+
+    def _exec_batched(self, op, spec: BlockwiseSpec, resident):
+        """Stack every task's input chunks on device and run vmap(kernel) once.
+
+        Collapses the reference's task fan-out (one dispatch per chunk through
+        storage) into a single XLA program: per-task host overhead and tunnel
+        round-trips vanish, and XLA tiles the batched kernel onto the MXU/VPU.
+        Returns None when the op isn't batchable (ragged grid, streamed reads,
+        non-uniform structure)."""
+        jax = _jax()
+        jnp = jax.numpy
+        _register_pred_pytrees()
+
+        target = spec.write.array
+        out_shape = tuple(target.shape)
+        if not out_shape:
+            return None
+        out_chunkset = blockdims_from_blockshape(out_shape, spec.write.chunks)
+        if any(len(set(c)) != 1 for c in out_chunkset):
+            return None  # ragged output grid
+        out_nb = tuple(len(c) for c in out_chunkset)
+        out_chunk = tuple(c[0] for c in out_chunkset)
+
+        keys = list(op.pipeline.mappable)
+        if len(keys) <= 1:
+            return None
+        # mappable is the C-order product over the out grid by construction
+        structures = [spec.block_function(k) for k in keys]
+
+        # flatten each task's key structure to leaves; all tasks must agree
+        treedef0, leaves0 = _flatten_keys(structures[0])
+        if treedef0 is None:
+            return None
+        per_leaf_keys = [[k] for k in leaves0]
+        for s in structures[1:]:
+            td, leaves = _flatten_keys(s)
+            if td != treedef0 or len(leaves) != len(leaves0):
+                return None
+            for i, k in enumerate(leaves):
+                per_leaf_keys[i].append(k)
+
+        T = len(keys)
+        stacked_leaves = []
+        in_axes_leaves = []
+        for leaf_keys in per_leaf_keys:
+            names = {k[0] for k in leaf_keys}
+            if len(names) != 1:
+                return None
+            name = leaf_keys[0][0]
+            proxy = spec.reads_map.get(name)
+            if proxy is None:
+                return None
+            arr = proxy.array
+            if arr.shape:
+                chunkset = blockdims_from_blockshape(arr.shape, proxy.chunks)
+                if any(len(set(c)) != 1 for c in chunkset):
+                    return None  # ragged input grid
+                chunk_shape = tuple(c[0] for c in chunkset)
+                nb = tuple(len(c) for c in chunkset)
+            else:
+                chunk_shape, nb = (), ()
+
+            coords = [tuple(k[1:]) for k in leaf_keys]
+            if all(c == coords[0] for c in coords):
+                # same chunk for every task: broadcast (no stacking)
+                stacked_leaves.append(self._resolve(leaf_keys[0], spec, resident))
+                in_axes_leaves.append(None)
+                continue
+
+            if isinstance(arr, VirtualOffsetsArray):
+                base = getattr(arr, "base", 0)
+                offs = np.asarray(
+                    [base + np.ravel_multi_index(c, arr.shape) for c in coords],
+                    dtype=arr.dtype,
+                ).reshape((T,) + (1,) * len(arr.shape))
+                stacked_leaves.append(self._device_put(offs, None))
+                in_axes_leaves.append(0)
+                continue
+            if isinstance(arr, (VirtualEmptyArray, VirtualFullArray)):
+                fill = getattr(arr, "fill_value", 0)
+                stacked_leaves.append(jnp.full(chunk_shape, fill, dtype=arr.dtype))
+                in_axes_leaves.append(None)  # constant: broadcast
+                continue
+
+            store_key = str(getattr(arr, "store", id(arr)))
+            if store_key in resident:
+                res = resident[store_key]
+                res.touch()
+                value = res.value
+                idx = np.asarray(
+                    [np.ravel_multi_index(c, nb) for c in coords], dtype=np.int32
+                )
+                stacked = _gather_blocks(value, nb, chunk_shape, idx)
+                stacked_leaves.append(stacked)
+                in_axes_leaves.append(0)
+                continue
+
+            # host source (in-memory / zarr): stack once, transfer once
+            opened = proxy.open()
+            host = np.stack(
+                [np.asarray(opened[get_item(chunkset, c)]) for c in coords]
+            )
+            if host.dtype.fields is not None:
+                stacked_leaves.append(
+                    {
+                        k: self._device_put(np.ascontiguousarray(host[k]), None)
+                        for k in host.dtype.names
+                    }
+                )
+            else:
+                stacked_leaves.append(self._device_put(host, None))
+            in_axes_leaves.append(0)
+
+        if all(ax is None for ax in in_axes_leaves):
+            return None
+
+        fn = spec.function
+        td = treedef0
+
+        def task_fn(*flat):
+            args = _unflatten_keys(td, list(flat))
+            return fn(*args)
+
+        batched = jax.jit(jax.vmap(task_fn, in_axes=tuple(in_axes_leaves)))
+        out_stacked = batched(*stacked_leaves)
+
+        def unstack(o):
+            # (T, *chunk) -> (*grid, *chunk) -> interleave -> full array
+            oc = tuple(o.shape[1:])
+            grid_full = tuple(n * c for n, c in zip(out_nb, oc))
+            r = o.reshape(out_nb + oc)
+            perm = []
+            for d in range(len(out_nb)):
+                perm.extend([d, d + len(out_nb)])
+            return r.transpose(perm).reshape(grid_full)
+
+        if isinstance(out_stacked, dict):
+            return {k: unstack(v) for k, v in out_stacked.items()}
+        if tuple(out_stacked.shape) != (T, *out_chunk):
+            return None
+        value = unstack(out_stacked)
+        if tuple(value.shape) != out_shape:
+            return None
+        return value
+
+    # ------------------------------------------------------------------
 
     def _exec_chunked(self, op, spec: BlockwiseSpec, resident):
         """Per-output-chunk execution with on-device slicing."""
@@ -354,21 +521,74 @@ class JaxExecutor(DagExecutor):
         needs_block_id = getattr(spec.function, "needs_block_id", False)
 
         jitted = _JitCache(spec.function)
+        region_fn = getattr(spec.function, "combine_region", None)
+        jitted_region = _JitCache(region_fn) if region_fn is not None else None
 
         chunk_grid: Dict[tuple, Any] = {}
         for out_key in op.pipeline.mappable:
             out_coords = tuple(out_key[1:])
             structure = spec.block_function(out_key)
-            args = [self._resolve(entry, spec, resident) for entry in structure]
-            if needs_block_id:
-                result = spec.function(*args, block_id=out_coords)
-            else:
-                result = jitted(*args)
+            result = None
+            if (
+                jitted_region is not None
+                and len(structure) == 1
+                and isinstance(structure[0], Iterator)
+            ):
+                keys = list(structure[0])
+                region = self._resolve_region(keys, spec, resident)
+                if region is not None:
+                    result = jitted_region(region)
+                else:
+                    structure = (iter(keys),)
+            if result is None:
+                args = [self._resolve(entry, spec, resident) for entry in structure]
+                if needs_block_id:
+                    result = spec.function(*args, block_id=out_coords)
+                else:
+                    result = jitted(*args)
             chunk_grid[out_coords] = result
 
         if not out_shape:
             return chunk_grid[()]
         return _assemble(chunk_grid, nb)
+
+    def _resolve_region(self, keys, spec: BlockwiseSpec, resident):
+        """Slice the contiguous region covering a group of blocks of one
+        resident array — one device slice replaces a streamed combine."""
+        if not keys:
+            return None
+        names = {k[0] for k in keys}
+        if len(names) != 1:
+            return None
+        name = keys[0][0]
+        proxy = spec.reads_map.get(name)
+        if proxy is None:
+            return None
+        arr = proxy.array
+        key = str(getattr(arr, "store", id(arr)))
+        if key not in resident or not arr.shape:
+            return None
+        res = resident[key]
+        res.touch()
+        chunkset = blockdims_from_blockshape(arr.shape, proxy.chunks)
+        coords = [tuple(k[1:]) for k in keys]
+        ndim = len(arr.shape)
+        los = [min(c[d] for c in coords) for d in range(ndim)]
+        his = [max(c[d] for c in coords) for d in range(ndim)]
+        # must be the full dense block range
+        if len(coords) != math.prod(h - l + 1 for l, h in zip(los, his)):
+            return None
+        sel = tuple(
+            slice(
+                sum(chunkset[d][: los[d]]),
+                sum(chunkset[d][: his[d] + 1]),
+            )
+            for d in range(ndim)
+        )
+        value = res.value
+        if isinstance(value, dict):
+            return {k: v[sel] for k, v in value.items()}
+        return value[sel]
 
     def _resolve(self, entry, spec: BlockwiseSpec, resident):
         """Resolve a key structure to device chunks (sliced from residents)."""
@@ -395,7 +615,17 @@ class JaxExecutor(DagExecutor):
             if isinstance(value, dict):
                 return {k: v[sel] for k, v in value.items()}
             return value[sel]
-        # storage / virtual fallback (host read + device transfer)
+        # constant-valued chunks are created on device — no host transfer
+        if isinstance(arr, (VirtualEmptyArray, VirtualFullArray)):
+            jax = _jax()
+            chunkset = (
+                blockdims_from_blockshape(arr.shape, proxy.chunks) if arr.shape else ()
+            )
+            sel = get_item(chunkset, coords) if arr.shape else ()
+            shape = tuple(s.stop - s.start for s in sel)
+            fill = getattr(arr, "fill_value", 0)
+            return jax.numpy.full(shape, fill, dtype=arr.dtype)
+        # storage / small-virtual fallback (host read + device transfer)
         from ...primitive.blockwise import get_chunk
 
         opened = proxy.open()
@@ -498,6 +728,107 @@ class JaxExecutor(DagExecutor):
                 concrete[sel] = rec
             else:
                 concrete[sel] = np.asarray(value[sel])
+
+
+_PYTREES_REGISTERED = False
+
+
+def _register_pred_pytrees() -> None:
+    """Register fusion marker types as jax pytrees so vmap maps through them."""
+    global _PYTREES_REGISTERED
+    if _PYTREES_REGISTERED:
+        return
+    import jax
+
+    from ...primitive.blockwise import PredArgs
+
+    try:
+        jax.tree_util.register_pytree_node(
+            PredArgs,
+            lambda x: (list(x), None),
+            lambda _, children: PredArgs(children),
+        )
+    except ValueError:
+        pass  # already registered
+    _PYTREES_REGISTERED = True
+
+
+def _flatten_keys(structure):
+    """Flatten a block-function result into (treedef, leaf keys).
+
+    Treedef is a comparable nested template: 'leaf' for a chunk key,
+    ('pred', ...) for fused-predecessor groups, ('list', ...) for contraction
+    lists, ('args', ...) at the top. Returns (None, None) on iterators
+    (streamed reads are not batchable)."""
+    from ...primitive.blockwise import PredKeys, _is_key
+
+    leaves: list = []
+
+    def walk(node):
+        if isinstance(node, PredKeys):
+            return ("pred", tuple(walk(c) for c in node))
+        if _is_key(node):
+            leaves.append(node)
+            return "leaf"
+        if isinstance(node, (list, tuple)):
+            return ("list", tuple(walk(c) for c in node))
+        return None  # Iterator / unknown
+
+    out = []
+    for entry in structure:
+        t = walk(entry)
+        if t is None or _contains_none(t):
+            return None, None
+        out.append(t)
+    return ("args", tuple(out)), leaves
+
+
+def _contains_none(t) -> bool:
+    if t is None:
+        return True
+    if isinstance(t, tuple) and len(t) == 2 and t[0] in ("pred", "list"):
+        return any(_contains_none(c) for c in t[1])
+    return False
+
+
+def _unflatten_keys(treedef, flat: list):
+    """Rebuild the argument structure with chunks in place of keys.
+
+    PredKeys groups become PredArgs (the resolved-chunk marker the fused
+    kernel expects); contraction groups become plain lists."""
+    from ...primitive.blockwise import PredArgs
+
+    it = iter(flat)
+
+    def build(t):
+        if t == "leaf":
+            return next(it)
+        kind, children = t
+        if kind == "pred":
+            return PredArgs([build(c) for c in children])
+        return [build(c) for c in children]
+
+    kind, entries = treedef
+    assert kind == "args"
+    return tuple(build(e) for e in entries)
+
+
+def _gather_blocks(value, nb, chunk_shape, idx):
+    """(full array, grid, chunk shape, task->block index) -> (T, *chunk)."""
+    import jax.numpy as jnp
+
+    def one(v):
+        inter = []
+        for n, c in zip(nb, chunk_shape):
+            inter.extend([n, c])
+        r = v.reshape(tuple(inter))
+        perm = list(range(0, 2 * len(nb), 2)) + list(range(1, 2 * len(nb), 2))
+        blocks = r.transpose(perm).reshape((-1,) + tuple(chunk_shape))
+        return blocks[idx]
+
+    if isinstance(value, dict):
+        return {k: one(v) for k, v in value.items()}
+    return one(value)
 
 
 class _JitCache:

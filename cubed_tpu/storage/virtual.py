@@ -82,17 +82,20 @@ class VirtualFullArray(_VirtualBase):
 
 
 class VirtualOffsetsArray(_VirtualBase):
-    """Maps each (1,...,1)-shaped chunk to its linear block offset.
+    """Maps each (1,...,1)-shaped chunk to ``base +`` its linear block offset.
 
     Appended as a hidden input to ``map_blocks`` calls that need ``block_id``:
-    the task reads its offset and unravels it. Reference parity:
-    cubed/storage/virtual.py:82-102.
+    the task reads its offset and unravels it. ``base`` lets per-plan values
+    (e.g. an RNG seed) travel as *data* rather than as compiled-in constants,
+    keeping kernel HLO identical across plans (compilation-cache friendly).
+    Reference parity: cubed/storage/virtual.py:82-102.
     """
 
-    def __init__(self, shape: Sequence[int]):
+    def __init__(self, shape: Sequence[int], base: int = 0):
         self.shape = tuple(int(s) for s in shape)
-        self.dtype = np.dtype(np.int32)
+        self.dtype = np.dtype(np.int64)
         self.chunks = (1,) * len(self.shape)
+        self.base = int(base)
 
     def __getitem__(self, key) -> np.ndarray:
         sel = _normalize_key(key, self.shape)
@@ -100,7 +103,7 @@ class VirtualOffsetsArray(_VirtualBase):
         if any(s.stop - s.start != 1 for s in sel):
             raise IndexError("VirtualOffsetsArray must be read one block at a time")
         offset = int(np.ravel_multi_index(idx, self.shape)) if self.shape else 0
-        return np.full((1,) * len(self.shape), offset, dtype=self.dtype)
+        return np.full((1,) * len(self.shape), self.base + offset, dtype=self.dtype)
 
 
 class VirtualInMemoryArray(_VirtualBase):

@@ -15,6 +15,16 @@ import tempfile
 
 import pytest
 
+# the axon TPU plugin ignores JAX_PLATFORMS; pin the default device to the
+# (virtual, 8-way) CPU backend so tests never touch the real chip
+try:
+    import jax
+
+    _cpu = jax.devices("cpu")
+    jax.config.update("jax_default_device", _cpu[0])
+except Exception:
+    pass
+
 
 @pytest.fixture
 def spec(tmp_path):
