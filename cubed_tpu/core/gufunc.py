@@ -119,10 +119,28 @@ def apply_gufunc(
     kwargs.pop("output_sizes", None)
 
     return blockwise(
-        func,
+        _UnwrapCoreDims(func),
         out_ind,
         *blockwise_args,
         dtype=otype,
         new_axes=new_axes or None,
         **kwargs,
     )
+
+
+class _UnwrapCoreDims:
+    """Contracted (core) dims arrive as single-element nested lists, since core
+    dims are single-chunk by contract; unwrap them to plain chunks."""
+
+    def __init__(self, func):
+        self.func = func
+        self.__name__ = getattr(func, "__name__", "apply_gufunc")
+
+    def __call__(self, *args, **kwargs):
+        return self.func(*[_unwrap_single(a) for a in args], **kwargs)
+
+
+def _unwrap_single(x):
+    while isinstance(x, list) and len(x) == 1:
+        x = x[0]
+    return x

@@ -19,6 +19,10 @@ MAX_IN_MEMORY_BYTES = 1_000_000
 def _normalize_key(key, shape):
     if not isinstance(key, tuple):
         key = (key,)
+    if Ellipsis in key:
+        i = key.index(Ellipsis)
+        fill = len(shape) - (len(key) - 1)
+        key = key[:i] + (slice(None),) * fill + key[i + 1 :]
     key = key + (slice(None),) * (len(shape) - len(key))
     return tuple(
         slice(*k.indices(s)) if isinstance(k, slice) else slice(int(k), int(k) + 1)

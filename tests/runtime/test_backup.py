@@ -1,0 +1,29 @@
+"""Straggler-backup policy unit tests. Reference parity:
+cubed/tests/runtime/test_backup.py."""
+
+from cubed_tpu.runtime.backup import should_launch_backup
+
+
+def test_not_enough_started():
+    start = {i: 0.0 for i in range(5)}
+    end = {i: 1.0 for i in range(4)}
+    assert not should_launch_backup(4, 100.0, start, end)
+
+
+def test_not_enough_completed():
+    start = {i: 0.0 for i in range(20)}
+    end = {i: 1.0 for i in range(5)}  # <50%
+    assert not should_launch_backup(19, 100.0, start, end)
+
+
+def test_not_slow_enough():
+    start = {i: 0.0 for i in range(20)}
+    end = {i: 1.0 for i in range(15)}
+    # median duration 1.0; task at 2.5x is under the 3x threshold
+    assert not should_launch_backup(19, 2.5, start, end)
+
+
+def test_backup_launched_for_straggler():
+    start = {i: 0.0 for i in range(20)}
+    end = {i: 1.0 for i in range(15)}
+    assert should_launch_backup(19, 3.5, start, end)

@@ -1,0 +1,102 @@
+"""Async executor tests: success, failure, retries, stragglers, batching.
+
+Reference parity: cubed/tests/runtime/test_python_async.py:43-102.
+"""
+
+import concurrent.futures
+from functools import partial
+
+import pytest
+
+from cubed_tpu.runtime.executors.python_async import map_unordered
+
+from .utils import check_invocation_counts, deterministic_failure
+
+
+def run_test(function, inputs, retries=2, use_backups=False, batch_size=None):
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+        map_unordered(
+            pool,
+            function,
+            inputs,
+            retries=retries,
+            use_backups=use_backups,
+            batch_size=batch_size,
+        )
+
+
+def test_success(tmp_path):
+    path = str(tmp_path)
+    timing_map = {}
+    run_test(partial(deterministic_failure, path, timing_map), list(range(10)))
+    check_invocation_counts(path, timing_map, 10)
+
+
+def test_retries_successful(tmp_path):
+    path = str(tmp_path)
+    timing_map = {0: [-1], 1: [-1, -1]}
+    run_test(partial(deterministic_failure, path, timing_map), list(range(10)))
+    check_invocation_counts(path, timing_map, 10)
+
+
+def test_retries_failure(tmp_path):
+    path = str(tmp_path)
+    timing_map = {0: [-1, -1, -1]}  # fails all 3 attempts
+    with pytest.raises(RuntimeError, match="Deliberately fail"):
+        run_test(partial(deterministic_failure, path, timing_map), list(range(10)))
+    check_invocation_counts(path, timing_map, 10, retries=2,
+                            expected_invocation_counts_overrides={0: 3})
+
+
+def test_stragglers_launch_backups(tmp_path):
+    path = str(tmp_path)
+    # one slow task among many fast ones; with backups on, a duplicate runs
+    timing_map = {9: [1000]}
+    run_test(
+        partial(deterministic_failure, path, timing_map),
+        list(range(10)),
+        use_backups=True,
+    )
+    # the slow task ran at least once (possibly twice with its backup)
+    from .utils import read_int_from_file
+    import os
+
+    assert read_int_from_file(os.path.join(path, "9")) >= 1
+
+
+def test_batch(tmp_path):
+    path = str(tmp_path)
+    timing_map = {}
+    run_test(
+        partial(deterministic_failure, path, timing_map),
+        list(range(10)),
+        batch_size=3,
+    )
+    check_invocation_counts(path, timing_map, 10)
+
+
+def test_executor_end_to_end_with_failures(tmp_path, spec, monkeypatch):
+    """Retries are exercised through a real plan execution."""
+    import numpy as np
+
+    import cubed_tpu as ct
+    import cubed_tpu.array_api as xp
+    from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+
+    calls = {"n": 0}
+    an = np.arange(16.0).reshape(4, 4)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+
+    fail_once = {"done": False}
+
+    def flaky(x):
+        calls["n"] += 1
+        if not fail_once["done"]:
+            fail_once["done"] = True
+            raise RuntimeError("transient")
+        return x + 1
+
+    b = ct.map_blocks(flaky, a, dtype=a.dtype)
+    result = b.compute(executor=AsyncPythonDagExecutor(retries=2))
+    np.testing.assert_allclose(result, an + 1)
+    assert calls["n"] >= 5  # 4 tasks + at least 1 retry

@@ -1,0 +1,79 @@
+"""Fault-injection harness for executor tests.
+
+Reference parity: cubed/tests/runtime/utils.py:20-103 — a task that, per
+input, consults a timing map of signed sleep codes (positive = slow success,
+negative = sleep then raise), persisting invocation counters in files so it
+works across threads/processes; then assert exact retry counts.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+
+
+def read_int_from_file(path: str) -> int:
+    try:
+        with open(path) as f:
+            return int(f.read())
+    except FileNotFoundError:
+        return 0
+
+
+def write_int_to_file(path: str, value: int) -> None:
+    tmp = f"{path}.{uuid.uuid4().hex[:8]}.tmp"
+    with open(tmp, "w") as f:
+        f.write(str(value))
+    os.replace(tmp, path)
+
+
+def deterministic_failure(path: str, timing_map: dict, i, *, config=None) -> int:
+    """Task that fails/succeeds deterministically per invocation count.
+
+    ``timing_map[i]`` is a list of signed sleep durations (ms): one entry per
+    invocation; positive sleeps then succeeds, negative sleeps then raises.
+    Invocations beyond the list succeed immediately.
+    """
+    # unpack task keys of the form (name, i)
+    if isinstance(i, tuple):
+        i = i[-1]
+    invocation_count_file = os.path.join(path, str(i))
+    invocation_count = read_int_from_file(invocation_count_file)
+    write_int_to_file(invocation_count_file, invocation_count + 1)
+    timing_codes = timing_map.get(i, [])
+    if invocation_count >= len(timing_codes):
+        return i
+    timing_code = timing_codes[invocation_count]
+    if timing_code >= 0:
+        time.sleep(timing_code / 1000.0)
+        return i
+    time.sleep(-timing_code / 1000.0)
+    raise RuntimeError(
+        f"Deliberately fail on invocation number {invocation_count + 1} for input {i}"
+    )
+
+
+def check_invocation_counts(
+    path: str,
+    timing_map: dict,
+    n_tasks: int,
+    retries: int | None = None,
+    expected_invocation_counts_overrides: dict | None = None,
+) -> None:
+    expected = {}
+    for i in range(n_tasks):
+        timing_codes = timing_map.get(i, [])
+        expected_count = 1
+        for timing_code in timing_codes:
+            if timing_code < 0:
+                expected_count += 1
+            else:
+                break
+        if retries is not None:
+            expected_count = min(expected_count, retries + 1)
+        expected[i] = expected_count
+    if expected_invocation_counts_overrides:
+        expected.update(expected_invocation_counts_overrides)
+    actual = {i: read_int_from_file(os.path.join(path, str(i))) for i in range(n_tasks)}
+    assert actual == expected, f"expected {expected}, got {actual}"

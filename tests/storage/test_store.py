@@ -1,0 +1,136 @@
+"""Zarr v2 store tests: layout conformance, indexing, atomicity, resume
+counters. Reference parity: cubed/tests/storage/test_zarr.py."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cubed_tpu.storage.store import open_zarr_array
+from cubed_tpu.storage.zarr import LazyZarrArray, lazy_empty, open_if_lazy_zarr_array
+
+
+def test_create_and_roundtrip(tmp_path):
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(store, "w", shape=(5, 7), dtype=np.float64, chunks=(2, 3))
+    an = np.arange(35.0).reshape(5, 7)
+    z[...] = an
+    np.testing.assert_array_equal(z[...], an)
+    # reopen
+    z2 = open_zarr_array(store, "r")
+    np.testing.assert_array_equal(z2[...], an)
+    assert z2.chunks == (2, 3)
+    assert z2.dtype == np.float64
+
+
+def test_zarr_v2_layout(tmp_path):
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(store, "w", shape=(4, 4), dtype=np.int32, chunks=(2, 2))
+    z[...] = np.arange(16, dtype=np.int32).reshape(4, 4)
+    meta = json.loads(open(os.path.join(store, ".zarray")).read())
+    assert meta["zarr_format"] == 2
+    assert meta["shape"] == [4, 4]
+    assert meta["chunks"] == [2, 2]
+    assert meta["compressor"] is None
+    assert meta["dimension_separator"] == "."
+    # chunk 1.1 holds the bottom-right block, raw C-order
+    raw = np.frombuffer(open(os.path.join(store, "1.1"), "rb").read(), dtype="<i4")
+    np.testing.assert_array_equal(raw.reshape(2, 2), [[10, 11], [14, 15]])
+
+
+def test_partial_reads_writes(tmp_path):
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(store, "w", shape=(6, 6), dtype=np.float64, chunks=(4, 4))
+    an = np.zeros((6, 6))
+    z[...] = an
+    z[1:3, 2:5] = 7.0
+    an[1:3, 2:5] = 7.0
+    np.testing.assert_array_equal(z[...], an)
+    np.testing.assert_array_equal(z[0:4, 3:6], an[0:4, 3:6])
+    np.testing.assert_array_equal(z[5], an[5])
+    np.testing.assert_array_equal(z[::2, 1::2], an[::2, 1::2])
+
+
+def test_edge_chunks_padded(tmp_path):
+    # 5x5 with 2x2 chunks: edge chunks stored padded, reads clip to shape
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(store, "w", shape=(5, 5), dtype=np.float64, chunks=(2, 2))
+    an = np.arange(25.0).reshape(5, 5)
+    z[...] = an
+    np.testing.assert_array_equal(z[...], an)
+    np.testing.assert_array_equal(z[4:5, 3:5], an[4:5, 3:5])
+
+
+def test_oindex(tmp_path):
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(store, "w", shape=(6, 8), dtype=np.float64, chunks=(2, 3))
+    an = np.arange(48.0).reshape(6, 8)
+    z[...] = an
+    np.testing.assert_array_equal(z.oindex[[0, 3, 5], :], an[[0, 3, 5], :])
+    np.testing.assert_array_equal(
+        z.oindex[[1, 4], [0, 2, 7]], an[np.ix_([1, 4], [0, 2, 7])]
+    )
+    np.testing.assert_array_equal(z.oindex[slice(1, 5), [2, 2, 3]],
+                                  an[1:5][:, [2, 2, 3]])
+
+
+def test_nchunks_initialized(tmp_path):
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(store, "w", shape=(4, 4), dtype=np.float64, chunks=(2, 2))
+    assert z.nchunks == 4
+    assert z.nchunks_initialized == 0
+    z[0:2, 0:2] = 1.0
+    assert z.nchunks_initialized == 1
+    z[...] = 1.0
+    assert z.nchunks_initialized == 4
+
+
+def test_structured_dtype(tmp_path):
+    dtype = np.dtype([("n", np.int64), ("total", np.float64)])
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(store, "w", shape=(2, 2), dtype=dtype, chunks=(1, 2))
+    rec = np.zeros((2, 2), dtype=dtype)
+    rec["n"] = [[1, 2], [3, 4]]
+    rec["total"] = [[0.5, 1.5], [2.5, 3.5]]
+    z[...] = rec
+    out = z[...]
+    np.testing.assert_array_equal(out["n"], rec["n"])
+    np.testing.assert_array_equal(out["total"], rec["total"])
+
+
+def test_0d_array(tmp_path):
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(store, "w", shape=(), dtype=np.float64)
+    z[()] = 42.0
+    assert float(z[()]) == 42.0
+
+
+def test_lazy_zarr_array(tmp_path):
+    store = str(tmp_path / "a.zarr")
+    lazy = lazy_empty((4, 4), dtype=np.float64, chunks=(2, 2), store=store)
+    # no metadata until create()
+    with pytest.raises(FileNotFoundError):
+        lazy.open()
+    lazy.create()
+    z = open_if_lazy_zarr_array(lazy)
+    assert z.shape == (4, 4)
+
+
+def test_mode_a_preserves_chunks(tmp_path):
+    # reopening with mode=a must not clobber existing chunk data (resume)
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(store, "w", shape=(4, 4), dtype=np.float64, chunks=(2, 2))
+    z[0:2, 0:2] = 5.0
+    z2 = open_zarr_array(store, "a", shape=(4, 4), dtype=np.float64, chunks=(2, 2))
+    np.testing.assert_array_equal(z2[0:2, 0:2], np.full((2, 2), 5.0))
+    assert z2.nchunks_initialized == 1
+
+
+def test_fill_value(tmp_path):
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(
+        store, "w", shape=(4,), dtype=np.float64, chunks=(2,), fill_value=np.nan
+    )
+    out = z[...]
+    assert np.isnan(out).all()

@@ -1,0 +1,52 @@
+"""Virtual array tests. Reference parity: cubed/tests/storage/test_virtual.py."""
+
+import numpy as np
+import pytest
+
+from cubed_tpu.storage.virtual import (
+    VirtualEmptyArray,
+    VirtualFullArray,
+    VirtualInMemoryArray,
+    VirtualOffsetsArray,
+)
+
+
+def test_virtual_full():
+    v = VirtualFullArray((5, 7), np.float64, (2, 3), 3.5)
+    out = v[1:4, 2:6]
+    assert out.shape == (3, 4)
+    assert (out == 3.5).all()
+    # broadcast trick: no real allocation
+    assert out.strides == (0, 0)
+
+
+def test_virtual_empty():
+    v = VirtualEmptyArray((5, 7), np.float64, (2, 3))
+    assert v[0:2, 0:3].shape == (2, 3)
+    assert v.nbytes == 5 * 7 * 8
+
+
+def test_virtual_offsets():
+    v = VirtualOffsetsArray((2, 3))
+    assert int(v[0:1, 0:1].ravel()[0]) == 0
+    assert int(v[0:1, 2:3].ravel()[0]) == 2
+    assert int(v[1:2, 0:1].ravel()[0]) == 3
+    with pytest.raises(IndexError):
+        v[0:2, 0:1]
+
+
+def test_virtual_offsets_base():
+    v = VirtualOffsetsArray((2, 2), base=100)
+    assert int(v[1:2, 1:2].ravel()[0]) == 103
+
+
+def test_virtual_in_memory():
+    an = np.arange(12).reshape(3, 4)
+    v = VirtualInMemoryArray(an, (2, 2))
+    np.testing.assert_array_equal(v[1:3, 0:2], an[1:3, 0:2])
+
+
+def test_virtual_in_memory_size_limit():
+    big = np.zeros(2_000_000, dtype=np.uint8)
+    with pytest.raises(ValueError, match="exceeds maximum"):
+        VirtualInMemoryArray(big, (1000,))

@@ -1,0 +1,364 @@
+"""Array API surface tests against numpy reference semantics.
+
+Reference parity: cubed/tests/test_array_api.py (600 LoC, behavioral).
+"""
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+
+
+@pytest.fixture
+def nums(spec):
+    an = np.arange(24.0).reshape(4, 6) + 1.0
+    return an, ct.from_array(an, chunks=(2, 3), spec=spec)
+
+
+def assert_eq(actual, expect, **kw):
+    np.testing.assert_allclose(np.asarray(actual), expect, **kw)
+
+
+# -- creation ----------------------------------------------------------------
+
+
+def test_arange(spec):
+    assert_eq(xp.arange(20, chunks=6, spec=spec).compute(), np.arange(20))
+    assert_eq(
+        xp.arange(3, 21, 2, chunks=5, spec=spec).compute(), np.arange(3, 21, 2)
+    )
+
+
+def test_linspace(spec):
+    assert_eq(
+        xp.linspace(0.0, 1.0, 13, chunks=5, spec=spec).compute(),
+        np.linspace(0.0, 1.0, 13),
+    )
+
+
+def test_asarray_roundtrip(spec):
+    an = np.arange(12).reshape(3, 4)
+    assert_eq(xp.asarray(an, chunks=2, spec=spec).compute(), an)
+
+
+def test_eye(spec):
+    assert_eq(xp.eye(7, 5, k=1, chunks=3, spec=spec).compute(), np.eye(7, 5, k=1))
+    assert_eq(xp.eye(6, chunks=2, spec=spec).compute(), np.eye(6))
+
+
+def test_ones_zeros_full(spec):
+    assert_eq(xp.ones((3, 4), chunks=2, spec=spec).compute(), np.ones((3, 4)))
+    assert_eq(xp.zeros((3, 4), chunks=2, spec=spec).compute(), np.zeros((3, 4)))
+    assert_eq(xp.full((3, 4), 7, chunks=2, spec=spec).compute(), np.full((3, 4), 7))
+
+
+def test_tril_triu(spec):
+    an = np.arange(25.0).reshape(5, 5)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    assert_eq(xp.tril(a).compute(), np.tril(an))
+    assert_eq(xp.triu(a, k=1).compute(), np.triu(an, k=1))
+
+
+def test_meshgrid(spec):
+    xn = np.arange(4.0)
+    yn = np.arange(3.0)
+    x = ct.from_array(xn, chunks=2, spec=spec)
+    y = ct.from_array(yn, chunks=2, spec=spec)
+    gx, gy = xp.meshgrid(x, y)
+    exp_x, exp_y = np.meshgrid(xn, yn)
+    assert_eq(gx.compute(), exp_x)
+    assert_eq(gy.compute(), exp_y)
+
+
+# -- elementwise / operators -------------------------------------------------
+
+
+def test_operators(nums):
+    an, a = nums
+    assert_eq((a + a).compute(), an + an)
+    assert_eq((a - 2.0).compute(), an - 2.0)
+    assert_eq((3.0 * a).compute(), 3.0 * an)
+    assert_eq((a / a).compute(), an / an)
+    assert_eq((a // 2.0).compute(), an // 2.0)
+    assert_eq((a % 3.0).compute(), an % 3.0)
+    assert_eq((a ** 2.0).compute(), an ** 2.0)
+    assert_eq((-a).compute(), -an)
+    assert_eq(abs(-a).compute(), an)
+
+
+def test_comparison_ops(nums):
+    an, a = nums
+    assert_eq((a > 5.0).compute(), an > 5.0)
+    assert_eq((a <= 5.0).compute(), an <= 5.0)
+    assert_eq((a == 4.0).compute(), an == 4.0)
+    assert_eq((a != 4.0).compute(), an != 4.0)
+
+
+def test_bitwise_ops(spec):
+    an = np.arange(16, dtype=np.int64).reshape(4, 4)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    assert_eq((a & 3).compute(), an & 3)
+    assert_eq((a | 3).compute(), an | 3)
+    assert_eq((a ^ 3).compute(), an ^ 3)
+    assert_eq((~a).compute(), ~an)
+    assert_eq((a << 2).compute(), an << 2)
+    assert_eq((a >> 1).compute(), an >> 1)
+
+
+def test_elementwise_functions(nums):
+    an, a = nums
+    assert_eq(xp.sqrt(a).compute(), np.sqrt(an))
+    assert_eq(xp.exp(a).compute(), np.exp(an), rtol=1e-12)
+    assert_eq(xp.log(a).compute(), np.log(an))
+    assert_eq(xp.sin(a).compute(), np.sin(an))
+    assert_eq(xp.square(a).compute(), np.square(an))
+    assert_eq(xp.sign(a).compute(), np.sign(an))
+    assert_eq(xp.floor(a / 2).compute(), np.floor(an / 2))
+    assert_eq(xp.ceil(a / 2).compute(), np.ceil(an / 2))
+    assert_eq(xp.round(a / 3).compute(), np.round(an / 3))
+    assert_eq(xp.logaddexp(a, a).compute(), np.logaddexp(an, an))
+
+
+def test_isnan_isinf(spec):
+    an = np.array([[1.0, np.nan], [np.inf, -np.inf]])
+    a = ct.from_array(an, chunks=(1, 2), spec=spec)
+    assert_eq(xp.isnan(a).compute(), np.isnan(an))
+    assert_eq(xp.isinf(a).compute(), np.isinf(an))
+    assert_eq(xp.isfinite(a).compute(), np.isfinite(an))
+
+
+def test_where(nums):
+    an, a = nums
+    r = xp.where(a > 10.0, a, 0.0 * a)
+    assert_eq(r.compute(), np.where(an > 10.0, an, 0.0))
+
+
+def test_scalar_promotion_errors(nums):
+    an, a = nums
+    with pytest.raises(TypeError):
+        a + True  # bool scalar with float array
+    b = xp.asarray([True, False], spec=a.spec)
+    with pytest.raises(TypeError):
+        b + 1  # int scalar with bool array
+
+
+# -- statistical -------------------------------------------------------------
+
+
+def test_reductions(nums):
+    an, a = nums
+    assert_eq(xp.sum(a).compute(), an.sum())
+    assert_eq(xp.prod(a / 4.0).compute(), (an / 4.0).prod(), rtol=1e-10)
+    assert_eq(xp.max(a, axis=0).compute(), an.max(axis=0))
+    assert_eq(xp.min(a, axis=1).compute(), an.min(axis=1))
+    assert_eq(xp.mean(a, axis=1).compute(), an.mean(axis=1))
+
+
+def test_sum_dtype_upcast(spec):
+    an = np.arange(6, dtype=np.int32)
+    a = ct.from_array(an, chunks=2, spec=spec)
+    s = xp.sum(a)
+    assert s.dtype == np.dtype(np.int64)
+    assert int(s.compute()) == an.sum()
+
+
+def test_var_std(nums):
+    an, a = nums
+    assert_eq(xp.var(a).compute(), an.var(), rtol=1e-12)
+    assert_eq(xp.std(a, axis=0).compute(), an.std(axis=0), rtol=1e-12)
+    assert_eq(
+        xp.var(a, correction=1).compute(), an.var(ddof=1), rtol=1e-12
+    )
+
+
+def test_argmax_argmin(spec):
+    an = np.random.default_rng(42).random((8, 10))
+    a = ct.from_array(an, chunks=(3, 4), spec=spec)
+    assert_eq(xp.argmax(a, axis=1).compute(), an.argmax(axis=1))
+    assert_eq(xp.argmin(a, axis=0).compute(), an.argmin(axis=0))
+    assert int(xp.argmax(a).compute()) == an.argmax()
+
+
+def test_all_any(spec):
+    an = np.array([[True, False], [True, True]])
+    a = ct.from_array(an, chunks=(1, 2), spec=spec)
+    assert bool(xp.all(a).compute()) == an.all()
+    assert bool(xp.any(a).compute()) == an.any()
+    assert_eq(xp.all(a, axis=0).compute(), an.all(axis=0))
+
+
+# -- linalg ------------------------------------------------------------------
+
+
+def test_matmul_1d(spec):
+    an = np.arange(6.0)
+    bn = np.arange(6.0) + 1
+    a = ct.from_array(an, chunks=3, spec=spec)
+    b = ct.from_array(bn, chunks=3, spec=spec)
+    assert_eq(xp.matmul(a, b).compute(), an @ bn)
+
+
+def test_matmul_batched(spec):
+    rng = np.random.default_rng(0)
+    an = rng.random((2, 4, 6))
+    bn = rng.random((2, 6, 5))
+    a = ct.from_array(an, chunks=(1, 2, 3), spec=spec)
+    b = ct.from_array(bn, chunks=(1, 3, 5), spec=spec)
+    assert_eq(xp.matmul(a, b).compute(), an @ bn, rtol=1e-12)
+
+
+def test_tensordot_axes2(spec):
+    rng = np.random.default_rng(0)
+    an = rng.random((4, 5, 6))
+    bn = rng.random((5, 6, 3))
+    a = ct.from_array(an, chunks=(2, 5, 3), spec=spec)
+    b = ct.from_array(bn, chunks=(5, 3, 3), spec=spec)
+    assert_eq(
+        xp.tensordot(a, b, axes=2).compute(), np.tensordot(an, bn, axes=2), rtol=1e-12
+    )
+
+
+def test_outer_vecdot(spec):
+    an = np.arange(4.0)
+    bn = np.arange(5.0)
+    a = ct.from_array(an, chunks=2, spec=spec)
+    b = ct.from_array(bn, chunks=2, spec=spec)
+    assert_eq(xp.outer(a, b).compute(), np.outer(an, bn))
+    c = ct.from_array(bn, chunks=2, spec=spec)
+    assert_eq(xp.vecdot(b, c).compute(), np.dot(bn, bn))
+
+
+def test_matrix_transpose(nums):
+    an, a = nums
+    assert_eq(a.T.compute(), an.T)
+    assert_eq(xp.matrix_transpose(a).compute(), an.T)
+
+
+# -- manipulation ------------------------------------------------------------
+
+
+def test_broadcast_to(spec):
+    an = np.arange(6.0)
+    a = ct.from_array(an, chunks=2, spec=spec)
+    assert_eq(
+        xp.broadcast_to(a, (4, 6)).compute(), np.broadcast_to(an, (4, 6))
+    )
+
+
+def test_concat(spec):
+    an = np.arange(12.0).reshape(3, 4)
+    bn = np.arange(8.0).reshape(2, 4)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = ct.from_array(bn, chunks=(2, 2), spec=spec)
+    assert_eq(xp.concat([a, b], axis=0).compute(), np.concatenate([an, bn], axis=0))
+    c = ct.from_array(an, chunks=(2, 2), spec=spec)
+    assert_eq(xp.concat([a, c], axis=1).compute(), np.concatenate([an, an], axis=1))
+
+
+def test_stack_expand_squeeze(spec):
+    an = np.arange(12.0).reshape(3, 4)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = ct.from_array(an, chunks=(2, 2), spec=spec)
+    s = xp.stack([a, b], axis=0)
+    assert_eq(s.compute(), np.stack([an, an], axis=0))
+    e = xp.expand_dims(a, axis=1)
+    assert_eq(e.compute(), np.expand_dims(an, 1))
+    assert_eq(xp.squeeze(e, axis=1).compute(), an)
+
+
+def test_reshape_flatten(spec):
+    an = np.arange(24.0).reshape(4, 6)
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    assert_eq(xp.reshape(a, (6, 4)).compute(), an.reshape(6, 4))
+    assert_eq(xp.reshape(a, (-1,)).compute(), an.ravel())
+    assert_eq(xp.flatten(a).compute(), an.ravel())
+
+
+def test_permute_moveaxis(spec):
+    an = np.arange(24.0).reshape(2, 3, 4)
+    a = ct.from_array(an, chunks=(1, 2, 2), spec=spec)
+    assert_eq(xp.permute_dims(a, (2, 0, 1)).compute(), an.transpose(2, 0, 1))
+    assert_eq(xp.moveaxis(a, 0, -1).compute(), np.moveaxis(an, 0, -1))
+
+
+def test_flip(spec):
+    an = np.arange(24.0).reshape(4, 6)
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    assert_eq(xp.flip(a).compute(), np.flip(an))
+    assert_eq(xp.flip(a, axis=0).compute(), np.flip(an, axis=0))
+
+
+def test_roll(spec):
+    an = np.arange(24.0).reshape(4, 6)
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    assert_eq(xp.roll(a, 2, axis=1).compute(), np.roll(an, 2, axis=1))
+    assert_eq(xp.roll(a, -1, axis=0).compute(), np.roll(an, -1, axis=0))
+    assert_eq(xp.roll(a, 5).compute(), np.roll(an, 5))
+
+
+def test_repeat(spec):
+    an = np.arange(6.0).reshape(2, 3)
+    a = ct.from_array(an, chunks=(1, 2), spec=spec)
+    assert_eq(xp.repeat(a, 3, axis=1).compute(), np.repeat(an, 3, axis=1))
+
+
+def test_broadcast_arrays(spec):
+    an = np.arange(3.0)
+    bn = np.arange(4.0).reshape(4, 1)
+    a = ct.from_array(an, chunks=2, spec=spec)
+    b = ct.from_array(bn, chunks=(2, 1), spec=spec)
+    ra, rb = xp.broadcast_arrays(a, b)
+    ea, eb = np.broadcast_arrays(an, bn)
+    assert_eq(ra.compute(), ea)
+    assert_eq(rb.compute(), eb)
+
+
+# -- indexing ----------------------------------------------------------------
+
+
+def test_take(spec):
+    an = np.arange(24.0).reshape(4, 6)
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    assert_eq(xp.take(a, [0, 2, 3], axis=1).compute(), np.take(an, [0, 2, 3], axis=1))
+
+
+def test_newaxis(spec):
+    an = np.arange(6.0)
+    a = ct.from_array(an, chunks=2, spec=spec)
+    assert_eq(a[xp.newaxis, :].compute(), an[np.newaxis, :])
+
+
+# -- dtype functions ---------------------------------------------------------
+
+
+def test_astype(nums):
+    an, a = nums
+    assert_eq(xp.astype(a, np.int32).compute(), an.astype(np.int32))
+
+
+def test_result_type_and_can_cast():
+    assert xp.result_type(xp.int32, xp.int64) == np.dtype(np.int64)
+    assert xp.result_type(xp.float32, xp.float64) == np.dtype(np.float64)
+    assert xp.result_type(xp.int8, xp.uint8) == np.dtype(np.int16)
+    assert xp.can_cast(xp.int32, xp.int64)
+    assert not xp.can_cast(xp.int64, xp.int32)
+    with pytest.raises(TypeError):
+        xp.result_type(xp.int32, xp.bool)
+
+
+def test_finfo_iinfo():
+    assert xp.finfo(xp.float64).bits == 64
+    assert xp.iinfo(xp.int32).max == 2**31 - 1
+    assert xp.isdtype(xp.float32, "real floating")
+    assert not xp.isdtype(xp.int32, "real floating")
+
+
+# -- 0-d / scalar conversion -------------------------------------------------
+
+
+def test_scalar_conversions(spec):
+    s = xp.sum(xp.ones((3,), chunks=2, spec=spec))
+    assert float(s) == 3.0
+    i = xp.sum(xp.asarray([1, 2, 3], spec=spec))
+    assert int(i) == 6

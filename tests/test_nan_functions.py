@@ -1,0 +1,37 @@
+"""NaN-aware reduction tests. Reference parity: cubed/tests/test_nan_functions.py."""
+
+import numpy as np
+
+import cubed_tpu as ct
+
+
+def test_nansum(spec):
+    an = np.array([[1.0, np.nan, 3.0], [np.nan, 5.0, 6.0]])
+    a = ct.from_array(an, chunks=(1, 2), spec=spec)
+    np.testing.assert_allclose(ct.nansum(a).compute(), np.nansum(an))
+    np.testing.assert_allclose(
+        ct.nansum(a, axis=0).compute(), np.nansum(an, axis=0)
+    )
+
+
+def test_nanmean(spec):
+    an = np.array([[1.0, np.nan, 3.0], [np.nan, 5.0, 6.0]])
+    a = ct.from_array(an, chunks=(1, 2), spec=spec)
+    np.testing.assert_allclose(ct.nanmean(a).compute(), np.nanmean(an))
+    np.testing.assert_allclose(
+        ct.nanmean(a, axis=1).compute(), np.nanmean(an, axis=1)
+    )
+
+
+def test_nanmean_all_nan_block(spec):
+    an = np.array([[np.nan, np.nan], [1.0, 2.0]])
+    a = ct.from_array(an, chunks=(1, 2), spec=spec)
+    np.testing.assert_allclose(
+        ct.nanmean(a, axis=1).compute(), np.nanmean(an, axis=1)
+    )
+
+
+def test_nansum_int_passthrough(spec):
+    an = np.arange(6)
+    a = ct.from_array(an, chunks=3, spec=spec)
+    assert int(ct.nansum(a).compute()) == an.sum()

@@ -57,5 +57,9 @@ class TaskCounter(Callback):
 
 def execute_pipeline(primitive_op, executor=None):
     """Run a single primitive op outside a plan (unit-test harness)."""
+    from cubed_tpu.storage.zarr import LazyZarrArray
+
+    if isinstance(primitive_op.target_array, LazyZarrArray):
+        primitive_op.target_array.create(mode="a")
     for m in primitive_op.pipeline.mappable:
         primitive_op.pipeline.function(m, config=primitive_op.pipeline.config)
