@@ -402,6 +402,9 @@ def map_blocks(
             return func(*real, block_id=block_id, **kw)
 
         func_with_block_id.__name__ = getattr(func, "__name__", "map_blocks")
+        for attr in ("side_inputs", "whole_select", "resident_identity"):
+            if hasattr(func, attr):
+                setattr(func_with_block_id, attr, getattr(func, attr))
         blockwise_args.extend([offsets, tuple(range(in_ndim))])
         return blockwise(
             func_with_block_id,
@@ -508,6 +511,13 @@ def map_direct(
         return func(block, *opened, block_id=block_id, **kw)
 
     new_func.__name__ = getattr(func, "__name__", "map_direct")
+    # declare side inputs so residency-based executors materialize them in
+    # storage before this op's tasks read them directly; propagate fast-path
+    # markers from the inner task body
+    new_func.side_inputs = side_arrays
+    for attr in ("whole_select", "resident_identity"):
+        if hasattr(func, attr):
+            setattr(new_func, attr, getattr(func, attr))
 
     mapped = map_blocks(
         new_func,
@@ -633,7 +643,7 @@ def index(x: CoreArray, key) -> CoreArray:
         extra_projected_mem = x.chunkmem + chunk_memory(x.dtype, out_chunksize)
 
         result = map_direct(
-            partial(_read_index_chunk, out_chunks=out_chunks, selections=resolved),
+            _IndexRead(out_chunks, resolved),
             x,
             shape=out_shape,
             dtype=x.dtype,
@@ -652,19 +662,34 @@ def index(x: CoreArray, key) -> CoreArray:
     return result
 
 
-def _read_index_chunk(block, zarray, *, out_chunks, selections, block_id=None):
-    """Task body for index: read this output block's selection via oindex."""
-    sel = []
-    for ax, (bid, chunks_ax, s) in enumerate(zip(block_id, out_chunks, selections)):
-        start = sum(chunks_ax[:bid])
-        stop = start + chunks_ax[bid]
-        if isinstance(s, tuple):  # resolved slice (start, stop, step)
-            s0, s1, st = s
-            sel.append(slice(s0 + start * st, s0 + stop * st, st))
-        else:
-            sel.append(s[start:stop])
-    out = zarray.oindex[tuple(sel)]
-    return numpy_array_to_backend_array(out)
+class _IndexRead:
+    """Task body for index: read this output block's selection via oindex.
+
+    ``whole_select`` exposes the global per-axis selection so residency-based
+    executors can realize the whole index as one device-side gather instead of
+    per-task storage reads.
+    """
+
+    __name__ = "index"
+
+    def __init__(self, out_chunks, selections):
+        self.out_chunks = out_chunks
+        self.whole_select = selections
+
+    def __call__(self, block, zarray, block_id=None):
+        sel = []
+        for ax, (bid, chunks_ax, s) in enumerate(
+            zip(block_id, self.out_chunks, self.whole_select)
+        ):
+            start = sum(chunks_ax[:bid])
+            stop = start + chunks_ax[bid]
+            if isinstance(s, tuple):  # resolved slice (start, stop, step)
+                s0, s1, st = s
+                sel.append(slice(s0 + start * st, s0 + stop * st, st))
+            else:
+                sel.append(s[start:stop])
+        out = zarray.oindex[tuple(sel)]
+        return numpy_array_to_backend_array(out)
 
 
 # ---------------------------------------------------------------------------
@@ -727,7 +752,7 @@ def merge_chunks(x: CoreArray, chunks) -> CoreArray:
     target_chunks = normalize_chunks(target_chunksize, x.shape, dtype=x.dtype)
     extra_projected_mem = chunk_memory(x.dtype, to_chunksize(target_chunks)) + x.chunkmem
     return map_direct(
-        partial(_read_merged_chunk, target_chunks=target_chunks),
+        _MergedChunkRead(target_chunks),
         x,
         shape=x.shape,
         dtype=x.dtype,
@@ -736,9 +761,19 @@ def merge_chunks(x: CoreArray, chunks) -> CoreArray:
     )
 
 
-def _read_merged_chunk(block, zarray, *, target_chunks, block_id=None):
-    sel = get_item(target_chunks, block_id)
-    return numpy_array_to_backend_array(zarray[sel])
+class _MergedChunkRead:
+    """Task body for merge_chunks. ``resident_identity`` tells residency-based
+    executors the values pass through unchanged (chunking is metadata)."""
+
+    __name__ = "merge_chunks"
+    resident_identity = True
+
+    def __init__(self, target_chunks):
+        self.target_chunks = target_chunks
+
+    def __call__(self, block, zarray, block_id=None):
+        sel = get_item(self.target_chunks, block_id)
+        return numpy_array_to_backend_array(zarray[sel])
 
 
 # ---------------------------------------------------------------------------

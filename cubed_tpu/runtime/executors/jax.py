@@ -244,6 +244,38 @@ class JaxExecutor(DagExecutor):
         out_shape = tuple(target.shape)
         out_store = str(target.store)
 
+        side_inputs = getattr(spec.function, "side_inputs", None)
+
+        # residency-native fast paths for map_direct-family ops whose task
+        # bodies declared their access pattern
+        if side_inputs and len(side_inputs) == 1:
+            skey = str(getattr(side_inputs[0], "store", id(side_inputs[0])))
+            if skey in resident:
+                res = resident[skey]
+                if getattr(spec.function, "resident_identity", False):
+                    # merge_chunks: values pass through; chunking is metadata
+                    res.touch()
+                    self._admit(resident, out_store, res.value, target, budget)
+                    return
+                ws = getattr(spec.function, "whole_select", None)
+                if ws is not None:
+                    value = self._apply_whole_select(res.value, ws)
+                    if value is not None and (
+                        isinstance(value, dict) or tuple(value.shape) == out_shape
+                    ):
+                        res.touch()
+                        self._admit(resident, out_store, value, target, budget)
+                        return
+
+        # other map_direct ops read arbitrary regions from storage inside the
+        # task: materialize any resident side inputs first (they stay resident
+        # for later consumers too)
+        if side_inputs:
+            for arr in side_inputs:
+                skey = str(getattr(arr, "store", id(arr)))
+                if skey in resident:
+                    self._flush(resident[skey])
+
         inputs = self._whole_inputs(spec, resident)
 
         value = None
@@ -271,6 +303,32 @@ class JaxExecutor(DagExecutor):
             value = self._exec_chunked(op, spec, resident)
 
         self._admit(resident, out_store, value, target, budget)
+
+    def _apply_whole_select(self, value, selections):
+        """Apply a per-axis orthogonal selection to a resident array on device."""
+        jax = _jax()
+        jnp = jax.numpy
+        try:
+            v = value
+            for ax, s in enumerate(selections):
+                if isinstance(s, tuple):  # resolved slice (start, stop, step)
+                    sel = (slice(None),) * ax + (slice(*s),)
+                    v = (
+                        {k: vv[sel] for k, vv in v.items()}
+                        if isinstance(v, dict)
+                        else v[sel]
+                    )
+                else:
+                    idx = jnp.asarray(np.asarray(s))
+                    v = (
+                        {k: jnp.take(vv, idx, axis=ax) for k, vv in v.items()}
+                        if isinstance(v, dict)
+                        else jnp.take(v, idx, axis=ax)
+                    )
+            return v
+        except Exception:
+            logger.exception("whole-select fast path failed")
+            return None
 
     def _whole_inputs(self, spec: BlockwiseSpec, resident) -> Optional[Dict[str, Any]]:
         """Whole arrays for every input, from residency or storage."""
