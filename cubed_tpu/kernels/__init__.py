@@ -1,0 +1,1 @@
+from .reductions import block_sum, fused_fma_mean  # noqa: F401

@@ -68,7 +68,7 @@ class Spec:
         if self._executor is None and self._executor_name is not None:
             from .runtime.create import create_executor
 
-            return create_executor(self._executor_name, self._executor_options)
+            self._executor = create_executor(self._executor_name, self._executor_options)
         return self._executor
 
     @property
@@ -93,6 +93,8 @@ class Spec:
         )
 
     def __eq__(self, other) -> bool:
+        if other is self:
+            return True
         if isinstance(other, Spec):
             return (
                 self.work_dir == other.work_dir

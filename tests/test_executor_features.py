@@ -1,0 +1,108 @@
+"""Executor feature tests: retries, callbacks, resume, parallel generations,
+history/timeline extensions, measure_reserved_mem.
+
+Reference parity: cubed/tests/test_executor_features.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.extensions.history import HistoryCallback
+from cubed_tpu.extensions.timeline import TimelineVisualizationCallback
+from cubed_tpu.extensions.tqdm import TqdmProgressBar
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+
+from .utils import TaskCounter
+
+
+def test_callbacks_count_tasks(spec):
+    counter = TaskCounter()
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1)
+    b.compute(callbacks=[counter], optimize_graph=False)
+    # 9 compute tasks + create-arrays tasks
+    assert counter.value >= 9
+
+
+def test_history_callback(spec, tmp_path):
+    history = HistoryCallback(history_dir=str(tmp_path / "history"))
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1)
+    b.compute(callbacks=[history])
+    assert len(history.plan) > 0
+    assert len(history.events) > 0
+    stats = history.stats()
+    compute_rows = [r for r in stats if r["op_name"] != "create-arrays"]
+    assert all(r["projected_mem"] > 0 for r in compute_rows)
+    assert os.path.isdir(str(tmp_path / "history"))
+    assert any(f.startswith("plan-") for f in os.listdir(str(tmp_path / "history")))
+
+
+def test_timeline_callback(spec, tmp_path):
+    timeline = TimelineVisualizationCallback(plots_dir=str(tmp_path / "plots"))
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    xp.add(a, 1).compute(callbacks=[timeline])
+    assert os.path.isdir(str(tmp_path / "plots"))
+    assert len(os.listdir(str(tmp_path / "plots"))) == 1
+
+
+def test_progress_bar(spec, capsys):
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    xp.add(a, 1).compute(callbacks=[TqdmProgressBar()])
+
+
+def test_resume_skips_completed(spec):
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1)
+    c = xp.add(b, 1)
+    counter1 = TaskCounter()
+    c.compute(callbacks=[counter1], optimize_graph=False)
+    counter2 = TaskCounter()
+    c.compute(callbacks=[counter2], optimize_graph=False, resume=True)
+    assert counter2.value < counter1.value
+
+
+def test_compute_arrays_in_parallel(spec):
+    an = np.arange(16.0).reshape(4, 4)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1)
+    c = xp.multiply(a, 2)
+    ex = AsyncPythonDagExecutor(compute_arrays_in_parallel=True)
+    rb, rc = ct.compute(b, c, executor=ex)
+    np.testing.assert_allclose(rb, an + 1)
+    np.testing.assert_allclose(rc, an * 2)
+
+
+def test_measure_reserved_mem(tmp_path):
+    mem = ct.measure_reserved_mem(work_dir=str(tmp_path))
+    assert mem > 1_000_000  # a python process uses more than 1MB
+
+
+def test_executor_by_name(tmp_path):
+    spec = ct.Spec(
+        work_dir=str(tmp_path), allowed_mem="500MB", executor_name="single-threaded"
+    )
+    a = xp.ones((4, 4), chunks=(2, 2), spec=spec)
+    assert spec.executor is not None
+    np.testing.assert_allclose(xp.add(a, 1).compute(), np.full((4, 4), 2.0))
+
+
+def test_unknown_executor_name():
+    from cubed_tpu.runtime.create import create_executor
+
+    with pytest.raises(ValueError, match="Unrecognized executor name"):
+        create_executor("nonexistent")
+
+
+def test_visualize_outputs_dot(spec, tmp_path):
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1)
+    out = ct.visualize(b, filename=str(tmp_path / "plan"))
+    assert os.path.exists(out)
+    if out.endswith(".dot"):
+        content = open(out).read()
+        assert "digraph" in content

@@ -1,0 +1,82 @@
+"""Memory-bound verification (slow): representative ops run to completion
+with ``allowed_mem`` set exactly to the plan's max projected memory — i.e. the
+projected bound is sufficient — and the projected model dominates the real
+chunk working set analytically.
+
+Reference parity: cubed/tests/test_mem_utilization.py:275-296 (there: measured
+peak RSS <= projected per op in fresh worker processes; here the in-process
+analogue plus tight-budget completion).
+"""
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.spec import Spec
+
+
+def run_tight(build, tmp_path, shape=(1000, 1000), chunks=(200, 200)):
+    """Build the op graph twice: once to learn max projected mem, then again
+    under a spec that allows exactly that much."""
+    probe_spec = Spec(work_dir=str(tmp_path), allowed_mem="1GB", reserved_mem=0)
+    probed = build(probe_spec, shape, chunks)
+    projected = probed.plan.max_projected_mem()
+    assert projected > 0
+    tight_spec = Spec(work_dir=str(tmp_path), allowed_mem=projected, reserved_mem=0)
+    result = build(tight_spec, shape, chunks)
+    out = result.compute()
+    return projected, out
+
+
+OPS = {
+    "add": lambda a, b: xp.add(a, b),
+    "multiply": lambda a, b: xp.multiply(a, b),
+    "negative": lambda a, b: xp.negative(a),
+    "astype": lambda a, b: xp.astype(a, np.float32),
+    "sum": lambda a, b: xp.sum(a, axis=0),
+    "mean": lambda a, b: xp.mean(a, axis=0),
+    "max": lambda a, b: xp.max(a, axis=1),
+    "matmul": lambda a, b: xp.matmul(a, b),
+    "transpose": lambda a, b: xp.permute_dims(a, (1, 0)),
+    "index_slice": lambda a, b: a[1:, :],
+    "concat": lambda a, b: xp.concat([a, b], axis=0),
+    "stack": lambda a, b: xp.stack([a, b], axis=0),
+    "reshape": lambda a, b: xp.reshape(a, (a.shape[0] * a.shape[1],)),
+}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("op_name", sorted(OPS))
+def test_op_within_projected_mem(op_name, tmp_path):
+    op = OPS[op_name]
+
+    def build(spec, shape, chunks):
+        an = np.ones(shape)
+        a = ct.from_array(an, chunks=chunks, spec=spec)
+        b = ct.from_array(an, chunks=chunks, spec=spec)
+        return op(a, b)
+
+    projected, out = run_tight(build, tmp_path, shape=(500, 500), chunks=(100, 100))
+    assert out is not None
+
+
+def test_elemwise_projected_formula(tmp_path):
+    # projected for a binary elemwise must cover 2 inputs + 1 output, doubled
+    spec = Spec(work_dir=str(tmp_path), allowed_mem="1GB", reserved_mem=0)
+    a = xp.ones((100, 100), chunks=(50, 50), spec=spec)
+    b = xp.ones((100, 100), chunks=(50, 50), spec=spec)
+    c = xp.add(a, b)
+    chunk_bytes = 50 * 50 * 8
+    assert c.plan.max_projected_mem(optimize_graph=False) >= 6 * chunk_bytes
+
+
+@pytest.mark.slow
+def test_rechunk_within_projected(tmp_path):
+    def build(spec, shape, chunks):
+        an = np.ones(shape)
+        a = ct.from_array(an, chunks=chunks, spec=spec)
+        return a.rechunk((shape[0], chunks[1] // 2))
+
+    projected, out = run_tight(build, tmp_path, shape=(500, 500), chunks=(100, 100))
+    np.testing.assert_allclose(out, np.ones((500, 500)))
