@@ -82,6 +82,18 @@ class Array(CoreArray):
 
     # -- attributes --------------------------------------------------------
 
+    def __array_namespace__(self, *, api_version=None):
+        if api_version is not None and api_version not in ("2021.12", "2022.12"):
+            raise ValueError(f"Unrecognized array API version: {api_version!r}")
+        import cubed_tpu.array_api
+
+        return cubed_tpu.array_api
+
+    def to_device(self, device, /, *, stream=None):
+        if stream is not None:
+            raise ValueError("stream is not supported")
+        return self
+
     @property
     def device(self):
         from .device import device as _device
