@@ -30,7 +30,10 @@ from .core.gufunc import apply_gufunc  # noqa: F401
 from .nan_functions import nanmean, nansum  # noqa: F401
 
 from . import array_api  # noqa: F401
+from .array_api import Array  # noqa: F401  (reference: cubed/__init__.py)
 from . import random  # noqa: F401
+
+__version__ = "0.1.0"
 
 __all__ = [
     "Spec",

@@ -45,13 +45,16 @@ def arange(
     chunks = normalize_chunks(chunks, (num,), dtype=dtype)
     chunksize = chunks[0][0] if chunks[0] else 1
 
-    def _arange_chunk(chunk, block_id=None):
-        bstart = start + block_id[0] * chunksize * step
+    def _arange_chunk(chunk, block_id=None, offset=None, numblocks=None):
+        # offset path: block index arrives as device data (trace/vmap-safe)
+        b0 = nxp.asarray(offset).ravel()[0] if offset is not None else block_id[0]
+        bstart = start + b0 * chunksize * step
         blen = chunk.shape[0]
         return nxp.asarray(
             bstart + step * nxp.arange(blen), dtype=dtype
         )
 
+    _arange_chunk.supports_offset = True
     return map_blocks(
         _arange_chunk,
         empty((num,), dtype=dtype, chunks=chunks, spec=spec),
@@ -157,13 +160,15 @@ def linspace(
     chunks = normalize_chunks(chunks, (num,), dtype=dtype)
     chunksize = chunks[0][0] if chunks[0] else 1
 
-    def _linspace_chunk(chunk, block_id=None):
-        bstart = start + block_id[0] * chunksize * step
+    def _linspace_chunk(chunk, block_id=None, offset=None, numblocks=None):
+        b0 = nxp.asarray(offset).ravel()[0] if offset is not None else block_id[0]
+        bstart = start + b0 * chunksize * step
         blen = chunk.shape[0]
         return nxp.asarray(
             bstart + step * nxp.arange(blen), dtype=dtype
         )
 
+    _linspace_chunk.supports_offset = True
     return map_blocks(
         _linspace_chunk,
         empty((num,), dtype=dtype, chunks=chunks, spec=spec),

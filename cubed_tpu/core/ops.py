@@ -102,6 +102,7 @@ def from_array(x, chunks="auto", asarray=None, spec=None) -> "CoreArray":
         return numpy_array_to_backend_array(x[sel])
 
     _from_array_chunk.__name__ = "from_array"
+    _from_array_chunk.host_data_nbytes = x.nbytes
     return map_blocks(
         _from_array_chunk,
         empty_virtual_array(x.shape, dtype=x.dtype, chunks=outchunks, spec=spec),
@@ -402,7 +403,15 @@ def map_blocks(
             return func(*real, block_id=block_id, **kw)
 
         func_with_block_id.__name__ = getattr(func, "__name__", "map_blocks")
-        for attr in ("side_inputs", "whole_select", "resident_identity"):
+        if supports_offset:
+            # kernel unravels the offset on device: trace/vmap-safe
+            func_with_block_id.traced_offsets = True
+        if not supports_offset:
+            # the offset->block_id conversion syncs to host: the executor must
+            # not hand this kernel traced offsets (no vmap, no jit of offsets)
+            func_with_block_id.host_block_id = True
+        for attr in ("side_inputs", "whole_select", "resident_identity",
+                     "host_data_nbytes"):
             if hasattr(func, attr):
                 setattr(func_with_block_id, attr, getattr(func, attr))
         blockwise_args.extend([offsets, tuple(range(in_ndim))])

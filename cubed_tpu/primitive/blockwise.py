@@ -456,12 +456,31 @@ def fuse_multiple(
             for entry, pspec in zip(structure, pred_specs)
         )
 
-    def fused_function(*args):
+    def fused_function(*args, **kw):
         evaluated = [
             arg if pf is None else _evaluate(arg, pf)
             for arg, pf in zip(args, pred_functions)
         ]
-        return spec.function(*evaluated)
+        return spec.function(*evaluated, **kw)
+
+    # executor routing hints survive fusion: a fused kernel is host-bound if
+    # any component is. Every offsets-reading kernel carries either
+    # host_block_id or traced_offsets, so "some component reads offsets
+    # traced and none reads them on the host" means all offsets reads in the
+    # fused body are trace-safe.
+    components = [spec.function] + [pf for pf in pred_functions if pf is not None]
+    fused_function.host_block_id = any(
+        getattr(f, "host_block_id", False) for f in components
+    )
+    fused_function.host_data_nbytes = sum(
+        getattr(f, "host_data_nbytes", 0) for f in components
+    )
+    fused_function.traced_offsets = (
+        any(getattr(f, "traced_offsets", False) for f in components)
+        and not fused_function.host_block_id
+    )
+    if getattr(spec.function, "needs_block_id", False):
+        fused_function.needs_block_id = True
 
     # reads: union of unfused own reads and all fused predecessors' reads
     fused_outputs = {id(p.target_array) for p in preds if p is not None}

@@ -68,6 +68,8 @@ def random(size, *, diagnostics=None, chunks=None, spec=None):
 def _random_block(chunk, seeded_offset):
     """One random block; ``seeded_offset`` is data, so the HLO has no
     per-plan constants."""
+    # (attribute set below: the kernel accepts a traced offset, letting the
+    # fused-plan tracer hoist the seed to a program input)
     if BACKEND == "jax":
         import jax
 
@@ -77,3 +79,6 @@ def _random_block(chunk, seeded_offset):
     off = int(np.asarray(seeded_offset).ravel()[0])
     rng = np.random.Generator(np.random.Philox(seed=off))
     return rng.random(chunk.shape, dtype=np.float64)
+
+
+_random_block.traced_offsets = True

@@ -11,10 +11,14 @@ def create_executor(name: str, executor_options: Optional[dict] = None):
         from .executors.python import PythonDagExecutor
 
         return PythonDagExecutor(**executor_options)
-    if name in ("threads", "processes", "async-python"):
+    if name in ("threads", "async-python"):
         from .executors.python_async import AsyncPythonDagExecutor
 
         return AsyncPythonDagExecutor(**executor_options)
+    if name == "processes":
+        from .executors.multiprocess import MultiprocessDagExecutor
+
+        return MultiprocessDagExecutor(**executor_options)
     if name in ("jax", "tpu", "jax-tpu"):
         from .executors.jax import JaxExecutor
 

@@ -38,19 +38,36 @@ def map_unordered(
     batch_size: Optional[int] = None,
     callbacks=None,
     array_name: Optional[str] = None,
+    array_names: Optional[list] = None,
     **kwargs,
 ) -> None:
-    """Run function over inputs, handling completion order, retries, backups."""
+    """Run function over inputs, handling completion order, retries, backups.
+
+    ``array_names`` (parallel to inputs) attributes each task's end event to
+    its own op when tasks of several ops are interleaved in one map.
+    """
+    inputs = list(inputs)
+    if array_names is not None:
+        assert len(array_names) == len(inputs)
     if batch_size is None:
         _map_unordered_batch(
-            executor, function, list(inputs), retries, use_backups,
-            callbacks, array_name, **kwargs,
+            executor, function, inputs, retries, use_backups,
+            callbacks, array_name, array_names, **kwargs,
         )
     else:
-        for batch in batched(inputs, batch_size):
+        for start in range(0, len(inputs), batch_size):
             _map_unordered_batch(
-                executor, function, batch, retries, use_backups,
-                callbacks, array_name, **kwargs,
+                executor,
+                function,
+                inputs[start : start + batch_size],
+                retries,
+                use_backups,
+                callbacks,
+                array_name,
+                array_names[start : start + batch_size]
+                if array_names is not None
+                else None,
+                **kwargs,
             )
 
 
@@ -62,6 +79,7 @@ def _map_unordered_batch(
     use_backups: bool,
     callbacks,
     array_name,
+    array_names: Optional[list] = None,
     **kwargs,
 ) -> None:
     attempts: Dict[int, int] = {i: 0 for i in range(len(inputs))}
@@ -119,7 +137,11 @@ def _map_unordered_batch(
                     del pending[f]
             handle_callbacks(
                 callbacks,
-                dict(stats, array_name=array_name, task_create_tstamp=create_times[i]),
+                dict(
+                    stats,
+                    array_name=array_names[i] if array_names is not None else array_name,
+                    task_create_tstamp=create_times[i],
+                ),
             )
         if use_backups:
             for fut, (i, is_backup) in list(pending.items()):
@@ -232,5 +254,5 @@ class AsyncPythonDagExecutor(DagExecutor):
             use_backups=use_backups,
             batch_size=batch_size,
             callbacks=callbacks,
-            array_name=names[0] if names else None,
+            array_names=names,
         )

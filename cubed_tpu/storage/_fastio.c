@@ -1,0 +1,92 @@
+/* Parallel chunk-file reader for the Zarr v2 storage layer.
+ *
+ * The TPU executor's residency preload reads every chunk file of an array
+ * before a fused program runs; Python-side reads serialize on the GIL and
+ * on per-file syscall latency. This tiny pthread pool reads N files into
+ * caller-provided buffers concurrently, GIL-free (called via ctypes).
+ *
+ * Role parity: the reference delegates parallel chunk IO to the cloud
+ * runtime's concurrent workers (fsspec/S3, cubed/runtime/executors/*); on a
+ * single host feeding one chip, the analogous concurrency lives here.
+ *
+ * Per-file status: 0 = ok, 1 = missing (ENOENT: caller substitutes the
+ * fill value), 2 = IO error / short read. Returns the count of status-2
+ * files.
+ */
+
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdatomic.h>
+#include <string.h>
+#include <unistd.h>
+
+typedef struct {
+    const char **paths;
+    char **dsts;
+    const long *sizes;
+    int *status;
+    int n;
+    atomic_int next;
+} pool_t;
+
+static void read_one(pool_t *p, int i) {
+    int fd = open(p->paths[i], O_RDONLY);
+    if (fd < 0) {
+        p->status[i] = (errno == ENOENT) ? 1 : 2;
+        return;
+    }
+    long off = 0;
+    long want = p->sizes[i];
+    char *dst = p->dsts[i];
+    while (off < want) {
+        ssize_t got = read(fd, dst + off, (size_t)(want - off));
+        if (got <= 0) {
+            close(fd);
+            p->status[i] = 2;
+            return;
+        }
+        off += got;
+    }
+    close(fd);
+    p->status[i] = 0;
+}
+
+static void *worker(void *arg) {
+    pool_t *p = (pool_t *)arg;
+    for (;;) {
+        int i = atomic_fetch_add(&p->next, 1);
+        if (i >= p->n)
+            return NULL;
+        read_one(p, i);
+    }
+}
+
+int fastio_read_files(const char **paths, char **dsts, const long *sizes,
+                      int *status, int n, int nthreads) {
+    pool_t p = {paths, dsts, sizes, status, n, 0};
+    atomic_store(&p.next, 0);
+    if (nthreads < 1)
+        nthreads = 1;
+    if (nthreads > n)
+        nthreads = n;
+    if (nthreads > 64)
+        nthreads = 64;
+
+    pthread_t tids[64];
+    int spawned = 0;
+    for (int t = 0; t < nthreads - 1; t++) {
+        if (pthread_create(&tids[spawned], NULL, worker, &p) == 0)
+            spawned++;
+    }
+    worker(&p); /* this thread participates */
+    for (int t = 0; t < spawned; t++)
+        pthread_join(tids[t], NULL);
+
+    int errs = 0;
+    for (int i = 0; i < n; i++)
+        if (status[i] == 2)
+            errs++;
+    return errs;
+}

@@ -1,0 +1,133 @@
+"""Multiprocess executor: process-boundary payloads, retries, fault injection.
+
+Mirrors the reference's per-executor runtime tests
+(cubed/tests/runtime/test_python_async.py:43-102) for the process-pool
+executor: success, deterministic failure with exact retry counts, and
+end-to-end plans whose (function, input, config) payloads must survive
+cloudpickle across a spawn boundary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.runtime.executors.multiprocess import MultiprocessDagExecutor
+
+from ..utils import TaskCounter
+from .utils import check_invocation_counts, deterministic_failure
+
+
+@pytest.fixture()
+def spec(tmp_path):
+    return ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+
+
+def test_multiprocess_end_to_end(spec):
+    an = np.arange(100, dtype=np.float64).reshape(10, 10)
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    b = ct.from_array(an, chunks=(4, 4), spec=spec)
+    counter = TaskCounter()
+    result = xp.sum(xp.add(a, b)).compute(
+        executor=MultiprocessDagExecutor(max_workers=2), callbacks=[counter]
+    )
+    assert np.allclose(float(result), (an + an).sum())
+    assert counter.value > 0
+
+
+def test_multiprocess_fused_kernels(spec):
+    # fused closures (optimizer output) are the hardest payloads to ship
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    r = xp.mean(xp.add(xp.multiply(a, 2.0), a))
+    result = r.compute(executor=MultiprocessDagExecutor(max_workers=2))
+    assert np.allclose(float(result), (an * 2.0 + an).mean())
+
+
+def test_multiprocess_retries_success(tmp_path):
+    # one failure then success: task must be retried in a fresh process
+    path = tmp_path / "counts"
+    path.mkdir()
+    timing_map = {0: [-1]}  # input 0: fail once, then succeed
+    ex = MultiprocessDagExecutor(max_workers=2, retries=2)
+    _run_fault_injected(ex, str(path), timing_map, n_tasks=2)
+    check_invocation_counts(str(path), timing_map, n_tasks=2, retries=2)
+
+
+def test_multiprocess_retries_exhausted(tmp_path):
+    path = tmp_path / "counts"
+    path.mkdir()
+    timing_map = {0: [-1, -1, -1]}  # more failures than allowed attempts
+    ex = MultiprocessDagExecutor(max_workers=2, retries=2)
+    with pytest.raises(RuntimeError):
+        _run_fault_injected(ex, str(path), timing_map, n_tasks=2)
+
+
+def _run_fault_injected(ex, path, timing_map, n_tasks):
+    """Drive map_unordered through the process pool with the shared
+    fault-injection task (persists invocation counts in files, so it works
+    across processes — reference cubed/tests/runtime/utils.py:20-59)."""
+    import concurrent.futures
+    import multiprocessing
+
+    from cubed_tpu.runtime.executors.python_async import map_unordered
+
+    ctx = multiprocessing.get_context("spawn")
+    with concurrent.futures.ProcessPoolExecutor(
+        max_workers=ex.max_workers, mp_context=ctx
+    ) as pool:
+        map_unordered(
+            pool,
+            _FaultTask(path, timing_map),
+            list(range(n_tasks)),
+            retries=ex.retries,
+        )
+
+
+class _FaultTask:
+    """Picklable wrapper around the shared deterministic_failure task."""
+
+    def __init__(self, path, timing_map):
+        self.path = path
+        self.timing_map = timing_map
+
+    def __call__(self, i):
+        return deterministic_failure(self.path, self.timing_map, i)
+
+
+class _DieOnce:
+    """Kill the worker process hard on the first invocation (simulated
+    OOM-kill); subsequent invocations — in a rebuilt pool — succeed. The
+    marker file records that the crash happened, surviving the dead process."""
+
+    def __init__(self, marker):
+        self.marker = marker
+
+    def __call__(self, i):
+        import os
+
+        if i == 0 and not os.path.exists(self.marker):
+            open(self.marker, "w").close()
+            os._exit(1)  # hard kill: breaks the ProcessPoolExecutor
+        return i
+
+
+def test_multiprocess_survives_worker_death(tmp_path):
+    ex = MultiprocessDagExecutor(max_workers=1, retries=2)
+    import concurrent.futures
+    import multiprocessing
+
+    marker = str(tmp_path / "died")
+    ctx = multiprocessing.get_context("spawn")
+    pool = concurrent.futures.ProcessPoolExecutor(max_workers=1, mp_context=ctx)
+    try:
+        pool = ex._map_surviving_pool_crash(
+            pool, ctx, _DieOnce(marker), [0, 1], retries=2
+        )
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+    import os
+
+    assert os.path.exists(marker)  # the crash really happened and was survived
