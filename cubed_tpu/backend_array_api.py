@@ -29,7 +29,12 @@ if BACKEND == "jax":
     # Every plan builds fresh kernel closures, which defeats jax's in-process
     # jit cache; the persistent (HLO-keyed) compilation cache makes repeat
     # compiles of structurally identical kernels ~100x cheaper.
-    if os.environ.get("CUBED_TPU_COMPILATION_CACHE", "1") == "1":
+    # CPU-only runs (tests) skip it: XLA:CPU AOT entries bake host machine
+    # features, so a cache written on one machine can SIGILL on another.
+    if (
+        os.environ.get("CUBED_TPU_COMPILATION_CACHE", "1") == "1"
+        and os.environ.get("JAX_PLATFORMS", "").lower() != "cpu"
+    ):
         cache_dir = os.environ.get(
             "CUBED_TPU_COMPILATION_CACHE_DIR",
             os.path.expanduser("~/.cache/cubed_tpu_xla"),

@@ -569,17 +569,29 @@ def index(x: CoreArray, key) -> CoreArray:
     if not isinstance(key, tuple):
         key = (key,)
 
-    # replace None (newaxis) markers: handle by expand_dims at the end
-    newaxis_positions = [i for i, k in enumerate(key) if k is None]
-    key = tuple(k for k in key if k is not None)
-
-    if Ellipsis in key:
-        i = key.index(Ellipsis)
-        fill = x.ndim - (len(key) - 1)
-        key = key[:i] + (slice(None),) * fill + key[i + 1 :]
-    key = key + (slice(None),) * (x.ndim - len(key))
-    if len(key) > x.ndim:
+    # expand Ellipsis first; None (newaxis) entries consume no input axis
+    n_consuming = sum(1 for k in key if k is not None and k is not Ellipsis)
+    if n_consuming > x.ndim:
         raise IndexError(f"too many indices for array with {x.ndim} dimensions")
+    if Ellipsis in key:
+        if sum(1 for k in key if k is Ellipsis) > 1:
+            raise IndexError("an index can only have a single ellipsis ('...')")
+        i = key.index(Ellipsis)
+        fill = x.ndim - n_consuming
+        key = key[:i] + (slice(None),) * fill + key[i + 1 :]
+    key = key + (slice(None),) * (x.ndim - sum(1 for k in key if k is not None))
+
+    # newaxis insert positions in OUTPUT coordinates: slices/arrays keep an
+    # axis, ints drop theirs, each None inserts one (applied after squeeze)
+    newaxis_positions = []
+    _out_pos = 0
+    for k in key:
+        if k is None:
+            newaxis_positions.append(_out_pos)
+            _out_pos += 1
+        elif not isinstance(k, (int, np.integer)):
+            _out_pos += 1
+    key = tuple(k for k in key if k is not None)
 
     # eagerly compute any lazy-array indices (reference ops.py:391-395)
     norm_key = []
@@ -694,7 +706,12 @@ class _IndexRead:
             stop = start + chunks_ax[bid]
             if isinstance(s, tuple):  # resolved slice (start, stop, step)
                 s0, s1, st = s
-                sel.append(slice(s0 + start * st, s0 + stop * st, st))
+                hi = s0 + stop * st
+                if st < 0 and hi < 0:
+                    # a computed stop of -1 means "walked past index 0";
+                    # as a literal slice bound it would wrap to the end
+                    hi = None
+                sel.append(slice(s0 + start * st, hi, st))
             else:
                 sel.append(s[start:stop])
         out = zarray.oindex[tuple(sel)]

@@ -72,6 +72,9 @@ def _ring_attention_local(
     m0 = jnp.full((B, H, S), -jnp.inf, dtype=jnp.float32)
     l0 = jnp.zeros((B, H, S), dtype=jnp.float32)
     o0 = jnp.zeros((B, H, S, D), dtype=jnp.float32)
+    # the loop body's outputs are device-varying (they mix in axis_index and
+    # ppermute'd blocks); the initial carry must carry the same vma type
+    o0, l0, m0 = (lax.pcast(x, (axis_name,), to="varying") for x in (o0, l0, m0))
 
     q_pos = idx * S + jnp.arange(S)  # global positions of this device's queries
 

@@ -581,7 +581,12 @@ class JaxExecutor(DagExecutor):
             v = value
             for ax, s in enumerate(selections):
                 if isinstance(s, tuple):  # resolved slice (start, stop, step)
-                    sel = (slice(None),) * ax + (slice(*s),)
+                    s0, s1, st = s
+                    if st < 0 and s1 < 0:
+                        # .indices() reports "walked past index 0" as stop=-1,
+                        # which a literal slice bound would wrap to the end
+                        s1 = None
+                    sel = (slice(None),) * ax + (slice(s0, s1, st),)
                     v = (
                         {k: vv[sel] for k, vv in v.items()}
                         if isinstance(v, dict)

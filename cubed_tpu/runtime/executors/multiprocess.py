@@ -24,6 +24,7 @@ Semantics exercised here that in-process executors can't:
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
 import logging
 import os
 from typing import Optional
@@ -33,6 +34,37 @@ from ..types import DagExecutor, OperationStartEvent, callbacks_on
 from .python_async import DEFAULT_RETRIES, map_unordered
 
 logger = logging.getLogger(__name__)
+
+#: env vars that make an interpreter-startup site hook register a hardware
+#: PJRT plugin (and dial the device tunnel) in every spawned interpreter
+_PLUGIN_ENV_VARS = ("PALLAS_AXON_POOL_IPS",)
+
+
+@contextlib.contextmanager
+def _worker_safe_env():
+    """Scrub plugin-registration env vars while worker processes spawn.
+
+    Workers do chunk IO + CPU compute only — device execution lives in the
+    parent's JaxExecutor. A spawned worker re-runs the interpreter's site
+    hooks, which on TPU hosts register the device plugin and block on tunnel
+    health; stripping the gating vars (and pinning workers to the CPU jax
+    platform) keeps worker startup hermetic. Restored on exit so the parent
+    process's own device access is unaffected.
+    """
+    saved: dict = {}
+    for k in _PLUGIN_ENV_VARS:
+        if k in os.environ:
+            saved[k] = os.environ.pop(k)
+    prev_platform = os.environ.get("JAX_PLATFORMS")
+    if prev_platform is not None and prev_platform.lower() != "cpu":
+        saved["JAX_PLATFORMS"] = prev_platform
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        yield
+    finally:
+        if prev_platform is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        os.environ.update(saved)
 
 
 class _ProcessTaskRunner:
@@ -106,6 +138,8 @@ class MultiprocessDagExecutor(DagExecutor):
         import multiprocessing
 
         ctx = multiprocessing.get_context("spawn")
+        stack = contextlib.ExitStack()
+        stack.enter_context(_worker_safe_env())
         pool = concurrent.futures.ProcessPoolExecutor(
             max_workers=self.max_workers, mp_context=ctx
         )
@@ -162,6 +196,7 @@ class MultiprocessDagExecutor(DagExecutor):
                     )
         finally:
             pool.shutdown(wait=False, cancel_futures=True)
+            stack.close()
 
     def _map_surviving_pool_crash(
         self, pool, ctx, fn, inputs, *, retries, **map_kwargs
@@ -172,6 +207,11 @@ class MultiprocessDagExecutor(DagExecutor):
         ProcessPoolExecutor; every op task is an idempotent whole-chunk
         write, so the whole op is safely re-run on a fresh pool. Returns the
         (possibly new) pool for subsequent ops.
+
+        Note: a re-run fires ``on_task_end`` again for tasks that completed
+        before the crash, so progress/history counters can exceed num_tasks
+        across pool-crash retries — the same at-least-once event semantics a
+        cloud executor's speculative backups have.
         """
         from concurrent.futures.process import BrokenProcessPool
 
@@ -181,11 +221,11 @@ class MultiprocessDagExecutor(DagExecutor):
                 return pool
             except BrokenProcessPool:
                 pool.shutdown(wait=False, cancel_futures=True)
+                if attempt == retries:
+                    raise  # caller's finally shuts down this (dead) pool
                 pool = concurrent.futures.ProcessPoolExecutor(
                     max_workers=self.max_workers, mp_context=ctx
                 )
-                if attempt == retries:
-                    raise
                 logger.warning(
                     "worker process died; rebuilt pool, re-running op "
                     "(attempt %d/%d)", attempt + 2, retries + 1,

@@ -1,29 +1,39 @@
 """Test configuration: run JAX on a virtual 8-device CPU mesh so sharding
 paths are exercised without TPU hardware; real-TPU benchmarks live in
-bench.py, not the test suite."""
+bench.py, not the test suite.
+
+A TPU PJRT plugin may be force-registered by an interpreter-startup site
+hook; once registered, backend init dials the device tunnel even under
+``JAX_PLATFORMS=cpu`` and hangs if the tunnel is unhealthy. So before any
+backend initializes we deregister every non-CPU backend factory and pin
+jax to the (virtual, 8-way) CPU platform."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# any subprocess a test spawns must not re-register the TPU plugin either
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax
+
+try:  # deregister the tunnel-backed plugin entirely: cpu-only, tunnel-free
+    # ('tpu' stays registered but uninitialized — Pallas interpret-mode needs
+    # it as a *known platform* for lowering-rule registration)
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
 
 import tempfile
 
 import pytest
-
-# the axon TPU plugin ignores JAX_PLATFORMS; pin the default device to the
-# (virtual, 8-way) CPU backend so tests never touch the real chip
-try:
-    import jax
-
-    _cpu = jax.devices("cpu")
-    jax.config.update("jax_default_device", _cpu[0])
-except Exception:
-    pass
 
 
 @pytest.fixture

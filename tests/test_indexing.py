@@ -1,0 +1,170 @@
+"""Indexing edge cases: slices with steps, negative steps, integer and
+integer-array (orthogonal) indexing, newaxis/ellipsis, and compositions.
+
+Reference scope: cubed/tests/test_indexing.py (int-array indexing) plus the
+slice/step matrix the reference covers in test_array_object.py; the
+negative-step cases are regressions for the resolved-stop wraparound bug
+(stop=-1 reinterpreted as "end of array").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from tests.utils import all_executors
+
+
+@pytest.fixture(params=all_executors(), ids=lambda e: e.name)
+def executor(request):
+    return request.param
+
+
+DN = np.arange(37.0)
+EN = np.arange(60.0).reshape(6, 10)
+
+
+@pytest.mark.parametrize(
+    "key",
+    [
+        slice(None, None, -1),
+        slice(None, None, -2),
+        slice(30, 2, -3),
+        slice(5, 25, 4),
+        slice(36, None, -1),
+        slice(None, 0, -1),
+        slice(3, None),
+        slice(None, -4),
+        slice(-10, -2),
+        slice(-2, -10, -1),
+    ],
+)
+def test_slice_steps_1d(spec, executor, key):
+    a = ct.from_array(DN, chunks=(10,), spec=spec)
+    expected = DN[key]
+    got = np.asarray(a[key].compute(executor=executor))
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected)
+
+
+@pytest.mark.parametrize(
+    "key",
+    [
+        (slice(None, None, -1), slice(None, None, -2)),
+        (slice(None, None, -1), slice(2, None)),
+        (slice(4, 0, -2), slice(None, None, 3)),
+        (slice(None, None, -1), 3),
+        (2, slice(None, None, -1)),
+    ],
+)
+def test_slice_steps_2d(spec, executor, key):
+    a = ct.from_array(EN, chunks=(2, 4), spec=spec)
+    expected = EN[key]
+    got = np.asarray(a[key].compute(executor=executor))
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected)
+
+
+def test_composed_negative_then_slice(spec, executor):
+    a = ct.from_array(DN, chunks=(10,), spec=spec)
+    expected = DN[::-1][3:]
+    got = np.asarray(a[::-1][3:].compute(executor=executor))
+    np.testing.assert_allclose(got, expected)
+
+
+@pytest.mark.parametrize("ind", [[1, 5, 10], [10, 5, 1], [1, 1, 5], [-1, -5]])
+def test_int_array_index_1d(spec, executor, ind):
+    a = ct.from_array(DN, chunks=(10,), spec=spec)
+    expected = DN[ind]
+    got = np.asarray(a[ind].compute(executor=executor))
+    np.testing.assert_allclose(got, expected)
+
+
+@pytest.mark.parametrize(
+    "ind", [[0, 3, 5], [5, 3, 0], [-1, 2]]
+)
+def test_int_array_index_2d(spec, executor, ind):
+    a = ct.from_array(EN, chunks=(2, 4), spec=spec)
+    np.testing.assert_allclose(
+        np.asarray(a[ind, :].compute(executor=executor)), EN[ind, :]
+    )
+    np.testing.assert_allclose(
+        np.asarray(a[:, ind].compute(executor=executor)), EN[:, ind]
+    )
+
+
+def test_multiple_int_array_indexes_rejected(spec):
+    a = ct.from_array(EN, chunks=(2, 4), spec=spec)
+    with pytest.raises((NotImplementedError, IndexError)):
+        a[[0, 1], [1, 2]]
+
+
+def test_int_index_drops_axis(spec, executor):
+    a = ct.from_array(EN, chunks=(2, 4), spec=spec)
+    got = a[3]
+    assert got.shape == (10,)
+    np.testing.assert_allclose(np.asarray(got.compute(executor=executor)), EN[3])
+    got2 = a[-1, -1]
+    assert got2.shape == ()
+    assert float(got2.compute(executor=executor)) == EN[-1, -1]
+
+
+@pytest.mark.parametrize(
+    "key",
+    [
+        (None, Ellipsis, 2),
+        (Ellipsis, None),
+        (3, None),
+        (None,),
+        (slice(1, 4), None, 2),
+        (2, Ellipsis, None, 3),
+    ],
+)
+def test_newaxis_and_ellipsis(spec, executor, key):
+    a = ct.from_array(EN, chunks=(2, 4), spec=spec)
+    expected = EN[key]
+    got = a[key]
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(
+        np.asarray(got.compute(executor=executor)), expected
+    )
+
+
+def test_double_ellipsis_rejected(spec):
+    a = ct.from_array(EN, chunks=(2, 4), spec=spec)
+    with pytest.raises(IndexError):
+        a[..., ...]
+
+
+def test_out_of_bounds_raises(spec):
+    a = ct.from_array(DN, chunks=(10,), spec=spec)
+    with pytest.raises(IndexError):
+        a[37]
+    with pytest.raises(IndexError):
+        a[-38]
+    with pytest.raises(IndexError):
+        a[0, 0]
+
+
+def test_empty_selection(spec, executor):
+    a = ct.from_array(DN, chunks=(10,), spec=spec)
+    got = a[5:5]
+    assert got.shape == (0,)
+    assert np.asarray(got.compute(executor=executor)).shape == (0,)
+
+
+def test_lazy_array_as_index(spec, executor):
+    a = ct.from_array(DN, chunks=(10,), spec=spec)
+    idx = ct.from_array(np.array([2, 4, 8]), chunks=(3,), spec=spec)
+    np.testing.assert_allclose(
+        np.asarray(a[idx].compute(executor=executor)), DN[[2, 4, 8]]
+    )
+
+
+def test_index_then_reduce(spec, executor):
+    # indexing composed with downstream ops (the vorticity pattern a[1:])
+    a = ct.from_array(EN, chunks=(2, 4), spec=spec)
+    got = float(xp.sum(a[1:]).compute(executor=executor))
+    assert np.isclose(got, EN[1:].sum())
