@@ -573,10 +573,12 @@ def index(x: CoreArray, key) -> CoreArray:
     n_consuming = sum(1 for k in key if k is not None and k is not Ellipsis)
     if n_consuming > x.ndim:
         raise IndexError(f"too many indices for array with {x.ndim} dimensions")
-    if Ellipsis in key:
-        if sum(1 for k in key if k is Ellipsis) > 1:
-            raise IndexError("an index can only have a single ellipsis ('...')")
-        i = key.index(Ellipsis)
+    # note: `Ellipsis in key` would compare numpy-array entries elementwise
+    n_ellipsis = sum(1 for k in key if k is Ellipsis)
+    if n_ellipsis > 1:
+        raise IndexError("an index can only have a single ellipsis ('...')")
+    if n_ellipsis:
+        i = next(i for i, k in enumerate(key) if k is Ellipsis)
         fill = x.ndim - n_consuming
         key = key[:i] + (slice(None),) * fill + key[i + 1 :]
     key = key + (slice(None),) * (x.ndim - sum(1 for k in key if k is not None))

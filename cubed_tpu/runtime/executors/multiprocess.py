@@ -35,9 +35,12 @@ from .python_async import DEFAULT_RETRIES, map_unordered
 
 logger = logging.getLogger(__name__)
 
-#: env vars that make an interpreter-startup site hook register a hardware
-#: PJRT plugin (and dial the device tunnel) in every spawned interpreter
-_PLUGIN_ENV_VARS = ("PALLAS_AXON_POOL_IPS",)
+#: env-var prefixes that make an interpreter-startup site hook register a
+#: hardware PJRT plugin (and dial the device tunnel) in every spawned
+#: interpreter. Keep in sync with __graft_entry__._PLUGIN_ENV_PREFIXES and
+#: tests/conftest.py (import-order constraints prevent a shared module:
+#: conftest must scrub before importing anything that pulls in jax).
+_PLUGIN_ENV_PREFIXES = ("PALLAS_AXON", "AXON_", "TPU_")
 
 
 @contextlib.contextmanager
@@ -52,9 +55,8 @@ def _worker_safe_env():
     process's own device access is unaffected.
     """
     saved: dict = {}
-    for k in _PLUGIN_ENV_VARS:
-        if k in os.environ:
-            saved[k] = os.environ.pop(k)
+    for k in [k for k in os.environ if k.startswith(_PLUGIN_ENV_PREFIXES)]:
+        saved[k] = os.environ.pop(k)
     prev_platform = os.environ.get("JAX_PLATFORMS")
     if prev_platform is not None and prev_platform.lower() != "cpu":
         saved["JAX_PLATFORMS"] = prev_platform

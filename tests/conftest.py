@@ -17,7 +17,9 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 # any subprocess a test spawns must not re-register the TPU plugin either
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# (prefix set kept in sync with __graft_entry__ and executors/multiprocess)
+for _k in [k for k in os.environ if k.startswith(("PALLAS_AXON", "AXON_", "TPU_"))]:
+    os.environ.pop(_k, None)
 
 import jax
 

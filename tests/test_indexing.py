@@ -74,7 +74,10 @@ def test_composed_negative_then_slice(spec, executor):
     np.testing.assert_allclose(got, expected)
 
 
-@pytest.mark.parametrize("ind", [[1, 5, 10], [10, 5, 1], [1, 1, 5], [-1, -5]])
+@pytest.mark.parametrize(
+    "ind",
+    [[1, 5, 10], [10, 5, 1], [1, 1, 5], [-1, -5], np.array([1, 5, 10])],
+)
 def test_int_array_index_1d(spec, executor, ind):
     a = ct.from_array(DN, chunks=(10,), spec=spec)
     expected = DN[ind]
