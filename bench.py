@@ -5,13 +5,17 @@ index + tree reduction. Run at (500,450,400) f64, chunks=100 (the notebook's
 (1000,900,800) exceeds one chip's HBM; the driver's mesh dryrun covers the
 sharded path).
 
-Compares the JaxExecutor on the real TPU chip against the single-process
-numpy-backend PythonDagExecutor (the reference's baseline executor semantics)
-running the identical plan in a subprocess.
+Driver-survivable by construction: the parent process never imports jax and
+never touches the device tunnel; each phase runs in a subprocess with its own
+timeout, and ONE JSON line is always printed before the overall deadline.
 
-Prints ONE JSON line: value = array data processed per second on the TPU path
-(4 generated arrays + 2 sliced operands), vs_baseline = speedup over the
-numpy executor.
+- The numpy baseline (reference's single-process PythonDagExecutor
+  semantics) is measured once and recorded in ``BASELINE_RECORDED.json``
+  (committed); it is only re-measured if the record is absent.
+- The TPU phase runs with the inherited (device) environment. If it fails
+  or times out, the framework is re-measured on the virtual CPU backend in a
+  tunnel-free subprocess and reported with an explicit ``cpu_fallback``
+  metric name — degraded, never silent.
 """
 
 from __future__ import annotations
@@ -20,8 +24,14 @@ import json
 import os
 import subprocess
 import sys
-import tempfile
 import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+RECORD_PATH = os.path.join(REPO, "BASELINE_RECORDED.json")
+
+OVERALL_DEADLINE_S = 540  # print the JSON line well inside 10 minutes
+BASELINE_TIMEOUT_S = 280
+TPU_TIMEOUT_S = 390
 
 SHAPE = (500, 450, 400)
 CHUNK = 100
@@ -29,9 +39,15 @@ _elems = SHAPE[0] * SHAPE[1] * SHAPE[2]
 #: bytes flowing through the pipeline: 4 generated arrays + 2 sliced reads
 WORK_BYTES = 6 * _elems * 8
 
+_T0 = time.monotonic()
+
+
+def _remaining(cap: float) -> float:
+    return max(10.0, min(cap, OVERALL_DEADLINE_S - (time.monotonic() - _T0)))
+
+
 WORKLOAD = r"""
 import json, sys, tempfile, time
-import numpy as np
 sys.path.insert(0, {repo!r})
 import cubed_tpu as ct
 import cubed_tpu.array_api as xp
@@ -39,6 +55,10 @@ import cubed_tpu.random
 
 spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="4GB")
 shape = {shape!r}
+executor = None
+if {use_jax_executor!r}:
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+    executor = JaxExecutor()
 
 def build():
     a = cubed_tpu.random.random(shape, chunks={chunk}, spec=spec)
@@ -47,72 +67,152 @@ def build():
     y = cubed_tpu.random.random(shape, chunks={chunk}, spec=spec)
     return xp.mean(xp.add(xp.multiply(a[1:], x[1:]), xp.multiply(b[1:], y[1:])))
 
+kw = dict(executor=executor) if executor is not None else {{}}
+if {warmup!r}:
+    # compile warmup (persistent cache + in-process caches)
+    w0 = time.perf_counter()
+    build().compute(**kw)
+    print("warmup done in", round(time.perf_counter() - w0, 2), "s",
+          file=sys.stderr, flush=True)
+
+s = build()
 t0 = time.perf_counter()
-val = build().compute()
+val = s.compute(**kw)
 t1 = time.perf_counter()
-print(json.dumps({{"elapsed": t1 - t0, "value": float(val)}}))
+# mean of u1*u2 + u3*u4 over uniforms is ~0.5
+assert 0.45 < float(val) < 0.55, float(val)
+print(json.dumps({{"elapsed": t1 - t0, "value": float(val)}}), flush=True)
 """
 
 
-def run_baseline() -> dict:
-    env = dict(os.environ, CUBED_TPU_BACKEND="numpy")
+def _scrubbed_cpu_env() -> dict:
+    """Tunnel-free env: no plugin-gating vars, jax pinned to 8 CPU devices."""
+    from __graft_entry__ import _scrubbed_cpu_env as scrub
+
+    return scrub(8)
+
+
+def _run_phase(
+    *, env: dict, timeout: float, use_jax_executor: bool, warmup: bool
+) -> dict:
     script = WORKLOAD.format(
-        repo=os.path.dirname(os.path.abspath(__file__)), shape=SHAPE, chunk=CHUNK
+        repo=REPO,
+        shape=SHAPE,
+        chunk=CHUNK,
+        use_jax_executor=use_jax_executor,
+        warmup=warmup,
     )
     out = subprocess.run(
-        [sys.executable, "-c", script], env=env, capture_output=True, text=True,
-        timeout=3000,
+        [sys.executable, "-c", script],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
     )
     if out.returncode != 0:
-        raise RuntimeError(f"baseline failed: {out.stderr[-2000:]}")
+        raise RuntimeError(f"phase failed (rc={out.returncode}): {out.stderr[-2000:]}")
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
-def run_tpu() -> dict:
-    import cubed_tpu as ct
-    import cubed_tpu.array_api as xp
-    import cubed_tpu.random
-    from cubed_tpu.runtime.executors.jax import JaxExecutor
-
-    spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="4GB")
-    executor = JaxExecutor()
-
-    def build():
-        a = cubed_tpu.random.random(SHAPE, chunks=CHUNK, spec=spec)
-        b = cubed_tpu.random.random(SHAPE, chunks=CHUNK, spec=spec)
-        x = cubed_tpu.random.random(SHAPE, chunks=CHUNK, spec=spec)
-        y = cubed_tpu.random.random(SHAPE, chunks=CHUNK, spec=spec)
-        return xp.mean(xp.add(xp.multiply(a[1:], x[1:]), xp.multiply(b[1:], y[1:])))
-
-    # warmup: compile kernels (persistent cache makes this cheap after round 1)
-    build().compute(executor=executor)
-
-    s = build()
-    t0 = time.perf_counter()
-    val = s.compute(executor=executor)
-    t1 = time.perf_counter()
-    # mean of u1*u2 + u3*u4 over uniforms is ~0.5
-    assert 0.45 < float(val) < 0.55, float(val)
-    return {"elapsed": t1 - t0, "value": float(val)}
+def get_baseline() -> dict | None:
+    """Recorded numpy-executor baseline; measure + record only if absent."""
+    try:
+        with open(RECORD_PATH) as f:
+            rec = json.load(f)
+        if (
+            rec.get("shape") == list(SHAPE)
+            and rec.get("chunk") == CHUNK
+            and isinstance(rec.get("elapsed"), (int, float))
+        ):
+            return rec
+    except (OSError, ValueError):
+        pass  # absent/corrupt record: re-measure below
+    env = _scrubbed_cpu_env()
+    env["CUBED_TPU_BACKEND"] = "numpy"
+    try:
+        res = _run_phase(
+            env=env,
+            timeout=_remaining(BASELINE_TIMEOUT_S),
+            use_jax_executor=False,
+            warmup=False,
+        )
+    except Exception as e:
+        print(f"baseline measurement failed: {e}", file=sys.stderr)
+        return None
+    rec = {
+        "metric": "pangeo_vorticity numpy-backend PythonDagExecutor elapsed",
+        "shape": list(SHAPE),
+        "chunk": CHUNK,
+        "elapsed": res["elapsed"],
+        "value": res["value"],
+        "measured": time.strftime("%Y-%m-%d")
+        + ", single-process numpy backend, scrubbed env",
+    }
+    try:  # atomic write so a killed run can't leave a corrupt record
+        tmp = RECORD_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(tmp, RECORD_PATH)
+    except OSError:
+        pass
+    return rec
 
 
 def main() -> None:
-    tpu = run_tpu()
-    try:
-        baseline = run_baseline()
-        vs_baseline = baseline["elapsed"] / tpu["elapsed"]
-    except Exception as e:
-        print(f"baseline run failed: {e}", file=sys.stderr)
-        vs_baseline = None
+    baseline = get_baseline()
 
+    tpu: dict | None = None
+    tpu_err = ""
+    try:
+        tpu = _run_phase(
+            env=dict(os.environ),
+            timeout=_remaining(TPU_TIMEOUT_S),
+            use_jax_executor=True,
+            warmup=True,
+        )
+    except Exception as e:  # timeout, crash, wedged tunnel — degrade
+        tpu_err = str(e)
+        print(f"TPU phase failed: {tpu_err[:1500]}", file=sys.stderr)
+
+    metric = "pangeo_vorticity_500x450x400_f64_throughput"
+    if tpu is None:
+        # tunnel-free CPU fallback: still the real framework + JaxExecutor,
+        # labelled honestly as not-a-TPU number
+        try:
+            tpu = _run_phase(
+                env=_scrubbed_cpu_env(),
+                timeout=_remaining(150),
+                use_jax_executor=True,
+                warmup=True,
+            )
+            metric += "_cpu_fallback"
+        except Exception as e:
+            print(f"CPU fallback failed too: {e}", file=sys.stderr)
+
+    if tpu is None:
+        print(
+            json.dumps(
+                {
+                    "metric": metric + "_unavailable",
+                    "value": 0.0,
+                    "unit": "GB/s/chip",
+                    "vs_baseline": None,
+                }
+            )
+        )
+        return
+
+    vs_baseline = (
+        round(baseline["elapsed"] / tpu["elapsed"], 3) if baseline else None
+    )
     gbps = WORK_BYTES / tpu["elapsed"] / 1e9
     print(
         json.dumps(
             {
-                "metric": "pangeo_vorticity_500x450x400_f64_throughput",
+                "metric": metric,
                 "value": round(gbps, 3),
                 "unit": "GB/s/chip",
-                "vs_baseline": round(vs_baseline, 3) if vs_baseline is not None else None,
+                "vs_baseline": vs_baseline,
             }
         )
     )

@@ -108,9 +108,9 @@ def concat(arrays, /, *, axis=0):
 
     # align non-axis chunking
     inds = []
-    for a in arrays:
+    for i, a in enumerate(arrays):
         ind = list(range(a.ndim))
-        ind[axis] = -1  # distinct symbol so axis chunks aren't unified
+        ind[axis] = -(i + 1)  # per-array symbol so axis chunks aren't unified
         inds.append(tuple(ind))
     pairs = list(itertools.chain(*zip(arrays, inds)))
     _, arrays = unify_chunks(*pairs)

@@ -295,6 +295,7 @@ def elemwise(func: Callable, *args: CoreArray, dtype=None) -> CoreArray:
     if dtype is None:
         raise ValueError("dtype must be specified for elemwise")
     shapes = [getattr(a, "shape", ()) for a in args]
+    np.broadcast_shapes(*shapes)  # raises ValueError on incompatible shapes
     out_ndim = max((len(s) for s in shapes), default=0)
     expr_inds = tuple(range(out_ndim))[::-1]
     blockwise_args = []
@@ -1073,30 +1074,39 @@ def unify_chunks(*args):
     """Align chunking of arrays sharing index symbols; rechunk as needed.
 
     Args are (array, ind) pairs. Returns (chunkss, arrays).
-    Reference cubed/core/ops.py:1172-1219.
+    Reference cubed/core/ops.py:1172-1219 (there via dask's common_blockdim,
+    which raises when the common refinement is not zarr-regular). Here any
+    misaligned-but-equal-extent chunkings unify: every array's chunks are
+    already zarr-regular, so the smallest per-symbol chunksize is a regular
+    target every input can rechunk to — rechunk regrids across arbitrary
+    boundaries (storage round-trip, or an in-HBM reshard on the TPU
+    executor), so boundary-union refinements are unnecessary, and the
+    smallest-chunksize choice keeps per-task memory bounded.
     """
     arrays = list(args[0::2])
     inds = list(args[1::2])
 
-    # Pick, per symbol, the chunking with the most blocks (regular-storage-
-    # friendly: the common-refinement rule can yield irregular chunks, which
-    # Zarr targets cannot express; rechunk handles arbitrary re-gridding).
     chunkss: dict = {}
     for a, ind in zip(arrays, inds):
         if ind is None:
             continue
-        for sym, c in zip(ind, a.chunks):
+        for sym, c, extent in zip(ind, a.chunks, a.shape):
             if sum(c) == 1 and len(c) == 1:
-                chunkss.setdefault(sym, c)
-            elif sym not in chunkss or (
-                sum(chunkss[sym]) == 1 or len(c) > len(chunkss[sym])
-            ):
-                if sym in chunkss and sum(chunkss[sym]) not in (1, sum(c)):
+                chunkss.setdefault(sym, c)  # broadcast candidate
+            elif sym not in chunkss or sum(chunkss[sym]) == 1:
+                chunkss[sym] = c
+            else:
+                prev = chunkss[sym]
+                if sum(prev) != sum(c):
                     raise ValueError(
                         f"Chunks do not align for symbol {sym!r}: "
-                        f"{chunkss[sym]} vs {c}"
+                        f"{prev} vs {c} (extents {sum(prev)} != {sum(c)})"
                     )
-                chunkss[sym] = c
+                if c != prev:
+                    smallest = min(prev[0], c[0])
+                    chunkss[sym] = normalize_chunks(
+                        (smallest,), (extent,), dtype=a.dtype
+                    )[0]
 
     unified = []
     for a, ind in zip(arrays, inds):

@@ -38,8 +38,9 @@ logger = logging.getLogger(__name__)
 #: env-var prefixes that make an interpreter-startup site hook register a
 #: hardware PJRT plugin (and dial the device tunnel) in every spawned
 #: interpreter. Keep in sync with __graft_entry__._PLUGIN_ENV_PREFIXES and
-#: tests/conftest.py (import-order constraints prevent a shared module:
-#: conftest must scrub before importing anything that pulls in jax).
+#: tests/conftest.py; bench.py reuses __graft_entry__'s copy. (Import-order
+#: constraints prevent a single shared module: conftest must scrub before
+#: importing anything that pulls in jax.)
 _PLUGIN_ENV_PREFIXES = ("PALLAS_AXON", "AXON_", "TPU_")
 
 

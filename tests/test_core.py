@@ -228,3 +228,30 @@ def test_unify_chunks_applies(spec, executor):
     b = ct.from_array(an, chunks=(3, 2), spec=spec)
     c = xp.add(a, b)
     assert np.allclose(c.compute(executor=executor), an + an)
+
+
+@pytest.mark.parametrize("n", [5, 6, 7])
+def test_unify_chunks_misaligned_1d(spec, executor, n):
+    # reference semantics: add of (3,)-chunked and (2,)-chunked computes
+    # (cubed/core/ops.py:1172-1219); here via smallest-chunksize rechunk
+    an = np.arange(float(n))
+    a = ct.from_array(an, chunks=(3,), spec=spec)
+    b = ct.from_array(an, chunks=(2,), spec=spec)
+    c = xp.add(a, b)
+    assert np.allclose(c.compute(executor=executor), an + an)
+
+
+def test_unify_chunks_misaligned_2d_with_broadcast(spec, executor):
+    an = np.arange(30.0).reshape(6, 5)
+    bn = np.arange(5.0)
+    a = ct.from_array(an, chunks=(4, 3), spec=spec)
+    b = ct.from_array(bn, chunks=(2,), spec=spec)
+    c = xp.multiply(a, b)
+    assert np.allclose(c.compute(executor=executor), an * bn)
+
+
+def test_unify_chunks_extent_mismatch_raises(spec):
+    a = ct.from_array(np.arange(6.0), chunks=(3,), spec=spec)
+    b = ct.from_array(np.arange(7.0), chunks=(2,), spec=spec)
+    with pytest.raises(ValueError):
+        xp.add(a, b)
