@@ -214,7 +214,11 @@ class Plan:
             spec=spec,
             **kwargs,
         )
-        callbacks_on(callbacks, "on_compute_end", ComputeEndEvent(dag))
+        callbacks_on(
+            callbacks,
+            "on_compute_end",
+            ComputeEndEvent(dag, executor_stats=getattr(executor, "stats", None)),
+        )
 
     # -- introspection -----------------------------------------------------
 

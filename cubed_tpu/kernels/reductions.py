@@ -133,6 +133,92 @@ def _pad_to_tiles(x):
     return x
 
 
+def _col_sum_kernel(x_ref, out_ref):
+    import jax.numpy as jnp
+
+    pl, _ = _pl()
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # stream row-tiles HBM->VMEM, accumulating the column partial into a
+    # revisited (8, N) block (TPU grids run sequentially; the (1, N) keepdims
+    # partial broadcasts over the 8 sublanes — every row holds the total, the
+    # caller reads row 0; a (1, N) output would break the f32 (8, 128) min
+    # tile)
+    out_ref[:] += jnp.sum(x_ref[:], axis=0, keepdims=True)
+
+
+@functools.lru_cache(maxsize=256)
+def _col_sum_call(shape, interpret):
+    import jax
+    import jax.numpy as jnp
+
+    pl, pltpu = _pl()
+    m, n = shape
+    tm = min(TILE_M, m)
+    return jax.jit(
+        pl.pallas_call(
+            _col_sum_kernel,
+            out_shape=jax.ShapeDtypeStruct((8, n), jnp.float32),
+            grid=(pl.cdiv(m, tm),),
+            in_specs=[pl.BlockSpec((tm, n), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((8, n), lambda i: (0, 0)),
+            interpret=interpret,
+        )
+    )
+
+
+def region_sum(x, axis, *, keepdims=True, interpret: bool | None = None):
+    """Pallas sum of an N-d f32 array over an axis set.
+
+    Reduced axes are transposed to the front and collapsed to rows, kept axes
+    to columns; a streaming column-sum kernel accumulates row-tiles in VMEM.
+    Full reductions route to the tiled ``block_sum``. Returns the keepdims
+    result (or the squeezed one with ``keepdims=False``).
+    """
+    import jax.numpy as jnp
+
+    if interpret is None:
+        interpret = not _on_tpu()
+    axis = tuple(sorted(ax % x.ndim for ax in axis))
+    kept = tuple(d for d in range(x.ndim) if d not in axis)
+    out_keep_shape = tuple(1 if d in axis else x.shape[d] for d in range(x.ndim))
+
+    if not kept or all(x.shape[d] == 1 for d in kept):
+        total = block_sum(x, interpret=interpret)
+        out = jnp.reshape(total, out_keep_shape)
+    else:
+        perm = axis + kept
+        rows = 1
+        for d in axis:
+            rows *= x.shape[d]
+        cols = 1
+        for d in kept:
+            cols *= x.shape[d]
+        x2 = jnp.reshape(jnp.transpose(x, perm), (rows, cols))
+        # zero-pad columns to the f32 lane width and rows to a whole number
+        # of grid tiles (out-of-bounds tile reads are undefined in pallas);
+        # _col_sum_call recomputes the same tile height from the padded shape
+        pn = (-cols) % 128
+        rows8 = rows + ((-rows) % 8)
+        tm = min(TILE_M, rows8)
+        pm = (-rows) % tm
+        if pn or pm:
+            x2 = jnp.pad(x2, ((0, pm), (0, pn)))
+        with _x32_scope():
+            fn = _col_sum_call(x2.shape, interpret)
+            col = fn(x2.astype(jnp.float32))
+        col = col[0:1, :cols]
+        out = jnp.reshape(col, tuple(x.shape[d] for d in kept))
+        out = jnp.reshape(out, out_keep_shape)
+    if not keepdims:
+        out = jnp.reshape(out, tuple(x.shape[d] for d in kept))
+    return out
+
+
 def _fma_mean_kernel(a_ref, x_ref, b_ref, y_ref, out_ref):
     import jax.numpy as jnp
 

@@ -62,6 +62,9 @@ class ComputeStartEvent:
 @dataclass
 class ComputeEndEvent:
     dag: object
+    #: execution-path counters from the executor (e.g. segments traced,
+    #: batched dispatches, eager fallbacks) — None if it reports none
+    executor_stats: Optional[dict] = None
 
 
 @dataclass
