@@ -1,0 +1,148 @@
+"""Micro-benchmark: Pallas streaming-reduction kernels vs plain XLA on the
+same shapes (VERDICT r2 item 1 'done' criterion).
+
+Compares, on the default jax backend:
+- ``region_sum`` (kernels/reductions.py) vs ``jnp.sum`` for the reduction
+  combine shape the executor routes through it;
+- ``fused_fma_mean`` vs XLA's fusion of ``mean(a*x + b*y)`` (the vorticity
+  inner loop).
+
+Measurement notes (the tunnel makes naive timing lie in BOTH directions):
+- repeated identical (executable, args) dispatches can be served from a
+  cache, yielding impossible >HBM-bandwidth numbers — so every inner
+  iteration consumes a DISTINCT slice of one device-resident buffer;
+- per-dispatch + host-sync round-trip latency (~tens of ms) swamps
+  millisecond kernels — so K applications run inside ONE jitted
+  ``lax.scan`` and the measured latency floor of an empty dispatch is
+  subtracted before computing throughput.
+
+Writes one JSON object to ``benchmarks/PALLAS_MICRO.json`` and prints it.
+Run on TPU hardware; on CPU the kernels run in interpret mode and the
+numbers are meaningless (the script refuses unless --force).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _run_scan(one_fn, stacked, reps=5):
+    """Best-of-reps wall time of ONE dispatch scanning one_fn over axis 0."""
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def many(b):
+        def body(c, vs):
+            return c, one_fn(*vs) if isinstance(vs, tuple) else one_fn(vs)
+
+        _, outs = jax.lax.scan(body, 0, b)
+        return outs
+
+    outs = many(stacked)  # compile + warm
+    np.asarray(jax.tree_util.tree_leaves(outs)[0])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = many(stacked)
+        np.asarray(jax.tree_util.tree_leaves(outs)[0])  # ONE host sync
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cubed_tpu.kernels.reductions import fused_fma_mean, region_sum
+
+    platform = jax.devices()[0].platform
+    if platform not in ("tpu", "axon") and "--force" not in sys.argv:
+        print(f"refusing on platform={platform}; pass --force for interpret mode")
+        return
+
+    results = {"platform": platform, "cases": []}
+
+    def device_random(key, shape):
+        # generate ON DEVICE: uploading GB buffers through the device tunnel
+        # takes minutes; a jitted uniform fills HBM at compute speed
+        k = jax.random.key(key)
+        return jax.block_until_ready(
+            jax.jit(lambda: jax.random.uniform(k, shape, dtype=jnp.float32))()
+        )
+
+    # dispatch+sync latency floor: an effectively-free scan with same sync
+    tiny = jnp.zeros((4, 8, 128), dtype=jnp.float32)
+    t_lat = _run_scan(lambda v: jnp.sum(v, keepdims=True), tiny)
+    results["latency_floor_ms"] = round(t_lat * 1e3, 3)
+
+    K = 64
+
+    def corrected(total, work_bytes):
+        exec_s = max(total - t_lat, 1e-9)
+        return exec_s, work_bytes / exec_s / 1e9
+
+    # the executor's region-combine shape: a merged group of f32 blocks
+    for shape, axis in [((2048, 2048), (0,)), ((4096, 4096), (0,)), ((4096, 4096), (0, 1))]:
+        big = device_random(0, (K,) + shape)
+        t_xla = _run_scan(lambda v: jnp.sum(v, axis=axis, keepdims=True), big)
+        t_pl = _run_scan(lambda v: region_sum(v, axis=axis), big)
+        work = K * big[0].size * 4
+        ex_x, gb_x = corrected(t_xla, work)
+        ex_p, gb_p = corrected(t_pl, work)
+        results["cases"].append(
+            {
+                "kernel": "region_sum",
+                "shape": list(shape),
+                "axis": list(axis),
+                "iters": K,
+                "xla_ms": round(ex_x / K * 1e3, 4),
+                "pallas_ms": round(ex_p / K * 1e3, 4),
+                "xla_gbps": round(gb_x, 1),
+                "pallas_gbps": round(gb_p, 1),
+                "pallas_speedup": round(ex_x / ex_p, 3),
+            }
+        )
+        del big
+
+    # the vorticity inner loop: mean(a*x + b*y), 4 streams in
+    for shape in [(2048, 2048)]:
+        bigs = tuple(device_random(i + 1, (K,) + shape) for i in range(4))
+        t_xla = _run_scan(lambda a, x, b, y: jnp.mean(a * x + b * y), bigs)
+        t_pl = _run_scan(fused_fma_mean, bigs)
+        work = K * 4 * bigs[0][0].size * 4
+        ex_x, gb_x = corrected(t_xla, work)
+        ex_p, gb_p = corrected(t_pl, work)
+        results["cases"].append(
+            {
+                "kernel": "fused_fma_mean",
+                "shape": list(shape),
+                "iters": K,
+                "xla_ms": round(ex_x / K * 1e3, 4),
+                "pallas_ms": round(ex_p / K * 1e3, 4),
+                "xla_gbps": round(gb_x, 1),
+                "pallas_gbps": round(gb_p, 1),
+                "pallas_speedup": round(ex_x / ex_p, 3),
+            }
+        )
+
+    speedups = [c["pallas_speedup"] for c in results["cases"]]
+    results["verdict"] = (
+        f"pallas/XLA speedup range {min(speedups)}-{max(speedups)}: "
+        "the executor keeps the Pallas combine opt-in "
+        "(JaxExecutor(use_pallas=True)) unless this shows >= 1.0"
+    )
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "PALLAS_MICRO.json")
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results, indent=1))
+
+
+if __name__ == "__main__":
+    main()

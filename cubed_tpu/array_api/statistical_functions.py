@@ -76,6 +76,12 @@ def _sum_with_dtype(a, axis=None, keepdims=False, dtype=None):
     return nxp.sum(a, axis=axis, keepdims=keepdims, dtype=dtype)
 
 
+# semantic tag consumed by the TPU executor: sum-combines over TPU-native
+# dtypes may be routed through the Pallas streaming-reduction kernels
+# (cubed_tpu/kernels/reductions.py) instead of the generic XLA combine
+_sum_with_dtype.reduce_kind = "sum"
+
+
 def prod(x, /, *, axis=None, dtype=None, keepdims=False, split_every=None):
     if x.dtype not in _numeric_dtypes:
         raise TypeError("Only numeric dtypes are allowed in prod")

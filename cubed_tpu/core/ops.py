@@ -904,6 +904,12 @@ class _StreamingCombine:
         self.combine_func = combine_func
         self.axis = axis
         self.kw = kw
+        # propagate the combine's semantic tag (e.g. "sum") so the TPU
+        # executor can substitute a Pallas streaming kernel for the region
+        # combine when the dtype permits
+        self.reduce_kind = getattr(combine_func, "reduce_kind", None) or (
+            "sum" if combine_func is nxp.sum else None
+        )
 
     def __call__(self, chunks_iter):
         acc = None

@@ -54,9 +54,13 @@ def _x32_scope():
         jax.config.update("jax_enable_x64", prev)
 
 
-#: VPU-friendly tile: multiples of the f32 (8, 128) min tile. (512, 512)
-#: empirically saturates HBM bandwidth on v5e (~740 GB/s on the fused
-#: fma+mean kernel, 6.6x XLA's fusion of the same expression).
+#: VPU-friendly tile: multiples of the f32 (8, 128) min tile. A v5e tile
+#: sweep (512-2048 per dim, benchmarks/pallas_vs_xla.py harness) showed
+#: ~300-350 GB/s for the accumulating sum kernels at every tile size vs
+#: ~890 GB/s for XLA's fused reduction of the same expression — the single
+#: revisited accumulator block serializes the grid, where XLA emits
+#: parallel partial sums. The executor therefore keeps these kernels
+#: opt-in (JaxExecutor(use_pallas=True)); see benchmarks/PALLAS_MICRO.json.
 TILE_M = 512
 TILE_N = 512
 
@@ -137,18 +141,31 @@ def _col_sum_kernel(x_ref, out_ref):
     import jax.numpy as jnp
 
     pl, _ = _pl()
-    i = pl.program_id(0)
+    i = pl.program_id(1)  # row-tile step: the INNER grid axis
 
     @pl.when(i == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
     # stream row-tiles HBM->VMEM, accumulating the column partial into a
-    # revisited (8, N) block (TPU grids run sequentially; the (1, N) keepdims
-    # partial broadcasts over the 8 sublanes — every row holds the total, the
-    # caller reads row 0; a (1, N) output would break the f32 (8, 128) min
-    # tile)
+    # revisited (8, tn) block. The grid is (col-tiles, row-tiles) with the
+    # row axis innermost, so every revisit of an output block is consecutive
+    # (the canonical TPU accumulation pattern) and each column tile's input
+    # block stays within the VMEM budget regardless of array width. The
+    # (1, tn) keepdims partial broadcasts over the 8 sublanes — every row
+    # holds the total, the caller reads row 0; a (1, tn) output would break
+    # the f32 (8, 128) min tile.
     out_ref[:] += jnp.sum(x_ref[:], axis=0, keepdims=True)
+
+
+def _tile_width(n: int) -> int:
+    """Largest lane-aligned tile width (multiple of 128, <= TILE_N) dividing
+    ``n`` (itself a multiple of 128) — so padding never exceeds the 128
+    alignment cost."""
+    for d in range(min(TILE_N, n), 0, -128):
+        if n % d == 0:
+            return d
+    return 128
 
 
 @functools.lru_cache(maxsize=256)
@@ -159,13 +176,14 @@ def _col_sum_call(shape, interpret):
     pl, pltpu = _pl()
     m, n = shape
     tm = min(TILE_M, m)
+    tn = _tile_width(n)
     return jax.jit(
         pl.pallas_call(
             _col_sum_kernel,
             out_shape=jax.ShapeDtypeStruct((8, n), jnp.float32),
-            grid=(pl.cdiv(m, tm),),
-            in_specs=[pl.BlockSpec((tm, n), lambda i: (i, 0))],
-            out_specs=pl.BlockSpec((8, n), lambda i: (0, 0)),
+            grid=(pl.cdiv(n, tn), pl.cdiv(m, tm)),
+            in_specs=[pl.BlockSpec((tm, tn), lambda j, i: (i, j))],
+            out_specs=pl.BlockSpec((8, tn), lambda j, i: (0, j)),
             interpret=interpret,
         )
     )
@@ -199,10 +217,11 @@ def region_sum(x, axis, *, keepdims=True, interpret: bool | None = None):
         for d in kept:
             cols *= x.shape[d]
         x2 = jnp.reshape(jnp.transpose(x, perm), (rows, cols))
-        # zero-pad columns to the f32 lane width and rows to a whole number
-        # of grid tiles (out-of-bounds tile reads are undefined in pallas);
-        # _col_sum_call recomputes the same tile height from the padded shape
-        pn = (-cols) % 128
+        # zero-pad both dims to whole grid tiles (out-of-bounds tile reads are
+        # undefined in pallas); _col_sum_call recomputes the same tile sizes
+        # from the padded shape, so padded dims must be tile multiples
+        n128 = cols + ((-cols) % 128)
+        pn = n128 - cols  # _col_sum_call picks a tile width dividing n128
         rows8 = rows + ((-rows) % 8)
         tm = min(TILE_M, rows8)
         pm = (-rows) % tm

@@ -44,32 +44,84 @@ def make_mesh(
     return Mesh(dev_array, tuple(axis_names))
 
 
+def prime_factors(n: int) -> list[int]:
+    """Prime factorization (ascending); [] for n <= 1."""
+    out = []
+    f = 2
+    while f * f <= n:
+        while n % f == 0:
+            out.append(f)
+            n //= f
+        f += 1
+    if n > 1:
+        out.append(n)
+    return out
+
+
+def factorized_mesh(mesh):
+    """A view of ``mesh``'s devices with one axis per prime factor.
+
+    Splitting the device count into prime-sized axes lets
+    ``sharding_for_chunks`` place factors on *different* array dims, so odd
+    shapes still shard fully: (499, 450, 400) on 8 devices replicates under a
+    1-d mesh (no dim divides by 8) but shards 8-way under (2, 2, 2)
+    (450 % 2 == 0 on one dim, 400 % 4 == 0 on another). Device order is
+    preserved, so collectives still ride the same ICI neighbours.
+    """
+    from jax.sharding import Mesh
+
+    devs = mesh.devices.flatten()
+    factors = prime_factors(len(devs)) or [1]
+    return Mesh(
+        devs.reshape(tuple(factors)),
+        tuple(f"f{i}" for i in range(len(factors))),
+    )
+
+
 def sharding_for_chunks(
     mesh,
-    chunkset: Sequence[Sequence[int]],
+    chunkset: Optional[Sequence[Sequence[int]]],
     shape: Sequence[int],
 ):
     """A NamedSharding laying the chunk grid over the mesh.
 
-    Mesh axes are assigned greedily to the array dims with the most blocks, so
-    the per-chip tile boundary coincides with chunk boundaries where possible
-    (tasks never straddle chips).
+    Mesh axes are assigned greedily to array dims — dims with the most chunk
+    blocks first, then by extent. Several mesh axes may stack on one dim
+    (their product must divide it), and no dim is required to be divisible by
+    the whole mesh — combined with :func:`factorized_mesh` this shards ragged
+    grids that a single-axis policy would replicate.
+
+    Chunk-aligned assignments (the chunk count divisible by the axis product,
+    so shard boundaries coincide with chunk boundaries and per-chunk task
+    slices never straddle chips) are preferred in a first pass; remaining
+    axes are then placed wherever the extent divides — a straddling shard
+    beats replication. ``chunkset=None`` ranks dims by extent alone.
     """
     from jax.sharding import NamedSharding, PartitionSpec
 
     if not shape:
         return NamedSharding(mesh, PartitionSpec())
-    nb = [len(c) for c in chunkset]
-    spec: list = [None] * len(shape)
-    axes = list(zip(mesh.axis_names, mesh.devices.shape))
-    # dims by descending block count
-    for dim in sorted(range(len(shape)), key=lambda d: -nb[d]):
-        if not axes:
-            break
-        name, size = axes[0]
-        if shape[dim] % size == 0 and nb[dim] >= size:
-            spec[dim] = name
-            axes.pop(0)
+    nb = [len(c) for c in chunkset] if chunkset else [1] * len(shape)
+    assigned: list[list] = [[] for _ in shape]
+    prods = [1] * len(shape)
+    pool = [(n, s) for n, s in zip(mesh.axis_names, mesh.devices.shape) if s > 1]
+    order = sorted(range(len(shape)), key=lambda d: (-nb[d], -shape[d]))
+    for aligned_only in (True, False):
+        for dim in order:
+            if not pool:
+                break
+            for name, size in list(pool):
+                total = prods[dim] * size
+                if shape[dim] % total != 0:
+                    continue
+                if aligned_only and nb[dim] % total != 0:
+                    continue
+                assigned[dim].append(name)
+                prods[dim] = total
+                pool.remove((name, size))
+    spec = [
+        (tuple(a) if len(a) > 1 else a[0]) if a else None for a in assigned
+    ]
     return NamedSharding(mesh, PartitionSpec(*spec))
 
 

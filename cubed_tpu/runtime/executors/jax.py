@@ -36,6 +36,7 @@ import itertools
 import logging
 import math
 import time
+from collections import Counter
 from typing import Any, Dict, Iterator, Optional
 
 import numpy as np
@@ -115,15 +116,33 @@ class JaxExecutor(DagExecutor):
         mesh=None,
         device_mem: Optional[int] = None,
         fuse_plan: bool = True,
+        use_pallas: Optional[bool] = None,
         **kwargs,
     ):
         self.mesh = mesh
         self.device_mem = device_mem
         #: trace consecutive traceable ops into ONE jitted XLA program
         self.fuse_plan = fuse_plan
+        #: route eligible reduction combines through the Pallas streaming
+        #: kernels (kernels/reductions.py). Default OFF: measured on v5e the
+        #: kernels reach only ~0.4-0.95x XLA's fused reductions (XLA emits
+        #: parallel partial sums; a single revisited accumulator block
+        #: serializes the Pallas grid) — see benchmarks/PALLAS_MICRO.json.
+        #: Pass True to opt in (tests use it to pin the wiring).
+        self.use_pallas = use_pallas
         self.kwargs = kwargs
         self._tracing = False
         self._prepared_bases: Dict[int, Any] = {}
+        self._placement = None  # factorized placement mesh, built lazily
+        #: execution-path counters for the last ``execute_dag`` call, reported
+        #: via ``ComputeEndEvent.executor_stats``. Keys: ``segments_traced``,
+        #: ``segments_compiled``, ``segment_cache_hits``, ``segment_mem_aborts``,
+        #: ``whole_array_hits``, ``batched_ops``, ``chunked_ops``,
+        #: ``rechunk_alias``, ``pallas_region_hits``, ``eager_ops``, and the
+        #: failure counters ``eager_fallbacks`` / ``trace_failures`` /
+        #: ``whole_array_errors`` / ``batched_errors`` / ``whole_select_errors``
+        #: (``eager_fallbacks`` must stay 0 on fused-path plans — tests pin it)
+        self.stats: Counter = Counter()
 
     @property
     def name(self) -> str:
@@ -143,23 +162,27 @@ class JaxExecutor(DagExecutor):
         n = len(self.mesh.devices.flat) if self.mesh is not None else 1
         return per_device * n
 
-    def _sharding_for(self, shape: tuple[int, ...]):
-        """NamedSharding partitioning the largest dim over all mesh axes."""
+    def _placement_mesh(self):
+        """Prime-factorized view of the mesh used for all array placement
+        (parallel/mesh.py:factorized_mesh) — cached per executor."""
+        if self._placement is None:
+            from ...parallel.mesh import factorized_mesh
+
+            self._placement = factorized_mesh(self.mesh)
+        return self._placement
+
+    def _sharding_for(self, shape: tuple[int, ...], chunkset=None):
+        """The chunk-grid-aligned sharding policy (parallel/mesh.py).
+
+        One policy for the whole executor: dims ranked by block count then
+        extent, mesh prime factors stacked per-dim, so ragged grids (e.g. the
+        vorticity slice (499, 450, 400)) shard instead of replicating.
+        """
         if self.mesh is None or not shape:
             return None
-        jax = _jax()
-        from jax.sharding import NamedSharding, PartitionSpec
+        from ...parallel.mesh import sharding_for_chunks
 
-        axis_names = tuple(self.mesh.axis_names)
-        total = math.prod(self.mesh.axis_sizes)
-        # choose the largest dim divisible by the mesh size; else replicate
-        order = sorted(range(len(shape)), key=lambda i: -shape[i])
-        for dim in order:
-            if shape[dim] % total == 0 and shape[dim] > 0:
-                spec = [None] * len(shape)
-                spec[dim] = axis_names if len(axis_names) > 1 else axis_names[0]
-                return NamedSharding(self.mesh, PartitionSpec(*spec))
-        return NamedSharding(self.mesh, PartitionSpec())
+        return sharding_for_chunks(self._placement_mesh(), chunkset, shape)
 
     def _full(self, shape, fill_value, dtype):
         """Materialize a constant array, sharded over the mesh if present."""
@@ -173,12 +196,15 @@ class JaxExecutor(DagExecutor):
             return fn()
         return jax.numpy.full(shape, fill_value, dtype=dtype)
 
-    def _device_put(self, value, shape):
+    def _device_put(self, value, shape, chunkset=None):
         jax = _jax()
-        sharding = self._sharding_for(shape)
+        sharding = self._sharding_for(shape, chunkset)
         if sharding is not None:
             if isinstance(value, dict):
-                return {k: jax.device_put(v, self._sharding_for(v.shape)) for k, v in value.items()}
+                return {
+                    k: jax.device_put(v, self._sharding_for(v.shape, chunkset))
+                    for k, v in value.items()
+                }
             return jax.device_put(value, sharding)
         if isinstance(value, dict):
             return {k: jax.device_put(v) for k, v in value.items()}
@@ -196,6 +222,7 @@ class JaxExecutor(DagExecutor):
         **kwargs,
     ) -> None:
         jax = _jax()
+        self.stats = Counter()
         resident: Dict[str, _Resident] = {}
         budget = self._budget()
 
@@ -227,6 +254,7 @@ class JaxExecutor(DagExecutor):
                 OperationStartEvent(name, primitive_op.num_tasks),
             )
             t0 = time.time()
+            self.stats["eager_ops"] += 1
             if pipeline.function is apply_blockwise:
                 self._exec_blockwise(primitive_op, resident, budget)
             elif pipeline.function is copy_read_to_write:
@@ -339,13 +367,18 @@ class JaxExecutor(DagExecutor):
         if nbytes > budget:
             return False
         data = concrete[...] if concrete.shape else concrete[()]
+        cs = (
+            blockdims_from_blockshape(concrete.shape, concrete.chunks)
+            if concrete.shape and getattr(concrete, "chunks", None)
+            else None
+        )
         if data.dtype.fields is not None:
             value = {
-                k: self._device_put(np.ascontiguousarray(data[k]), data.shape)
+                k: self._device_put(np.ascontiguousarray(data[k]), data.shape, cs)
                 for k in data.dtype.names
             }
         else:
-            value = self._device_put(data, data.shape)
+            value = self._device_put(data, data.shape, cs)
         self._admit(resident, key, value, arr, budget)
         return True
 
@@ -382,8 +415,14 @@ class JaxExecutor(DagExecutor):
                 traced = self._trace_segment(
                     ops, dag, resident, budget, requested_stores
                 )
+                if traced:
+                    self.stats["segments_traced"] += 1
+                else:
+                    self.stats["segment_mem_aborts"] += 1
             except Exception:
                 logger.exception("segment trace failed; falling back to eager")
+                self.stats["trace_failures"] += 1
+                self.stats["eager_fallbacks"] += 1
                 traced = False
         if not traced:
             for name, node in ops:
@@ -394,18 +433,27 @@ class JaxExecutor(DagExecutor):
                     self._exec_rechunk(primitive_op, resident, budget)
 
         t1 = time.time()
+        # the segment ran as ONE fused program; apportion its wall time across
+        # the member ops by task count so history/timeline totals sum to the
+        # real segment duration instead of len(ops) x duration
+        total_tasks = sum(node["primitive_op"].num_tasks for _, node in ops) or 1
+        elapsed = t1 - t0
+        start = t0
         for name, node in ops:
+            num_tasks = node["primitive_op"].num_tasks
+            end = start + elapsed * (num_tasks / total_tasks)
             callbacks_on(
                 callbacks, "on_task_end",
                 TaskEndEvent(
                     array_name=name,
-                    num_tasks=node["primitive_op"].num_tasks,
-                    task_create_tstamp=t0,
-                    function_start_tstamp=t0,
-                    function_end_tstamp=t1,
-                    task_result_tstamp=t1,
+                    num_tasks=num_tasks,
+                    task_create_tstamp=start,
+                    function_start_tstamp=start,
+                    function_end_tstamp=end,
+                    task_result_tstamp=end,
                 ),
             )
+            start = end
 
     def _trace_segment(
         self, ops, dag, resident, budget, requested_stores
@@ -483,16 +531,28 @@ class JaxExecutor(DagExecutor):
         try:
             import hashlib
 
-            key = hashlib.sha256(lowered.as_text().encode()).hexdigest()
+            # key on HLO text PLUS the device set: the same program lowered
+            # for a different mesh/device assignment must not reuse an
+            # executable compiled for another topology
+            devices = (
+                tuple(d.id for d in self.mesh.devices.flat)
+                if self.mesh is not None
+                else (jax.devices()[0].id,)
+            )
+            fingerprint = lowered.as_text() + repr(devices)
+            key = hashlib.sha256(fingerprint.encode()).hexdigest()
         except Exception:
             key = None
         compiled = _SEGMENT_CACHE.get(key) if key is not None else None
         if compiled is None:
             compiled = lowered.compile()
+            self.stats["segments_compiled"] += 1
             if key is not None:
                 if len(_SEGMENT_CACHE) >= 64:
                     _SEGMENT_CACHE.pop(next(iter(_SEGMENT_CACHE)))
                 _SEGMENT_CACHE[key] = compiled
+        else:
+            self.stats["segment_cache_hits"] += 1
         outs = compiled(in_vals, base_vals)
         for store, value in zip(keep_list, outs):
             self._admit(resident, store, value, keep[store], budget)
@@ -553,8 +613,14 @@ class JaxExecutor(DagExecutor):
                     value = fn(*full)
                     if not isinstance(value, dict) and tuple(value.shape) != out_shape:
                         value = None  # kernel wasn't truly shape-invariant
+                    else:
+                        self.stats["whole_array_hits"] += 1
+                except _TraceAbort:
+                    raise
                 except Exception:
                     logger.exception("whole-array path failed; falling back")
+                    self.stats["whole_array_errors"] += 1
+                    self.stats["eager_fallbacks"] += 1
                     value = None
 
         if (
@@ -564,12 +630,19 @@ class JaxExecutor(DagExecutor):
         ):
             try:
                 value = self._exec_batched(op, spec, resident)
+                if value is not None:
+                    self.stats["batched_ops"] += 1
+            except _TraceAbort:
+                raise
             except Exception:
                 logger.exception("batched path failed; falling back")
+                self.stats["batched_errors"] += 1
+                self.stats["eager_fallbacks"] += 1
                 value = None
 
         if value is None:
             value = self._exec_chunked(op, spec, resident)
+            self.stats["chunked_ops"] += 1
 
         self._admit(resident, out_store, value, target, budget)
 
@@ -602,6 +675,8 @@ class JaxExecutor(DagExecutor):
             return v
         except Exception:
             logger.exception("whole-select fast path failed")
+            self.stats["whole_select_errors"] += 1
+            self.stats["eager_fallbacks"] += 1
             return None
 
     def _whole_inputs(self, spec: BlockwiseSpec, resident) -> Optional[Dict[str, Any]]:
@@ -912,9 +987,14 @@ class JaxExecutor(DagExecutor):
         nb = tuple(len(c) for c in chunkset)
         needs_block_id = getattr(spec.function, "needs_block_id", False)
 
-        jitted = _JitCache(spec.function)
+        jitted = _JitCache(spec.function, self.stats)
         region_fn = getattr(spec.function, "combine_region", None)
-        jitted_region = _JitCache(region_fn) if region_fn is not None else None
+        jitted_region = (
+            _JitCache(region_fn, self.stats) if region_fn is not None else None
+        )
+        pallas_region = (
+            self._pallas_region_fn(spec.function) if region_fn is not None else None
+        )
 
         traced_offsets = self._tracing and getattr(
             spec.function, "traced_offsets", False
@@ -933,7 +1013,10 @@ class JaxExecutor(DagExecutor):
                 keys = list(structure[0])
                 region = self._resolve_region(keys, spec, resident)
                 if region is not None:
-                    result = jitted_region(region)
+                    if pallas_region is not None:
+                        result = pallas_region(region)
+                    if result is None:
+                        result = jitted_region(region)
                 else:
                     structure = (iter(keys),)
             if result is None:
@@ -988,6 +1071,48 @@ class JaxExecutor(DagExecutor):
         if isinstance(value, dict):
             return {k: v[sel] for k, v in value.items()}
         return value[sel]
+
+    def _pallas_region_fn(self, fn) -> Optional[Any]:
+        """A Pallas substitute for the region combine, or None.
+
+        Eligible when the combine is semantically a sum (``reduce_kind``
+        tagged by the array_api layer / core reduction), the accumulation
+        dtype is f32 (the kernels accumulate in f32; other dtypes keep the
+        XLA combine), and ``use_pallas=True`` was requested (the reference's
+        combine shape is cubed/core/ops.py:978-1005; here the streamed group
+        is a single HBM->VMEM pass, kernels/reductions.py).
+        """
+        if not self.use_pallas:
+            return None
+        if getattr(fn, "reduce_kind", None) != "sum":
+            return None
+        kw = getattr(fn, "kw", None) or {}
+        extra = {k: v for k, v in kw.items() if k != "dtype"}
+        if extra:
+            return None
+        kw_dtype = kw.get("dtype")
+        if kw_dtype is not None and np.dtype(kw_dtype) != np.float32:
+            return None
+        axis = getattr(fn, "axis", None)
+        if not axis:
+            return None
+        from ...kernels.reductions import region_sum
+
+        def run(region):
+            if isinstance(region, dict) or region.dtype != np.float32:
+                return None
+            try:
+                out = region_sum(region, axis=axis, keepdims=True)
+            except Exception:
+                # recovered by the jitted XLA combine — a pallas_errors event,
+                # not an eager fallback (the fast path still runs)
+                logger.exception("pallas region combine failed; using XLA")
+                self.stats["pallas_errors"] += 1
+                return None
+            self.stats["pallas_region_hits"] += 1
+            return out
+
+        return run
 
     def _resolve(self, entry, spec: BlockwiseSpec, resident, traced_offsets=False):
         """Resolve a key structure to device chunks (sliced from residents)."""
@@ -1065,6 +1190,7 @@ class JaxExecutor(DagExecutor):
             # chunking is metadata; the resident value is the whole array
             res = resident[src_key]
             res.touch()
+            self.stats["rechunk_alias"] += 1
             self._admit(resident, dst_key, res.value, dst, budget)
             return
 
@@ -1148,9 +1274,11 @@ class JaxExecutor(DagExecutor):
                 concrete[sel] = np.asarray(value[sel])
 
 
-#: in-process cache of compiled segment programs keyed by lowered-HLO hash:
-#: repeat computes of structurally equal plans skip executable reload entirely
-_SEGMENT_CACHE: Dict[int, Any] = {}
+#: in-process cache of compiled segment programs keyed by the sha256 hex
+#: digest of (lowered HLO text, device-id tuple): repeat computes of
+#: structurally equal plans on the same device set skip compilation entirely,
+#: while a different mesh/topology gets its own entry
+_SEGMENT_CACHE: Dict[str, Any] = {}
 
 _PYTREES_REGISTERED = False
 
@@ -1306,8 +1434,9 @@ def _gather_blocks(value, nb, chunk_shape, idx):
 class _JitCache:
     """jit a chunk kernel lazily, falling back to eager on trace failure."""
 
-    def __init__(self, function):
+    def __init__(self, function, stats: Optional[Counter] = None):
         self.function = function
+        self.stats = stats
         self._jitted = None
         # host-bound kernels (block_id sync, closed-over host data) can't jit
         self._use_eager = getattr(function, "host_block_id", False) or bool(
@@ -1326,6 +1455,10 @@ class _JitCache:
         try:
             return self._jitted(*args)
         except Exception:
+            logger.exception("chunk-kernel jit failed; running eagerly")
+            if self.stats is not None:
+                self.stats["jit_kernel_errors"] += 1
+                self.stats["eager_fallbacks"] += 1
             self._use_eager = True
             return self.function(*args)
 

@@ -9,6 +9,7 @@ reimplemented on concurrent.futures without aiostream.
 from __future__ import annotations
 
 import concurrent.futures
+import itertools
 import logging
 import time
 from typing import Callable, Dict, Iterable, Optional
@@ -45,15 +46,29 @@ def map_unordered(
 
     ``array_names`` (parallel to inputs) attributes each task's end event to
     its own op when tasks of several ops are interleaved in one map.
+
+    With ``batch_size`` set and no ``array_names``, inputs are consumed
+    lazily batch by batch — large task grids never materialize in memory
+    (that bounded-submission streaming is what ``batch_size`` is for).
     """
-    inputs = list(inputs)
     if array_names is not None:
+        inputs = list(inputs)
         assert len(array_names) == len(inputs)
     if batch_size is None:
         _map_unordered_batch(
-            executor, function, inputs, retries, use_backups,
+            executor, function, list(inputs), retries, use_backups,
             callbacks, array_name, array_names, **kwargs,
         )
+    elif array_names is None:
+        it = iter(inputs)
+        while True:
+            batch = list(itertools.islice(it, batch_size))
+            if not batch:
+                break
+            _map_unordered_batch(
+                executor, function, batch, retries, use_backups,
+                callbacks, array_name, None, **kwargs,
+            )
     else:
         for start in range(0, len(inputs), batch_size):
             _map_unordered_batch(
@@ -64,9 +79,7 @@ def map_unordered(
                 use_backups,
                 callbacks,
                 array_name,
-                array_names[start : start + batch_size]
-                if array_names is not None
-                else None,
+                array_names[start : start + batch_size],
                 **kwargs,
             )
 

@@ -113,3 +113,75 @@ def test_sharding_for_chunks():
     sharding = sharding_for_chunks(mesh, ((2,) * 8, (6,) * 4), (16, 24))
     spec_dims = sharding.spec
     assert spec_dims[0] == "data"  # most blocks and divisible
+
+
+def test_prime_factors():
+    from cubed_tpu.parallel.mesh import prime_factors
+
+    assert prime_factors(8) == [2, 2, 2]
+    assert prime_factors(12) == [2, 2, 3]
+    assert prime_factors(7) == [7]
+    assert prime_factors(1) == []
+
+
+@needs_8
+def test_factorized_mesh_shards_odd_shapes():
+    # the round-2 verdict case: (499, 450, 400) replicated under a 1-d 8-mesh
+    # because no dim divides by 8; the factorized (2,2,2) placement shards it
+    # 8-way across two dims
+    from cubed_tpu.parallel.mesh import (
+        factorized_mesh,
+        make_mesh,
+        sharding_for_chunks,
+    )
+
+    mesh = make_mesh(shape=(8,), devices=_cpu_devices()[:8])
+    fmesh = factorized_mesh(mesh)
+    assert fmesh.devices.shape == (2, 2, 2)
+
+    shape = (499, 450, 400)
+    chunkset = tuple(
+        tuple(min(100, s - i) for i in range(0, s, 100)) for s in shape
+    )
+    sharding = sharding_for_chunks(fmesh, chunkset, shape)
+    shard_shape = sharding.shard_shape(shape)
+    # fully 8-way sharded: each shard holds 1/8 of the elements
+    import math
+
+    assert math.prod(shard_shape) * 8 == math.prod(shape)
+
+
+@needs_8
+def test_sharding_for_chunks_2d_mesh_uneven_grid():
+    from cubed_tpu.parallel.mesh import make_mesh, sharding_for_chunks
+
+    mesh = make_mesh(shape=(4, 2), axis_names=("a", "b"), devices=_cpu_devices()[:8])
+    # ragged chunk grid: 19 = 5+5+5+4 blocks of chunk 5; both dims uneven
+    sharding = sharding_for_chunks(mesh, ((5, 5, 5, 4), (6, 6, 2)), (19, 14))
+    # 19 is prime (no axis divides); 14 % 2 == 0 -> 'b' lands on dim 1
+    assert sharding.spec[1] == "b" or sharding.spec[1] == ("b",)
+    assert sharding.spec[0] is None
+
+
+@needs_8
+def test_sharded_execution_nondivisible_shape(spec, mesh_executor):
+    # shape with no dim divisible by 8: the factorized placement mesh must
+    # still shard it AND produce correct results
+    an = np.arange(34.0 * 12).reshape(34, 12)
+    a = ct.from_array(an, chunks=(8, 6), spec=spec)
+    b = ct.from_array(an, chunks=(8, 6), spec=spec)
+    out = xp.sum(xp.add(xp.multiply(a, 2.0), b))
+    np.testing.assert_allclose(
+        float(out.compute(executor=mesh_executor)), (an * 3.0).sum()
+    )
+
+
+@needs_8
+def test_executor_uses_mesh_policy(mesh_executor):
+    # the executor must delegate to parallel.mesh.sharding_for_chunks (one
+    # policy); (34, 12) has no dim divisible by 8 but shards 8-way factorized
+    s = mesh_executor._sharding_for((34, 12))
+    assert s is not None
+    import math
+
+    assert math.prod(s.shard_shape((34, 12))) * 8 == 34 * 12

@@ -75,6 +75,30 @@ def test_batch(tmp_path):
     check_invocation_counts(path, timing_map, 10)
 
 
+def test_batch_streams_iterator_inputs():
+    """With batch_size and no array_names, inputs are pulled lazily: the
+    generator must never be drained more than one batch ahead of the work."""
+    done = []
+    pulled = []
+
+    def gen():
+        for i in range(12):
+            # laziness invariant: everything pulled beyond the current batch
+            # would show as pulled - done > batch_size at pull time
+            assert len(pulled) - len(done) <= 3, (len(pulled), len(done))
+            pulled.append(i)
+            yield i
+
+    def work(i, config=None):
+        done.append(i)
+        return i
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+        map_unordered(pool, work, gen(), batch_size=3)
+    assert sorted(done) == list(range(12))
+    assert pulled == list(range(12))
+
+
 def test_executor_end_to_end_with_failures(tmp_path, spec, monkeypatch):
     """Retries are exercised through a real plan execution."""
     import numpy as np

@@ -100,6 +100,90 @@ def test_fused_structured_mean_intermediates(spec):
     np.testing.assert_allclose(eager, an.mean())
 
 
+# ---------------------------------------------------------------------------
+# executor stats: the fast paths must be *observably* taken. A silently broken
+# fast path costs 10x quietly; these pins make it fail a test instead.
+# ---------------------------------------------------------------------------
+
+
+def test_stats_fused_elementwise_counts(spec):
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    b = ct.from_array(an, chunks=(4, 4), spec=spec)
+    ex = JaxExecutor()
+    result = xp.add(xp.multiply(a, 2.0), b).compute(executor=ex)
+    np.testing.assert_allclose(np.asarray(result), an * 2 + an)
+    assert ex.stats["segments_traced"] == 1
+    assert ex.stats["trace_failures"] == 0
+    assert ex.stats["eager_fallbacks"] == 0
+    # the fused op must take a vectorized path, never per-chunk dispatch
+    assert ex.stats["batched_ops"] + ex.stats["whole_array_hits"] >= 1
+    assert ex.stats["chunked_ops"] == 0
+
+
+def test_stats_vorticity_plan_fully_fused(spec):
+    # the benchmark plan shape (bench.py WORKLOAD) at test size: the whole
+    # pipeline must run as ONE traced segment with zero eager fallbacks
+    def rnd():
+        return cubed_tpu.random.random((12, 10, 8), chunks=4, spec=spec)
+
+    a, b, x, y = rnd(), rnd(), rnd(), rnd()
+    s = xp.mean(xp.add(xp.multiply(a[1:], x[1:]), xp.multiply(b[1:], y[1:])))
+    ex = JaxExecutor()
+    val = float(s.compute(executor=ex))
+    assert 0.0 < val < 1.0
+    assert ex.stats["segments_traced"] == 1
+    assert ex.stats["trace_failures"] == 0
+    assert ex.stats["eager_fallbacks"] == 0
+    assert ex.stats["whole_select_errors"] == 0
+
+
+def test_stats_segment_cache_hit_on_recompute(spec):
+    # same plan structure twice: the second compute reuses the compiled
+    # segment executable (traced again, compiled never)
+    an = np.arange(36, dtype=np.float64).reshape(6, 6)
+
+    def build():
+        a = ct.from_array(an, chunks=(3, 3), spec=spec)
+        return xp.sum(xp.multiply(a, 3.7193))
+
+    ex1 = JaxExecutor()
+    ex2 = JaxExecutor()
+    v1 = float(build().compute(executor=ex1))
+    v2 = float(build().compute(executor=ex2))
+    assert v1 == v2
+    assert ex1.stats["segments_traced"] == 1
+    assert ex2.stats["segments_traced"] == 1
+    assert ex2.stats["segment_cache_hits"] == 1
+    assert ex2.stats["segments_compiled"] == 0
+
+
+def test_stats_eager_mode_traces_nothing(spec):
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    ex = JaxExecutor(fuse_plan=False)
+    xp.add(a, 1.0).compute(executor=ex)
+    assert ex.stats["segments_traced"] == 0
+    assert ex.stats["eager_ops"] >= 1
+
+
+def test_stats_reported_via_compute_end_event(spec):
+    from cubed_tpu.runtime.types import Callback
+
+    seen = {}
+
+    class Capture(Callback):
+        def on_compute_end(self, event):
+            seen["stats"] = event.executor_stats
+
+    an = np.arange(16, dtype=np.float64).reshape(4, 4)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    ex = JaxExecutor()
+    xp.sum(a).compute(executor=ex, callbacks=[Capture()])
+    assert seen["stats"] is ex.stats
+    assert seen["stats"]["segments_traced"] == 1
+
+
 def test_fused_output_also_persisted(spec, tmp_path):
     # a kept store must flush correctly after a traced segment
     an = np.arange(64, dtype=np.float64).reshape(8, 8)
