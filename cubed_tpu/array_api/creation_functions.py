@@ -164,9 +164,13 @@ def linspace(
         b0 = nxp.asarray(offset).ravel()[0] if offset is not None else block_id[0]
         bstart = start + b0 * chunksize * step
         blen = chunk.shape[0]
-        return nxp.asarray(
-            bstart + step * nxp.arange(blen), dtype=dtype
-        )
+        vals = bstart + step * nxp.arange(blen)
+        if endpoint and num > 1:
+            # pin the final element to `stop` exactly (numpy semantics): the
+            # per-block affine accumulates one rounding step at the endpoint
+            gidx = b0 * chunksize + nxp.arange(blen)
+            vals = nxp.where(gidx == num - 1, stop, vals)
+        return nxp.asarray(vals, dtype=dtype)
 
     _linspace_chunk.supports_offset = True
     return map_blocks(

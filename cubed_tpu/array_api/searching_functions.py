@@ -15,32 +15,29 @@ from .manipulation_functions import flatten
 def argmax(x, /, *, axis=None, keepdims=False, split_every=None):
     if x.dtype not in _real_numeric_dtypes:
         raise TypeError("Only real numeric dtypes are allowed in argmax")
-    if axis is None:
-        x = flatten(x)
-        axis = 0
-    return _maybe_keepdims(
-        arg_reduction(x, nxp.argmax, nxp.max, axis=axis, dtype=np.dtype(np.int64)),
-        keepdims, axis, x.ndim,
-    )
+    return _arg_reduce(x, nxp.argmax, nxp.max, axis, keepdims)
 
 
 def argmin(x, /, *, axis=None, keepdims=False, split_every=None):
     if x.dtype not in _real_numeric_dtypes:
         raise TypeError("Only real numeric dtypes are allowed in argmin")
+    return _arg_reduce(x, nxp.argmin, nxp.min, axis, keepdims)
+
+
+def _arg_reduce(x, arg_func, val_func, axis, keepdims):
+    orig_ndim = x.ndim
     if axis is None:
         x = flatten(x)
         axis = 0
-    return _maybe_keepdims(
-        arg_reduction(x, nxp.argmin, nxp.min, axis=axis, dtype=np.dtype(np.int64)),
-        keepdims, axis, x.ndim,
-    )
-
-
-def _maybe_keepdims(out, keepdims, axis, ndim):
+    out = arg_reduction(x, arg_func, val_func, axis=axis, dtype=np.dtype(np.int64))
     if keepdims:
         from .manipulation_functions import expand_dims
 
-        return expand_dims(out, axis=axis % ndim)
+        if orig_ndim != x.ndim:
+            # axis=None reduces ALL axes: keepdims restores every one as a
+            # singleton (spec: out shape (1,) * x.ndim)
+            return expand_dims(out, axis=tuple(range(orig_ndim)))
+        return expand_dims(out, axis=axis % x.ndim)
     return out
 
 

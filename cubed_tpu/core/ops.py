@@ -753,16 +753,15 @@ def rechunk(x: CoreArray, chunks, target_store=None) -> CoreArray:
         temp_store=temp_store,
         storage_options=spec.storage_options,
     )
-    if len(ops) == 1:
-        op = ops[0]
-        plan = Plan._new(name, "rechunk", op.target_array, op, False, x)
-        return new_array(name, op.target_array, spec, plan)
-    op1, op2 = ops
-    int_name = gensym("array")
-    plan1 = Plan._new(int_name, "rechunk", op1.target_array, op1, True, x)
-    intermediate = new_array(int_name, op1.target_array, spec, plan1)
-    plan2 = Plan._new(name, "rechunk", op2.target_array, op2, False, intermediate)
-    return new_array(name, op2.target_array, spec, plan2)
+    # chain the staged copies (1 op for direct, 2 for min-intermediate, N for
+    # a multistage geometric plan) into plan nodes
+    prev = x
+    for i, op in enumerate(ops):
+        last = i == len(ops) - 1
+        nm = name if last else gensym("array")
+        plan = Plan._new(nm, "rechunk", op.target_array, op, not last, prev)
+        prev = new_array(nm, op.target_array, spec, plan)
+    return prev
 
 
 def merge_chunks(x: CoreArray, chunks) -> CoreArray:

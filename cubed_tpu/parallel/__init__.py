@@ -1,1 +1,3 @@
-from .mesh import make_mesh, sharding_for_chunks  # noqa: F401
+from .attention import attention  # noqa: F401
+from .mesh import factorized_mesh, make_mesh, reshard, sharding_for_chunks  # noqa: F401
+from .multihost import dcn_mesh, host_chunk_assignment, local_chunks  # noqa: F401

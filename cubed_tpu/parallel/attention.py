@@ -1,0 +1,82 @@
+"""User-facing attention over cubed arrays: the bridge that makes the
+sequence-parallel ring kernels reachable from the array layer.
+
+Global attention needs cross-chunk communication along the sequence axis —
+exactly what the array layer's embarrassingly-parallel task model cannot
+express (the reference has no attention at all; SURVEY.md §5.7 maps the
+long-context obligation to sequence sharding over the mesh). So this API
+sits beside the plan machinery, not inside it: inputs are computed (storage
+-> HBM), attention runs as ONE jitted sequence-parallel program
+(parallel/ring_attention.py — ring over the mesh's axis, dense on a single
+device), and the result re-enters the plan world as a cubed array.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    mesh=None,
+    axis_name: str = "seq",
+    chunks=None,
+    spec=None,
+):
+    """Multi-head attention over cubed arrays of shape (B, S, H, D).
+
+    With ``mesh``, the sequence axis is sharded over ``axis_name`` and the
+    kernel is blockwise ring attention (KV blocks rotate via collective
+    permute over ICI; numerically-stable streaming softmax). Without it, a
+    single-device dense kernel. Returns a cubed array chunked like ``q``
+    (override with ``chunks``).
+    """
+    from ..core.array import CoreArray
+    from ..core.ops import from_array
+    from .ring_attention import dense_attention, ring_attention, sequence_sharded
+
+    import jax
+
+    # evaluate all cubed inputs in ONE plan so a shared upstream subgraph
+    # (the usual one-source-three-projections pattern) computes once
+    from ..core.array import compute as compute_multi
+
+    core = [x for x in (q, k, v) if isinstance(x, CoreArray)]
+    computed = iter(compute_multi(*core)) if core else iter(())
+
+    def materialize(x):
+        if isinstance(x, CoreArray):
+            return np.asarray(next(computed)), x
+        return np.asarray(x), None
+
+    qn, q_arr = materialize(q)
+    kn, _ = materialize(k)
+    vn, _ = materialize(v)
+    if qn.ndim != 4:
+        raise ValueError(f"attention expects (B, S, H, D) arrays, got {qn.shape}")
+
+    if mesh is not None and axis_name in mesh.axis_names:
+        qd = sequence_sharded(qn, mesh, axis_name=axis_name)
+        kd = sequence_sharded(kn, mesh, axis_name=axis_name)
+        vd = sequence_sharded(vn, mesh, axis_name=axis_name)
+        out = ring_attention(
+            qd, kd, vd, mesh=mesh, axis_name=axis_name, causal=causal, scale=scale
+        )
+    else:
+        out = jax.jit(
+            lambda a, b, c: dense_attention(a, b, c, causal=causal, scale=scale)
+        )(qn, kn, vn)
+
+    out_np = np.asarray(out).astype(qn.dtype)
+    if chunks is None:
+        chunks = q_arr.chunksize if q_arr is not None else out_np.shape
+    if spec is None and q_arr is not None:
+        spec = q_arr.spec
+    return from_array(out_np, chunks=chunks, spec=spec)

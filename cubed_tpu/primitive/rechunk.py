@@ -106,6 +106,103 @@ def _consolidate_chunks(
     return tuple(chunks)
 
 
+def _stage_chunks(
+    shape: tuple[int, ...],
+    source_chunks: tuple[int, ...],
+    target_chunks: tuple[int, ...],
+    t: float,
+) -> tuple[int, ...]:
+    """Geometric interpolation between source and target chunk shapes at
+    fraction ``t`` (reference: vendored rechunker
+    algorithm.py:calculate_stage_chunks, 114-145 — geomspace per dim)."""
+    out = []
+    for s, r, w in zip(shape, source_chunks, target_chunks):
+        c = round(math.exp(math.log(r) * (1 - t) + math.log(w) * t))
+        out.append(max(1, min(s, int(c))))
+    return tuple(out)
+
+
+def _copy_io_ops(
+    shape: tuple[int, ...],
+    read_chunks: tuple[int, ...],
+    write_chunks: tuple[int, ...],
+) -> int:
+    """IO operations for one copy pass: one write per task plus the covering
+    source-chunk reads per task (reference: vendored rechunker
+    algorithm.py:148-185, LCM-based op counting — here the worst-case
+    straddle count, which upper-bounds it)."""
+    tasks = math.prod(max(1, math.ceil(s / w)) for s, w in zip(shape, write_chunks))
+    reads_per_task = math.prod(
+        min(math.ceil(s / r), math.ceil((w - 1) / r) + 1)
+        for s, r, w in zip(shape, read_chunks, write_chunks)
+    )
+    return tasks * (1 + reads_per_task)
+
+
+def _copy_feasible(
+    shape: tuple[int, ...],
+    read_chunks: tuple[int, ...],
+    write_chunks: tuple[int, ...],
+    itemsize: int,
+    max_mem: int,
+) -> bool:
+    """ONE memory-feasibility rule for a direct copy pass, shared by the
+    single-stage planner, the multistage planner, and mirrored (with the
+    reference's x2 compressed/uncompressed factors) by _copy_op's
+    plan-time ValueError check."""
+    return (
+        _covering_bytes(shape, write_chunks, read_chunks, itemsize)
+        + math.prod(write_chunks) * itemsize
+        <= max_mem
+    )
+
+
+def _plan_io_ops(shape: tuple[int, ...], seq: list[tuple[int, ...]]) -> int:
+    """Total IO operations of a staged chunking sequence."""
+    return sum(_copy_io_ops(shape, a, b) for a, b in zip(seq, seq[1:]))
+
+
+def multistage_rechunking_plan(
+    shape: tuple[int, ...],
+    source_chunks: tuple[int, ...],
+    target_chunks: tuple[int, ...],
+    itemsize: int,
+    max_mem: int,
+    max_stages: int = 8,
+) -> Optional[list[tuple[int, ...]]]:
+    """An N-stage sequence of chunkings [source, c_1, .., c_{n}, target] where
+    every adjacent pair is a memory-feasible direct copy, minimizing total IO
+    operations.
+
+    Solves the pathological shape-transpose rechunks — e.g. (1, N) -> (N, 1)
+    chunks — where the elementwise-min intermediate degenerates to (1, 1)
+    chunks and O(N^2) one-element IO ops; geometric stages keep every pass
+    O(N·sqrt(N)) or better (reference: vendored rechunker
+    algorithm.py:multistage_rechunking_plan, 200-318). Returns None when no
+    stage count up to ``max_stages`` yields a feasible plan (caller falls
+    back to the min-intermediate 2-pass).
+    """
+    best: Optional[list[tuple[int, ...]]] = None
+    best_io = None
+    for n_stages in range(0, max_stages + 1):
+        seq = [tuple(source_chunks)]
+        for k in range(1, n_stages + 1):
+            c = _stage_chunks(shape, source_chunks, target_chunks, k / (n_stages + 1))
+            if c != seq[-1]:
+                seq.append(c)
+        if tuple(target_chunks) != seq[-1]:
+            seq.append(tuple(target_chunks))
+        if any(
+            not _copy_feasible(shape, a, b, itemsize, max_mem)
+            for a, b in zip(seq, seq[1:])
+        ):
+            continue
+        io = _plan_io_ops(shape, seq)
+        if best_io is None or io < best_io:
+            best, best_io = seq, io
+    return best
+
+
 def rechunking_plan(
     shape: tuple[int, ...],
     source_chunks: tuple[int, ...],
@@ -119,16 +216,14 @@ def rechunking_plan(
     """
     # direct: write at target granularity, reading the covering source region
     write_chunks = tuple(min(t, s) for t, s in zip(target_chunks, shape))
-    direct_bytes = _covering_bytes(shape, write_chunks, source_chunks, itemsize)
-    if direct_bytes + math.prod(write_chunks) * itemsize <= max_mem:
+    if _copy_feasible(shape, source_chunks, write_chunks, itemsize, max_mem):
         # grow write chunks while the (recomputed) covering read still fits
         grown = write_chunks
         while True:
             candidate = _consolidate_chunks(shape, grown, itemsize, 2 * math.prod(grown) * itemsize)
             if candidate == grown:
                 break
-            cb = _covering_bytes(shape, candidate, source_chunks, itemsize)
-            if cb + math.prod(candidate) * itemsize > max_mem:
+            if not _copy_feasible(shape, source_chunks, candidate, itemsize, max_mem):
                 break
             grown = candidate
         # grown write chunks must remain aligned to the target chunk grid
@@ -212,14 +307,34 @@ def rechunk(
         ]
     if temp_store is None:
         raise ValueError("temp_store required for staged rechunk")
-    intermediate = lazy_empty(
-        shape, dtype=dtype, chunks=int_chunks, store=temp_store,
-        storage_options=storage_options,
+
+    # choose between the min-intermediate 2-pass and an N-stage geometric
+    # plan by total IO operations (the multistage plan wins on
+    # shape-transpose rechunks where the elementwise min degenerates)
+    eff_target = tuple(min(t, s) for t, s in zip(target_chunks, shape))
+    min_seq = [tuple(source_chunks), int_chunks, eff_target]
+    seq = multistage_rechunking_plan(
+        shape, tuple(source_chunks), eff_target, isz, max_mem
     )
-    op1 = _copy_op(
-        source, intermediate, int_chunks, allowed_mem, reserved_mem, tuple(source_chunks)
-    )
-    op2 = _copy_op(
-        intermediate, target, write_chunks, allowed_mem, reserved_mem, int_chunks
-    )
-    return [op1, op2]
+    if seq is None or len(seq) <= 2 or _plan_io_ops(shape, seq) >= _plan_io_ops(
+        shape, min_seq
+    ):
+        seq = min_seq
+
+    ops = []
+    prev_arr, prev_chunks = source, tuple(source_chunks)
+    for k, stage in enumerate(seq[1:], start=1):
+        last = k == len(seq) - 1
+        if last:
+            arr = target
+        else:
+            arr = lazy_empty(
+                shape, dtype=dtype, chunks=stage,
+                store=temp_store if k == 1 else f"{temp_store}-s{k}",
+                storage_options=storage_options,
+            )
+        ops.append(
+            _copy_op(prev_arr, arr, stage, allowed_mem, reserved_mem, prev_chunks)
+        )
+        prev_arr, prev_chunks = arr, stage
+    return ops

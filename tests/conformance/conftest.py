@@ -1,0 +1,31 @@
+"""Conformance-suite configuration: hypothesis profiles + shared fixtures.
+
+The property tests compare ``cubed_tpu.array_api`` against the numpy oracle
+over generated shapes/dtypes/values. PythonDagExecutor runs kernels eagerly
+(no per-example jit compiles), keeping hypothesis iteration fast.
+"""
+
+import os
+import tempfile
+
+import pytest
+from hypothesis import HealthCheck, settings
+
+settings.register_profile(
+    "conformance",
+    max_examples=int(os.environ.get("CONFORMANCE_EXAMPLES", "15")),
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow, HealthCheck.data_too_large],
+)
+settings.load_profile("conformance")
+
+
+@pytest.fixture(scope="session")
+def spec():
+    import cubed_tpu as ct
+
+    return ct.Spec(
+        work_dir=tempfile.mkdtemp(prefix="conformance-"),
+        allowed_mem="1GB",
+        reserved_mem=0,
+    )

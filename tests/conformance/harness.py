@@ -1,0 +1,87 @@
+"""Shared machinery for the hypothesis conformance suite.
+
+Role parity: the official ``data-apis/array-api-tests`` hypothesis suite the
+reference runs in CI (/root/reference/.github/workflows/array-api-tests.yml:
+28-112). That package cannot be installed here (no network egress), so this
+suite reimplements its approach — property tests driving the namespace-under-
+test against an oracle over generated inputs — with numpy 2.x (Array-API-
+aligned) as the oracle. Known divergences are pinned in SKIPS.txt.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from hypothesis import strategies as st
+from hypothesis.extra import numpy as hnp
+
+import cubed_tpu as ct
+
+#: dtype pools per Array API category
+REAL_FLOAT_DTYPES = (np.float32, np.float64)
+INT_DTYPES = (np.int8, np.int16, np.int32, np.int64)
+UINT_DTYPES = (np.uint8, np.uint16, np.uint32, np.uint64)
+NUMERIC_DTYPES = REAL_FLOAT_DTYPES + INT_DTYPES + UINT_DTYPES
+BOOL_DTYPE = (np.bool_,)
+ALL_DTYPES = NUMERIC_DTYPES + BOOL_DTYPE
+
+
+def shapes(min_dims=1, max_dims=3, max_side=7):
+    return hnp.array_shapes(
+        min_dims=min_dims, max_dims=max_dims, min_side=1, max_side=max_side
+    )
+
+
+def arrays(dtypes=REAL_FLOAT_DTYPES, shape=None, elements=None, min_dims=1):
+    """Strategy for a numpy array with finite, kernel-safe elements."""
+
+    def elems(dt):
+        dt = np.dtype(dt)
+        if elements is not None:
+            return elements
+        if dt.kind == "f":
+            # no subnormals: XLA flushes them to zero (pinned in SKIPS.txt)
+            return st.floats(
+                min_value=-1e6,
+                max_value=1e6,
+                allow_nan=False,
+                allow_infinity=False,
+                allow_subnormal=False,
+                width=dt.itemsize * 8,
+            )
+        if dt.kind == "u":
+            return st.integers(min_value=0, max_value=100)
+        if dt.kind == "i":
+            return st.integers(min_value=-100, max_value=100)
+        return st.booleans()
+
+    dtype_st = st.sampled_from(dtypes)
+    shape_st = shapes(min_dims=min_dims) if shape is None else st.just(shape)
+    return dtype_st.flatmap(
+        lambda dt: shape_st.flatmap(
+            lambda sh: hnp.arrays(dtype=dt, shape=sh, elements=elems(dt))
+        )
+    )
+
+
+def chunks_for(shape):
+    """A ragged-ish chunking: exercises edge chunks on most shapes."""
+    return tuple(max(1, (s + 1) // 2) for s in shape)
+
+
+def wrap(an, spec):
+    return ct.from_array(an, chunks=chunks_for(an.shape), spec=spec)
+
+
+def run(arr):
+    return np.asarray(arr.compute())
+
+
+def assert_matches(got: np.ndarray, expect: np.ndarray, *, exact=False):
+    """Result comparison with spec-level tolerance per dtype."""
+    assert got.shape == tuple(expect.shape), (got.shape, expect.shape)
+    assert got.dtype == expect.dtype, (got.dtype, expect.dtype)
+    if exact or expect.dtype.kind in "biu":
+        np.testing.assert_array_equal(got, expect)
+    else:
+        rtol = 1e-4 if expect.dtype.itemsize <= 4 else 1e-9
+        np.testing.assert_allclose(got, expect, rtol=rtol, atol=1e-30, equal_nan=True)

@@ -1,0 +1,260 @@
+"""Manipulation, creation, indexing, and dtype-function conformance against
+the numpy oracle.
+
+Parity role: array-api-tests test_manipulation_functions.py /
+test_creation_functions.py / test_indexing_functions.py /
+test_data_type_functions.py.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+from hypothesis import given
+from hypothesis import strategies as st
+from hypothesis.extra import numpy as hnp
+
+import cubed_tpu.array_api as xp
+
+from .harness import (
+    ALL_DTYPES,
+    NUMERIC_DTYPES,
+    REAL_FLOAT_DTYPES,
+    arrays,
+    assert_matches,
+    run,
+    wrap,
+)
+
+# ---------------------------------------------------------------------------
+# manipulation
+# ---------------------------------------------------------------------------
+
+
+@given(data=st.data())
+def test_concat(data, spec):
+    shape = data.draw(hnp.array_shapes(min_dims=1, max_dims=3, min_side=1, max_side=5))
+    axis = data.draw(st.integers(min_value=0, max_value=len(shape) - 1))
+    parts = data.draw(st.integers(min_value=2, max_value=3))
+    arrs = [data.draw(arrays(dtypes=(np.float64,), shape=shape)) for _ in range(parts)]
+    got = run(xp.concat([wrap(a, spec) for a in arrs], axis=axis))
+    assert_matches(got, np.concatenate(arrs, axis=axis))
+
+
+@given(data=st.data())
+def test_stack(data, spec):
+    shape = data.draw(hnp.array_shapes(min_dims=1, max_dims=2, min_side=1, max_side=5))
+    axis = data.draw(st.integers(min_value=0, max_value=len(shape)))
+    arrs = [data.draw(arrays(dtypes=(np.float64,), shape=shape)) for _ in range(2)]
+    got = run(xp.stack([wrap(a, spec) for a in arrs], axis=axis))
+    assert_matches(got, np.stack(arrs, axis=axis))
+
+
+@given(data=st.data())
+def test_permute_dims(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,), min_dims=2))
+    perm = data.draw(st.permutations(range(an.ndim)))
+    got = run(xp.permute_dims(wrap(an, spec), tuple(perm)))
+    assert_matches(got, np.transpose(an, perm))
+
+
+@given(data=st.data())
+def test_reshape(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,)))
+    # a compatible target: regroup the flat size into 1-3 factors
+    n = an.size
+    f1 = data.draw(st.sampled_from([d for d in range(1, n + 1) if n % d == 0]))
+    rest = n // f1
+    target = data.draw(st.sampled_from([(n,), (f1, rest), (f1, rest, 1)]))
+    got = run(xp.reshape(wrap(an, spec), target))
+    assert_matches(got, an.reshape(target))
+
+
+@given(data=st.data())
+def test_expand_squeeze_roundtrip(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,)))
+    axis = data.draw(st.integers(min_value=0, max_value=an.ndim))
+    expanded = xp.expand_dims(wrap(an, spec), axis=axis)
+    got = run(xp.squeeze(expanded, axis=axis))
+    assert_matches(got, an)
+
+
+@given(data=st.data())
+def test_flip(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,)))
+    axis = data.draw(st.one_of(st.none(), st.integers(0, an.ndim - 1)))
+    got = run(xp.flip(wrap(an, spec), axis=axis))
+    assert_matches(got, np.flip(an, axis=axis))
+
+
+@given(data=st.data())
+def test_roll(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,)))
+    shift = data.draw(st.integers(min_value=-7, max_value=7))
+    axis = data.draw(st.one_of(st.none(), st.integers(0, an.ndim - 1)))
+    got = run(xp.roll(wrap(an, spec), shift, axis=axis))
+    assert_matches(got, np.roll(an, shift, axis=axis))
+
+
+@given(data=st.data())
+def test_broadcast_to(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,)))
+    lead = data.draw(st.integers(min_value=1, max_value=3))
+    target = (lead,) + an.shape
+    got = run(xp.broadcast_to(wrap(an, spec), target))
+    assert_matches(got, np.broadcast_to(an, target))
+
+
+@given(data=st.data())
+def test_moveaxis(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,), min_dims=2))
+    src = data.draw(st.integers(0, an.ndim - 1))
+    dst = data.draw(st.integers(0, an.ndim - 1))
+    got = run(xp.moveaxis(wrap(an, spec), src, dst))
+    assert_matches(got, np.moveaxis(an, src, dst))
+
+
+@given(data=st.data())
+def test_repeat(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,)))
+    reps = data.draw(st.integers(min_value=1, max_value=3))
+    axis = data.draw(st.integers(0, an.ndim - 1))
+    got = run(xp.repeat(wrap(an, spec), reps, axis=axis))
+    assert_matches(got, np.repeat(an, reps, axis=axis))
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+
+@given(data=st.data())
+def test_arange(data, spec):
+    start = data.draw(st.integers(min_value=-20, max_value=20))
+    stop = data.draw(st.integers(min_value=start + 1, max_value=start + 40))
+    step = data.draw(st.integers(min_value=1, max_value=5))
+    got = run(xp.arange(start, stop, step, chunks=4, spec=spec))
+    assert_matches(got, np.arange(start, stop, step, dtype=got.dtype))
+
+
+@given(data=st.data())
+def test_linspace(data, spec):
+    start = data.draw(st.floats(min_value=-100, max_value=100, allow_nan=False))
+    stop = data.draw(st.floats(min_value=-100, max_value=100, allow_nan=False))
+    num = data.draw(st.integers(min_value=2, max_value=20))
+    endpoint = data.draw(st.booleans())
+    got = run(xp.linspace(start, stop, num, chunks=4, spec=spec, endpoint=endpoint))
+    assert_matches(got, np.linspace(start, stop, num, endpoint=endpoint))
+
+
+@given(data=st.data())
+def test_eye(data, spec):
+    n = data.draw(st.integers(min_value=1, max_value=8))
+    m = data.draw(st.one_of(st.none(), st.integers(min_value=1, max_value=8)))
+    k = data.draw(st.integers(min_value=-3, max_value=3))
+    got = run(xp.eye(n, m, k=k, chunks=3, spec=spec))
+    assert_matches(got, np.eye(n, m, k=k))
+
+
+@given(data=st.data())
+def test_full_ones_zeros(data, spec):
+    shape = data.draw(hnp.array_shapes(min_dims=1, max_dims=3, min_side=1, max_side=5))
+    fill = data.draw(st.floats(min_value=-10, max_value=10, allow_nan=False))
+    got = run(xp.full(shape, fill, chunks=2, spec=spec))
+    assert_matches(got, np.full(shape, fill))
+    assert_matches(run(xp.ones(shape, chunks=2, spec=spec)), np.ones(shape))
+    assert_matches(run(xp.zeros(shape, chunks=2, spec=spec)), np.zeros(shape))
+
+
+@pytest.mark.parametrize("fn", ["tril", "triu"])
+@given(data=st.data())
+def test_tril_triu(fn, data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,), shape=(5, 6)))
+    k = data.draw(st.integers(min_value=-4, max_value=4))
+    got = run(getattr(xp, fn)(wrap(an, spec), k=k))
+    assert_matches(got, getattr(np, fn)(an, k=k))
+
+
+@given(data=st.data())
+def test_asarray_roundtrip(data, spec):
+    an = data.draw(arrays(dtypes=ALL_DTYPES))
+    got = run(xp.asarray(an, chunks=3, spec=spec))
+    assert_matches(got, an, exact=True)
+
+
+# ---------------------------------------------------------------------------
+# indexing / take
+# ---------------------------------------------------------------------------
+
+
+@given(data=st.data())
+def test_basic_slicing(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,)))
+    key = tuple(
+        data.draw(st.slices(size), label=f"slice{d}")
+        for d, size in enumerate(an.shape)
+    )
+    expect = an[key]
+    if 0 in expect.shape:
+        return  # empty selections unsupported (pinned in SKIPS.txt)
+    got = run(wrap(an, spec)[key])
+    assert_matches(got, expect)
+
+
+@given(data=st.data())
+def test_take(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,)))
+    axis = data.draw(st.integers(0, an.ndim - 1))
+    idx = data.draw(
+        st.lists(
+            st.integers(min_value=0, max_value=an.shape[axis] - 1),
+            min_size=1,
+            max_size=6,
+            unique=True,
+        ).map(sorted)
+    )
+    got = run(xp.take(wrap(an, spec), np.asarray(idx), axis=axis))
+    assert_matches(got, np.take(an, idx, axis=axis))
+
+
+# ---------------------------------------------------------------------------
+# dtype functions
+# ---------------------------------------------------------------------------
+
+
+@given(data=st.data())
+def test_astype(data, spec):
+    an = data.draw(arrays(dtypes=REAL_FLOAT_DTYPES))
+    target = data.draw(st.sampled_from(NUMERIC_DTYPES))
+    if np.dtype(target).kind in "iu":
+        an = np.trunc(an) % 100  # in-range, exact
+    got = run(xp.astype(wrap(an, spec), target))
+    assert_matches(got, an.astype(target))
+
+
+@given(data=st.data())
+def test_result_type_matches_numpy(data):
+    dt1 = data.draw(st.sampled_from(NUMERIC_DTYPES))
+    dt2 = data.draw(st.sampled_from(NUMERIC_DTYPES))
+    try:
+        expect = np.result_type(np.dtype(dt1), np.dtype(dt2))
+    except TypeError:
+        return
+    if np.dtype(dt1).kind != np.dtype(dt2).kind and expect.kind == "f":
+        return  # cross-kind promotion to float is numpy-specific, spec-undefined
+    got = xp.result_type(np.dtype(dt1), np.dtype(dt2))
+    assert np.dtype(got) == expect, (dt1, dt2, got, expect)
+
+
+def test_finfo_iinfo_fields():
+    for dt in REAL_FLOAT_DTYPES:
+        f = xp.finfo(dt)
+        nf = np.finfo(dt)
+        assert f.bits == nf.bits and f.max == nf.max and f.min == nf.min
+        assert math.isclose(f.eps, float(nf.eps))
+    for dt in (np.int8, np.int32, np.uint16, np.uint64):
+        i = xp.iinfo(dt)
+        ni = np.iinfo(dt)
+        assert i.bits == ni.bits and i.max == ni.max and i.min == ni.min

@@ -1,0 +1,26 @@
+"""Pinned shapes for arg-reduction keepdims (spec: axis=None + keepdims=True
+restores every reduced axis as a singleton) — caught by the hypothesis suite."""
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+
+
+@pytest.mark.parametrize("name", ["argmax", "argmin"])
+@pytest.mark.parametrize("axis,keepdims,expect_shape", [
+    (None, False, ()),
+    (None, True, (1, 1)),
+    (0, False, (3,)),
+    (0, True, (1, 3)),
+    (1, False, (2,)),
+    (1, True, (2, 1)),
+])
+def test_arg_reduction_keepdims_shapes(name, axis, keepdims, expect_shape, spec):
+    an = np.arange(6.0).reshape(2, 3)
+    a = ct.from_array(an, chunks=(1, 2), spec=spec)
+    got = np.asarray(getattr(xp, name)(a, axis=axis, keepdims=keepdims).compute())
+    assert got.shape == expect_shape, (got.shape, expect_shape)
+    flat = getattr(np, name)(an) if axis is None else getattr(np, name)(an, axis=axis)
+    np.testing.assert_array_equal(got.reshape(np.asarray(flat).shape), flat)

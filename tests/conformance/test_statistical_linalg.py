@@ -1,0 +1,165 @@
+"""Statistical + linear-algebra conformance against the numpy oracle.
+
+Parity role: array-api-tests test_statistical_functions.py / test_linalg.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+from hypothesis import given
+from hypothesis import strategies as st
+from hypothesis.extra import numpy as hnp
+
+import cubed_tpu.array_api as xp
+
+from .harness import (
+    INT_DTYPES,
+    REAL_FLOAT_DTYPES,
+    arrays,
+    assert_matches,
+    run,
+    wrap,
+)
+
+
+def axes_for(ndim):
+    return st.one_of(
+        st.none(),
+        st.integers(min_value=-ndim, max_value=ndim - 1),
+        st.lists(
+            st.integers(min_value=0, max_value=ndim - 1),
+            min_size=1,
+            max_size=ndim,
+            unique=True,
+        ).map(tuple),
+    )
+
+
+@pytest.mark.parametrize("name", ["sum", "prod", "max", "min", "mean"])
+@given(data=st.data())
+def test_reduction(name, data, spec):
+    elements = (
+        st.floats(min_value=-2.0, max_value=2.0, allow_nan=False, width=32)
+        if name == "prod"
+        else None
+    )
+    an = data.draw(arrays(dtypes=REAL_FLOAT_DTYPES, elements=elements))
+    axis = data.draw(axes_for(an.ndim))
+    keepdims = data.draw(st.booleans())
+    got = run(getattr(xp, name)(wrap(an, spec), axis=axis, keepdims=keepdims))
+    expect = getattr(np, name)(an, axis=axis, keepdims=keepdims)
+    assert_matches(got, np.asarray(expect))
+
+
+@pytest.mark.parametrize("name", ["sum", "prod"])
+@given(data=st.data())
+def test_reduction_int_upcasts_to_64bit(name, data, spec):
+    # spec: sum/prod of intN accumulates in the 64-bit type of the same kind
+    an = data.draw(arrays(dtypes=INT_DTYPES))
+    got = run(getattr(xp, name)(wrap(an, spec)))
+    expect = np.asarray(getattr(np, name)(an, dtype=np.int64))
+    assert_matches(got, expect)
+
+
+@pytest.mark.parametrize("name", ["std", "var"])
+@given(data=st.data())
+def test_std_var(name, data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,)))
+    axis = data.draw(axes_for(an.ndim))
+    correction = data.draw(st.sampled_from([0.0, 1.0]))
+    # correction must leave at least one free element along reduced axes
+    reduced = (
+        an.size
+        if axis is None
+        else int(np.prod([an.shape[a] for a in (axis if isinstance(axis, tuple) else (axis,))]))
+    )
+    if reduced <= int(correction):
+        correction = 0.0
+    got = run(getattr(xp, name)(wrap(an, spec), axis=axis, correction=correction))
+    expect = np.asarray(getattr(np, name)(an, axis=axis, ddof=int(correction)))
+    assert got.shape == expect.shape and got.dtype == expect.dtype
+    # catastrophic cancellation makes tiny variances implementation-noise
+    # (Welford-combined vs numpy two-pass); compare at the data's own scale
+    scale = float(np.max(np.abs(an)) ** (2 if name == "var" else 1)) + 1.0
+    np.testing.assert_allclose(got, expect, rtol=1e-8, atol=1e-12 * scale)
+
+
+@given(data=st.data())
+def test_matmul_2d(data, spec):
+    m, k, n = (
+        data.draw(st.integers(min_value=1, max_value=6)) for _ in range(3)
+    )
+    an = data.draw(arrays(dtypes=(np.float64,), shape=(m, k)))
+    bn = data.draw(arrays(dtypes=(np.float64,), shape=(k, n)))
+    got = run(xp.matmul(wrap(an, spec), wrap(bn, spec)))
+    assert_matches(got, an @ bn)
+
+
+@given(data=st.data())
+def test_tensordot(data, spec):
+    k = data.draw(st.integers(min_value=1, max_value=4))
+    an = data.draw(arrays(dtypes=(np.float64,), shape=(3, k)))
+    bn = data.draw(arrays(dtypes=(np.float64,), shape=(k, 2)))
+    got = run(xp.tensordot(wrap(an, spec), wrap(bn, spec), axes=1))
+    assert_matches(got, np.tensordot(an, bn, axes=1))
+
+
+@given(data=st.data())
+def test_vecdot(data, spec):
+    shape = data.draw(hnp.array_shapes(min_dims=1, max_dims=3, min_side=1, max_side=5))
+    an = data.draw(arrays(dtypes=(np.float64,), shape=shape))
+    bn = data.draw(arrays(dtypes=(np.float64,), shape=shape))
+    got = run(xp.vecdot(wrap(an, spec), wrap(bn, spec)))
+    assert_matches(got, np.vecdot(an, bn))
+
+
+@given(data=st.data())
+def test_outer(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,), min_dims=1, shape=(4,)))
+    bn = data.draw(arrays(dtypes=(np.float64,), min_dims=1, shape=(3,)))
+    got = run(xp.outer(wrap(an, spec), wrap(bn, spec)))
+    assert_matches(got, np.outer(an, bn))
+
+
+@given(data=st.data())
+def test_matrix_transpose(data, spec):
+    shape = data.draw(hnp.array_shapes(min_dims=2, max_dims=3, min_side=1, max_side=5))
+    an = data.draw(arrays(dtypes=(np.float64,), shape=shape))
+    got = run(xp.matrix_transpose(wrap(an, spec)))
+    assert_matches(got, np.swapaxes(an, -1, -2))
+
+
+@pytest.mark.parametrize("name", ["argmax", "argmin"])
+@given(data=st.data())
+def test_arg_reduction(name, data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,)))
+    axis = data.draw(st.one_of(st.none(), st.integers(0, an.ndim - 1)))
+    keepdims = data.draw(st.booleans())
+    got = run(getattr(xp, name)(wrap(an, spec), axis=axis, keepdims=keepdims))
+    if axis is None:
+        expect = np.asarray(getattr(np, name)(an))
+        if keepdims:
+            expect = expect.reshape((1,) * an.ndim)
+    else:
+        expect = getattr(np, name)(an, axis=axis, keepdims=keepdims)
+    assert_matches(got, np.asarray(expect))
+
+
+@pytest.mark.parametrize("name", ["all", "any"])
+@given(data=st.data())
+def test_utility(name, data, spec):
+    an = data.draw(arrays(dtypes=(np.bool_,)))
+    axis = data.draw(axes_for(an.ndim))
+    got = run(getattr(xp, name)(wrap(an, spec), axis=axis))
+    assert_matches(got, np.asarray(getattr(np, name)(an, axis=axis)))
+
+
+@given(data=st.data())
+def test_where(data, spec):
+    shape = data.draw(hnp.array_shapes(min_dims=1, max_dims=3, min_side=1, max_side=5))
+    cn = data.draw(arrays(dtypes=(np.bool_,), shape=shape))
+    an = data.draw(arrays(dtypes=(np.float64,), shape=shape))
+    bn = data.draw(arrays(dtypes=(np.float64,), shape=shape))
+    got = run(xp.where(wrap(cn, spec), wrap(an, spec), wrap(bn, spec)))
+    assert_matches(got, np.where(cn, an, bn))

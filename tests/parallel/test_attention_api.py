@@ -1,0 +1,56 @@
+"""The user-facing attention bridge (cubed_tpu.parallel.attention):
+cubed arrays in, cubed array out, ring-parallel under a mesh."""
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+from cubed_tpu.parallel import attention, make_mesh
+from cubed_tpu.parallel.ring_attention import dense_attention
+
+
+def _cpu_devices():
+    import jax
+
+    try:
+        return jax.devices("cpu")
+    except RuntimeError:
+        return []
+
+
+needs_8 = pytest.mark.skipif(
+    len(_cpu_devices()) < 8, reason="needs 8 virtual CPU devices"
+)
+
+
+def _qkv(spec, B=2, S=16, H=2, D=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: rng.standard_normal((B, S, H, D)).astype(np.float32)
+    qn, kn, vn = mk(), mk(), mk()
+    wrap = lambda an: ct.from_array(an, chunks=(B, S // 2, H, D), spec=spec)
+    return (qn, kn, vn), (wrap(qn), wrap(kn), wrap(vn))
+
+
+def test_attention_dense_single_device(spec):
+    (qn, kn, vn), (q, k, v) = _qkv(spec)
+    out = attention(q, k, v)
+    expect = np.asarray(dense_attention(qn, kn, vn))
+    got = np.asarray(out.compute())
+    assert out.chunksize == q.chunksize
+    np.testing.assert_allclose(got, expect, atol=2e-5)
+
+
+@needs_8
+@pytest.mark.parametrize("causal", [False, True])
+def test_attention_ring_over_mesh(spec, causal):
+    mesh = make_mesh(shape=(8,), axis_names=("seq",), devices=_cpu_devices()[:8])
+    (qn, kn, vn), (q, k, v) = _qkv(spec)
+    out = attention(q, k, v, causal=causal, mesh=mesh)
+    expect = np.asarray(dense_attention(qn, kn, vn, causal=causal))
+    np.testing.assert_allclose(np.asarray(out.compute()), expect, atol=2e-5)
+
+
+def test_attention_rejects_bad_rank(spec):
+    a = ct.from_array(np.zeros((4, 4)), chunks=(2, 2), spec=spec)
+    with pytest.raises(ValueError):
+        attention(a, a, a)

@@ -134,3 +134,95 @@ def test_fill_value(tmp_path):
     )
     out = z[...]
     assert np.isnan(out).all()
+
+
+# ---------------------------------------------------------------------------
+# Zarr v2 spec golden files: the on-disk format is the interchange contract
+# (other implementations must be able to read our stores); these pin the
+# exact metadata JSON so any drift fails loudly. Spec reference:
+# https://zarr-specs.readthedocs.io/en/latest/v2/v2.0.html
+# ---------------------------------------------------------------------------
+
+
+def test_zarray_metadata_golden(tmp_path):
+    import json
+    import os
+
+    a = open_zarr_array(
+        str(tmp_path / "g.zarr"), mode="w",
+        shape=(10, 7), dtype=np.dtype("float64"), chunks=(4, 3),
+    )
+    a[...] = np.arange(70.0).reshape(10, 7)
+    meta = json.loads((tmp_path / "g.zarr" / ".zarray").read_text())
+    assert meta == {
+        "zarr_format": 2,
+        "shape": [10, 7],
+        "chunks": [4, 3],
+        "dtype": "<f8",
+        "compressor": None,
+        "fill_value": 0.0,
+        "order": "C",
+        "filters": None,
+        "dimension_separator": ".",
+    }
+    # v2 mandatory keys, exactly (no extras that could confuse readers)
+    assert set(meta) == {
+        "zarr_format", "shape", "chunks", "dtype", "compressor",
+        "fill_value", "order", "filters", "dimension_separator",
+    }
+
+
+@pytest.mark.parametrize(
+    "np_dtype,v2_dtype",
+    [("float32", "<f4"), ("int64", "<i8"), ("uint8", "|u1"), ("bool", "|b1"),
+     ("int16", "<i2"), ("complex128", "<c16")],
+)
+def test_zarray_dtype_encoding(tmp_path, np_dtype, v2_dtype):
+    import json
+
+    a = open_zarr_array(
+        str(tmp_path / f"d-{np_dtype}.zarr"), mode="w",
+        shape=(4,), dtype=np.dtype(np_dtype), chunks=(2,),
+    )
+    meta = json.loads((tmp_path / f"d-{np_dtype}.zarr" / ".zarray").read_text())
+    assert meta["dtype"] == v2_dtype
+
+
+def test_zarray_structured_dtype_encoding(tmp_path):
+    import json
+
+    dt = np.dtype([("n", np.int64), ("total", np.float64)])
+    a = open_zarr_array(
+        str(tmp_path / "s.zarr"), mode="w", shape=(4,), dtype=dt, chunks=(2,),
+    )
+    meta = json.loads((tmp_path / "s.zarr" / ".zarray").read_text())
+    # v2 structured dtypes are lists of [name, dtype] pairs
+    assert meta["dtype"] == [["n", "<i8"], ["total", "<f8"]]
+
+
+def test_raw_chunk_layout_c_order_readback(tmp_path):
+    """Chunk files are raw C-order buffers a third-party v2 reader decodes
+    with nothing but the .zarray JSON."""
+    import json
+    import os
+
+    an = np.arange(70.0).reshape(10, 7)
+    a = open_zarr_array(
+        str(tmp_path / "r.zarr"), mode="w",
+        shape=(10, 7), dtype=np.dtype("float64"), chunks=(4, 3),
+    )
+    a[...] = an
+    meta = json.loads((tmp_path / "r.zarr" / ".zarray").read_text())
+    chunks = meta["chunks"]
+    sep = meta["dimension_separator"]
+    # reconstruct the full array exactly the way an independent reader would
+    out = np.empty(meta["shape"], dtype=meta["dtype"])
+    for ci in range((meta["shape"][0] + chunks[0] - 1) // chunks[0]):
+        for cj in range((meta["shape"][1] + chunks[1] - 1) // chunks[1]):
+            raw = (tmp_path / "r.zarr" / f"{ci}{sep}{cj}").read_bytes()
+            block = np.frombuffer(raw, dtype=meta["dtype"]).reshape(chunks)
+            i0, j0 = ci * chunks[0], cj * chunks[1]
+            i1 = min(i0 + chunks[0], meta["shape"][0])
+            j1 = min(j0 + chunks[1], meta["shape"][1])
+            out[i0:i1, j0:j1] = block[: i1 - i0, : j1 - j0]
+    np.testing.assert_array_equal(out, an)

@@ -140,3 +140,128 @@ def test_fused_different_chunk_elementwise(spec):
     b = ct.from_array(an, chunks=(6, 6), spec=spec)
     c = xp.add(a, b)
     np.testing.assert_allclose(c.compute(), an * 2)
+
+
+# ---------------------------------------------------------------------------
+# exact num_ops / num_tasks / num_arrays deltas per fusion shape (reference:
+# cubed/tests/test_optimization.py:492-684 asserts the same count matrix)
+# ---------------------------------------------------------------------------
+
+
+def counts(arr, optimize_function=None, optimize_graph=True):
+    plan = arr.plan
+    return (
+        num_ops(plan, optimize_function=optimize_function, optimize_graph=optimize_graph),
+        plan.num_tasks(optimize_graph=optimize_graph, optimize_function=optimize_function),
+        plan.num_arrays(optimize_graph=optimize_graph, optimize_function=optimize_function),
+    )
+
+
+def test_unary_chain_exact_counts(spec):
+    # ones(virtual) -> neg -> neg -> neg: 3 blockwise ops collapse to 1
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    d = xp.negative(xp.negative(xp.negative(a)))
+    ops_un, tasks_un, arrays_un = counts(d, optimize_graph=False)
+    ops_opt, tasks_opt, arrays_opt = counts(d, optimize_function=multiple_inputs_optimize_dag)
+    assert ops_un - ops_opt == 2       # two producer ops fused away
+    assert arrays_un - arrays_opt == 2  # their intermediate arrays vanish
+    assert tasks_opt == 10              # 3x3 block grid + the create-arrays task
+    assert tasks_un == 30               # 9 per op + 3 create-arrays tasks
+    np.testing.assert_array_equal(d.compute(), np.full((6, 6), -1.0))
+
+
+def test_fan_in_exact_counts(spec):
+    # 4 independent sources -> binary tree of adds: all fuse into one op
+    arrs = [xp.ones((4, 4), chunks=(2, 2), spec=spec) for _ in range(4)]
+    s = xp.add(xp.add(arrs[0], arrs[1]), xp.add(arrs[2], arrs[3]))
+    ops_un, tasks_un, _ = counts(s, optimize_graph=False)
+    ops_opt, tasks_opt, _ = counts(s, optimize_function=multiple_inputs_optimize_dag)
+    assert tasks_un == 3 * 4 + 3  # 3 add ops x 4 blocks + 3 create-arrays tasks
+    assert tasks_opt == 5     # one fused op over the 2x2 grid + create-arrays
+    assert ops_un - ops_opt == 2
+    np.testing.assert_array_equal(s.compute(), np.full((4, 4), 4.0))
+
+
+def test_fan_in_gate_blocks_wide_fusion(spec):
+    # 5 sources exceeds max_total_source_arrays=4: top add keeps distinct
+    # predecessors under the default gate, fuses under an explicit override
+    arrs = [xp.ones((4, 4), chunks=(2, 2), spec=spec) for _ in range(5)]
+    s = xp.add(
+        xp.add(xp.add(arrs[0], arrs[1]), xp.add(arrs[2], arrs[3])), arrs[4]
+    )
+    import functools
+
+    gated = functools.partial(multiple_inputs_optimize_dag, max_total_source_arrays=4)
+    wide = functools.partial(multiple_inputs_optimize_dag, max_total_source_arrays=5)
+    ops_gated, tasks_gated, _ = counts(s, optimize_function=gated)
+    ops_wide, tasks_wide, _ = counts(s, optimize_function=wide)
+    assert tasks_wide == 5           # fully fused: one op, 4 blocks + create-arrays
+    assert ops_wide < ops_gated      # the gate left at least one op unfused
+    assert tasks_gated > tasks_wide
+    np.testing.assert_array_equal(
+        s.compute(optimize_function=wide), np.full((4, 4), 5.0)
+    )
+
+
+def test_never_fuse_override_pins_op(spec):
+    a = xp.ones((4, 4), chunks=(2, 2), spec=spec)
+    b = xp.negative(a)
+    c = xp.negative(b)
+    import functools
+
+    # find c's producing op name: the last op node in the unoptimized dag
+    dag = c.plan._finalize(optimize_graph=False).dag
+    op_of_c = [
+        n for n, d in dag.nodes(data=True)
+        if d.get("type") == "op" and any(s == c.name for s in dag.successors(n))
+    ]
+    assert len(op_of_c) == 1
+    never = functools.partial(
+        multiple_inputs_optimize_dag, never_fuse={op_of_c[0]}
+    )
+    ops_plain, tasks_plain, _ = counts(c, optimize_function=multiple_inputs_optimize_dag)
+    ops_never, tasks_never, _ = counts(c, optimize_function=never)
+    assert tasks_plain == 5          # neg-neg fused over 2x2 blocks + create-arrays
+    assert tasks_never == 10         # pinned op stays separate (+2 creates)
+    assert ops_never == ops_plain + 1
+    np.testing.assert_array_equal(
+        c.compute(optimize_function=never), np.full((4, 4), 1.0)
+    )
+
+
+def test_repeated_argument_fuses_once(spec):
+    # the same predecessor array consumed twice by one op (multigraph edge)
+    a = xp.ones((4, 4), chunks=(2, 2), spec=spec)
+    b = xp.negative(a)
+    c = xp.add(b, b)
+    ops_un, tasks_un, _ = counts(c, optimize_graph=False)
+    ops_opt, tasks_opt, _ = counts(c, optimize_function=multiple_inputs_optimize_dag)
+    assert tasks_opt == 5  # fused op's 4 blocks + create-arrays
+    assert ops_un - ops_opt == 1
+    np.testing.assert_array_equal(c.compute(), np.full((4, 4), -2.0))
+
+
+def test_other_dependent_keeps_producer_alive(spec):
+    # b is consumed by c AND persisted separately: the producer can't vanish
+    an = np.arange(16.0).reshape(4, 4)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = xp.negative(a)
+    c = xp.negative(b)
+    # computing BOTH b and c: b's op must survive optimization
+    from cubed_tpu.core.array import compute as compute_multi
+
+    res_b, res_c = compute_multi(b, c, optimize_function=multiple_inputs_optimize_dag)
+    np.testing.assert_allclose(np.asarray(res_b), -an)
+    np.testing.assert_allclose(np.asarray(res_c), an)
+
+
+def test_mixed_levels_partial_fusion_counts(spec):
+    # reduction output feeding elementwise: the reduce op can't fuse into its
+    # consumer (different task grids) but the elementwise tail fuses
+    a = xp.ones((8, 8), chunks=(2, 2), spec=spec)
+    s = xp.sum(a, axis=0)           # tree-reduce: multiple ops
+    t = xp.negative(xp.negative(s))  # fusable tail
+    ops_un, _, _ = counts(t, optimize_graph=False)
+    ops_opt, _, _ = counts(t, optimize_function=multiple_inputs_optimize_dag)
+    assert ops_un - ops_opt >= 1     # at least the tail pair fused
+    np.testing.assert_array_equal(t.compute(), np.full((8,), 8.0))

@@ -107,6 +107,9 @@ def test_fused_structured_mean_intermediates(spec):
 
 
 def test_stats_fused_elementwise_counts(spec):
+    from cubed_tpu.runtime.executors import jax as jxm
+
+    jxm._STRUCT_CACHE.clear()  # force a real trace so path counters fire
     an = np.arange(64, dtype=np.float64).reshape(8, 8)
     a = ct.from_array(an, chunks=(4, 4), spec=spec)
     b = ct.from_array(an, chunks=(4, 4), spec=spec)
@@ -140,7 +143,8 @@ def test_stats_vorticity_plan_fully_fused(spec):
 
 def test_stats_segment_cache_hit_on_recompute(spec):
     # same plan structure twice: the second compute reuses the compiled
-    # segment executable (traced again, compiled never)
+    # executable — via the structural fingerprint (no re-trace) or, with the
+    # structural layer disabled, via the HLO hash (re-trace, no re-compile)
     an = np.arange(36, dtype=np.float64).reshape(6, 6)
 
     def build():
@@ -154,7 +158,9 @@ def test_stats_segment_cache_hit_on_recompute(spec):
     assert v1 == v2
     assert ex1.stats["segments_traced"] == 1
     assert ex2.stats["segments_traced"] == 1
-    assert ex2.stats["segment_cache_hits"] == 1
+    assert (
+        ex2.stats["segment_cache_hits"] + ex2.stats["segment_struct_hits"] == 1
+    )
     assert ex2.stats["segments_compiled"] == 0
 
 
@@ -182,6 +188,109 @@ def test_stats_reported_via_compute_end_event(spec):
     xp.sum(a).compute(executor=ex, callbacks=[Capture()])
     assert seen["stats"] is ex.stats
     assert seen["stats"]["segments_traced"] == 1
+
+
+# ---------------------------------------------------------------------------
+# structural segment cache: repeat computes of identical plan shapes must
+# skip tracing, rebind seeds, and never alias across different programs
+# ---------------------------------------------------------------------------
+
+
+def test_struct_cache_hit_skips_trace_and_rebinds_seed(spec):
+    from cubed_tpu.runtime.executors import jax as jxm
+
+    jxm._STRUCT_CACHE.clear()
+
+    def build():
+        r = cubed_tpu.random.random((24, 24), chunks=6, spec=spec)
+        return xp.mean(xp.multiply(r, 1.618))
+
+    ex1, ex2 = JaxExecutor(), JaxExecutor()
+    v1 = float(build().compute(executor=ex1))
+    v2 = float(build().compute(executor=ex2))
+    assert ex1.stats["segment_struct_hits"] == 0
+    assert ex1.stats["segments_traced"] == 1
+    assert ex2.stats["segment_struct_hits"] == 1  # tracing skipped entirely
+    assert ex2.stats["segments_compiled"] == 0
+    # both runs valid, and the DIFFERENT per-plan seed was rebound (the
+    # cached program did not bake the first plan's randomness)
+    assert 0.4 < v1 / 1.618 < 0.6 and 0.4 < v2 / 1.618 < 0.6
+    assert v1 != v2
+
+
+def test_struct_cache_distinguishes_kernel_constants(spec):
+    from cubed_tpu.runtime.executors import jax as jxm
+
+    jxm._STRUCT_CACHE.clear()
+    an = np.arange(16.0).reshape(4, 4)
+
+    def build(c):
+        a = ct.from_array(an, chunks=(2, 2), spec=spec)
+        return xp.sum(xp.multiply(a, c))
+
+    ex1, ex2 = JaxExecutor(), JaxExecutor()
+    v1 = float(build(2.0).compute(executor=ex1))
+    v2 = float(build(3.0).compute(executor=ex2))
+    assert ex2.stats["segment_struct_hits"] == 0  # different program
+    assert v1 == an.sum() * 2 and v2 == an.sum() * 3
+
+
+def test_struct_cache_distinguishes_chunking(spec):
+    from cubed_tpu.runtime.executors import jax as jxm
+
+    jxm._STRUCT_CACHE.clear()
+    an = np.arange(64.0).reshape(8, 8)
+
+    def build(chunks):
+        a = ct.from_array(an, chunks=chunks, spec=spec)
+        return xp.sum(xp.negative(a))
+
+    v1 = float(build((2, 2)).compute(executor=JaxExecutor()))
+    ex2 = JaxExecutor()
+    v2 = float(build((4, 4)).compute(executor=ex2))
+    assert ex2.stats["segment_struct_hits"] == 0
+    assert v1 == v2 == -an.sum()
+
+
+def test_struct_cache_no_collision_on_gensym_like_user_strings(spec):
+    # user closure strings that merely LOOK like gensym identifiers must not
+    # normalize away: only this plan's own names are canonicalized
+    from cubed_tpu.runtime.executors import jax as jxm
+
+    jxm._STRUCT_CACHE.clear()
+    an = np.full((4, 4), 2.0)
+
+    def build(tag):
+        def kernel(block):
+            return block * len(tag.split("-")[1])
+
+        a = ct.from_array(an, chunks=(2, 2), spec=spec)
+        return xp.sum(ct.map_blocks(kernel, a, dtype=a.dtype))
+
+    ex1, ex2 = JaxExecutor(), JaxExecutor()
+    v1 = float(build("exp-0010").compute(executor=ex1))
+    v2 = float(build("exp-009876").compute(executor=ex2))
+    assert v1 == an.sum() * 4
+    assert v2 == an.sum() * 6  # a struct-cache collision would return *4
+
+
+def test_struct_cache_hit_matches_fresh_result(spec):
+    from cubed_tpu.runtime.executors import jax as jxm
+
+    jxm._STRUCT_CACHE.clear()
+    an = np.arange(36.0).reshape(6, 6)
+
+    def build():
+        a = ct.from_array(an, chunks=(2, 3), spec=spec)
+        b = ct.from_array(an + 1, chunks=(2, 3), spec=spec)
+        return xp.mean(xp.add(xp.multiply(a, 0.5), b))
+
+    v1 = np.asarray(build().compute(executor=JaxExecutor()))
+    ex2 = JaxExecutor()
+    v2 = np.asarray(build().compute(executor=ex2))
+    assert ex2.stats["segment_struct_hits"] == 1
+    np.testing.assert_allclose(v1, (an * 0.5 + an + 1).mean())
+    np.testing.assert_allclose(v2, v1)
 
 
 def test_fused_output_also_persisted(spec, tmp_path):
