@@ -33,9 +33,9 @@ from . import array_api  # noqa: F401
 from .array_api import Array  # noqa: F401  (reference: cubed/__init__.py)
 from . import random  # noqa: F401
 
-__version__ = "0.1.0"
-
 __all__ = [
+    "__version__",
+    "Array",
     "Spec",
     "Callback",
     "TaskEndEvent",

@@ -62,7 +62,13 @@ def attention(
     if qn.ndim != 4:
         raise ValueError(f"attention expects (B, S, H, D) arrays, got {qn.shape}")
 
-    if mesh is not None and axis_name in mesh.axis_names:
+    if mesh is not None and axis_name not in mesh.axis_names:
+        raise ValueError(
+            f"axis_name {axis_name!r} is not a mesh axis {mesh.axis_names}; "
+            "pass axis_name= matching your mesh (a silent dense fallback "
+            "would run the whole sequence on one device)"
+        )
+    if mesh is not None:
         qd = sequence_sharded(qn, mesh, axis_name=axis_name)
         kd = sequence_sharded(kn, mesh, axis_name=axis_name)
         vd = sequence_sharded(vn, mesh, axis_name=axis_name)

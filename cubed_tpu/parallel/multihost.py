@@ -96,6 +96,28 @@ def chunk_owner_devices(
     return owners
 
 
+def chunk_within_owner_shard(
+    sharding, shape, chunkset, coords: Tuple[int, ...]
+) -> bool:
+    """True when the chunk's whole region lies inside its owner's shard —
+    the alignment a multi-process flush needs (a straddling chunk's data
+    spans devices other processes own and cannot be fetched locally)."""
+    index_map = sharding.devices_indices_map(tuple(shape))
+    sel = get_item(chunkset, coords)
+    start = tuple(s.start for s in sel)
+    for device, idx in index_map.items():
+        if all(
+            (sl.start or 0) <= st < (sl.stop if sl.stop is not None else dim)
+            for sl, st, dim in zip(idx, start, shape)
+        ):
+            return all(
+                (sl.start or 0) <= c.start
+                and c.stop <= (sl.stop if sl.stop is not None else dim)
+                for sl, c, dim in zip(idx, sel, shape)
+            )
+    return False
+
+
 def host_chunk_assignment(
     sharding,
     shape: Tuple[int, ...],

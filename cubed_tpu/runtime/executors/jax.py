@@ -354,8 +354,15 @@ class JaxExecutor(DagExecutor):
         return preload, offsets
 
     def _preload(self, arr, resident, budget) -> bool:
-        """Load a concrete storage array whole onto the device (outside any
-        trace) so segment programs take it as an input, not a baked constant."""
+        """Load a concrete storage array onto the device (outside any trace)
+        so segment programs take it as an input, not a baked constant.
+
+        Under a mesh, ingestion goes through ``make_array_from_callback``:
+        each process materializes only the storage regions its addressable
+        shards cover — the per-host Zarr IO sharding seam of
+        docs/multihost.md (on one host this degenerates to reading
+        everything, shard by shard)."""
+        jax = _jax()
         key = str(arr.store)
         if key in resident:
             return True
@@ -366,12 +373,24 @@ class JaxExecutor(DagExecutor):
         nbytes = int(np.prod(concrete.shape or (1,))) * concrete.dtype.itemsize
         if nbytes > budget:
             return False
-        data = concrete[...] if concrete.shape else concrete[()]
         cs = (
             blockdims_from_blockshape(concrete.shape, concrete.chunks)
             if concrete.shape and getattr(concrete, "chunks", None)
             else None
         )
+        shape = tuple(concrete.shape)
+        sharding = self._sharding_for(shape, cs)
+        if (
+            sharding is not None
+            and shape
+            and concrete.dtype.fields is None
+        ):
+            value = jax.make_array_from_callback(
+                shape, sharding, lambda idx: np.asarray(concrete[idx])
+            )
+            self._admit(resident, key, value, arr, budget)
+            return True
+        data = concrete[...] if concrete.shape else concrete[()]
         if data.dtype.fields is not None:
             value = {
                 k: self._device_put(np.ascontiguousarray(data[k]), data.shape, cs)
@@ -598,7 +617,18 @@ class JaxExecutor(DagExecutor):
             else (jax.devices()[0].id,)
         )
         payload.append(
-            ("env", bool(jax.config.jax_enable_x64), devices, jax.devices()[0].platform)
+            (
+                "env",
+                bool(jax.config.jax_enable_x64),
+                devices,
+                jax.devices()[0].platform,
+                # executor config that changes the traced program: the Pallas
+                # opt-in swaps combine kernels; the mesh SHAPE (not just the
+                # flat device order) determines shardings
+                bool(self.use_pallas),
+                tuple(self.mesh.devices.shape) if self.mesh is not None else None,
+                tuple(self.mesh.axis_names) if self.mesh is not None else None,
+            )
         )
         buf = io.BytesIO()
         try:
@@ -1468,7 +1498,38 @@ class JaxExecutor(DagExecutor):
                 concrete[()] = np.asarray(value)
             return
         chunkset = blockdims_from_blockshape(shape, concrete.chunks)
-        for idx in itertools.product(*(range(len(c)) for c in chunkset)):
+        coords_iter = itertools.product(*(range(len(c)) for c in chunkset))
+        sharding = getattr(value, "sharding", None)
+        jax = _jax()
+        if (
+            self.mesh is not None
+            and not isinstance(value, dict)
+            and sharding is not None
+            and jax.process_count() > 1
+        ):
+            # per-host write sharding (docs/multihost.md): under
+            # multi-controller SPMD every process runs this flush, but each
+            # writes only the chunks its own devices own — together exactly
+            # the full grid, each byte written once. Single-process runs
+            # skip the assignment scan (every chunk is addressable anyway).
+            from ...parallel.multihost import (
+                chunk_within_owner_shard,
+                local_chunks,
+            )
+
+            mine = local_chunks(sharding, shape, tuple(concrete.chunks))
+            for coords in mine:
+                if not chunk_within_owner_shard(
+                    sharding, shape, chunkset, coords
+                ):
+                    raise NotImplementedError(
+                        "multi-host flush requires a chunk-aligned sharding "
+                        f"(chunk {coords} straddles shard boundaries); "
+                        "rechunk or choose a chunk-aligned mesh layout "
+                        "(parallel.mesh.sharding_for_chunks prefers one)"
+                    )
+            coords_iter = iter(mine)
+        for idx in coords_iter:
             sel = get_item(chunkset, idx)
             if isinstance(value, dict):
                 fields = {k: np.asarray(v[sel]) for k, v in value.items()}

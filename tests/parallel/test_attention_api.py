@@ -54,3 +54,12 @@ def test_attention_rejects_bad_rank(spec):
     a = ct.from_array(np.zeros((4, 4)), chunks=(2, 2), spec=spec)
     with pytest.raises(ValueError):
         attention(a, a, a)
+
+
+@needs_8
+def test_attention_rejects_axis_name_miss(spec):
+    # a mesh without the requested axis must raise, not silently run dense
+    mesh = make_mesh(shape=(8,), axis_names=("data",), devices=_cpu_devices()[:8])
+    (_, _, _), (q, k, v) = _qkv(spec)
+    with pytest.raises(ValueError, match="not a mesh axis"):
+        attention(q, k, v, mesh=mesh)  # default axis_name='seq'

@@ -1,0 +1,36 @@
+"""HTML repr tests (reference parity: cubed/tests/test_html.py)."""
+
+import numpy as np
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+
+
+def test_repr_html_contains_metadata(spec):
+    a = ct.from_array(np.arange(48.0).reshape(6, 8), chunks=(2, 4), spec=spec)
+    html = a._repr_html_()
+    assert "<svg" in html  # chunk-grid picture
+    assert "float64" in html
+    assert "(6, 8)" in html or "6" in html and "8" in html
+    assert "Chunk" in html or "chunk" in html
+
+
+def test_repr_html_1d_and_scalar(spec):
+    v = xp.ones((12,), chunks=(5,), spec=spec)
+    html = v._repr_html_()
+    assert "<svg" in html
+    s = xp.sum(v)  # 0-d
+    assert s._repr_html_()  # must not raise on 0-d
+
+
+def test_repr_html_ragged_grid(spec):
+    a = ct.from_array(np.zeros((19, 13)), chunks=(5, 4), spec=spec)
+    html = a._repr_html_()
+    assert "<svg" in html
+
+
+def test_plain_repr(spec):
+    a = ct.from_array(np.zeros((4, 4)), chunks=(2, 2), spec=spec)
+    r = repr(a)
+    assert "Array" in r or "array" in r
+    assert "(4, 4)" in r or "4, 4" in r

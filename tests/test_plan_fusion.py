@@ -252,6 +252,28 @@ def test_struct_cache_distinguishes_chunking(spec):
     assert v1 == v2 == -an.sum()
 
 
+def test_struct_cache_distinguishes_executor_config(spec):
+    # use_pallas swaps the combine kernel: the cached XLA program must not
+    # be reused by a Pallas-opted executor (or vice versa)
+    from cubed_tpu.runtime.executors import jax as jxm
+
+    jxm._STRUCT_CACHE.clear()
+    an = np.arange(64 * 8, dtype=np.float32).reshape(64, 8)
+
+    def build():
+        a = ct.from_array(an, chunks=(4, 8), spec=spec)
+        return xp.sum(a, axis=0)
+
+    ex1 = JaxExecutor(use_pallas=False)
+    ex2 = JaxExecutor(use_pallas=True)
+    v1 = np.asarray(build().compute(executor=ex1))
+    v2 = np.asarray(build().compute(executor=ex2))
+    assert ex2.stats["segment_struct_hits"] == 0  # different config, no reuse
+    assert ex2.stats["pallas_region_hits"] >= 1  # the opted path really ran
+    np.testing.assert_allclose(v1, an.sum(axis=0))
+    np.testing.assert_allclose(v2, an.sum(axis=0), rtol=1e-4)
+
+
 def test_struct_cache_no_collision_on_gensym_like_user_strings(spec):
     # user closure strings that merely LOOK like gensym identifiers must not
     # normalize away: only this plan's own names are canonicalized

@@ -1,0 +1,129 @@
+"""Unit tests for utils (reference parity: cubed/tests/test_utils.py)."""
+
+import numpy as np
+import pytest
+
+from cubed_tpu.utils import (
+    array_memory,
+    block_id_to_offset,
+    broadcast_trick,
+    chunk_memory,
+    convert_to_bytes,
+    extract_stack_summaries,
+    flatten_nested,
+    get_item,
+    itemsize,
+    join_path,
+    map_nested,
+    memory_repr,
+    offset_to_block_id,
+    peak_measured_mem,
+    split_into,
+    to_chunksize,
+)
+
+
+@pytest.mark.parametrize(
+    "value,expect",
+    [
+        (1000, 1000),
+        ("500", 500),
+        ("1KB", 1000),
+        ("1kB", 1000),
+        ("2MB", 2_000_000),
+        ("1.5GB", 1_500_000_000),
+        ("100B", 100),
+        (1.0, 1),
+    ],
+)
+def test_convert_to_bytes(value, expect):
+    assert convert_to_bytes(value) == expect
+
+
+def test_convert_to_bytes_none_and_invalid():
+    assert convert_to_bytes(None) is None
+    with pytest.raises((ValueError, TypeError)):
+        convert_to_bytes("lots")
+
+
+def test_memory_repr():
+    assert memory_repr(1000) in ("1.0 KB", "1000 bytes", "1.0 kB")
+    assert "MB" in memory_repr(2_000_000)
+    assert memory_repr(0) is not None
+
+
+def test_chunk_and_array_memory():
+    assert itemsize(np.dtype("float64")) == 8
+    assert chunk_memory(np.dtype("float64"), (100, 100)) == 80_000
+    assert array_memory(np.dtype("int32"), (10, 10)) == 400
+    # structured dtypes count all fields
+    dt = np.dtype([("n", np.int64), ("total", np.float64)])
+    assert chunk_memory(dt, (10,)) == 160
+
+
+def test_to_chunksize():
+    assert to_chunksize(((4, 4, 2), (3, 3))) == (4, 3)
+    with pytest.raises(ValueError):
+        to_chunksize(((4, 2, 4),))  # irregular: short chunk not last
+
+
+def test_get_item():
+    chunks = ((4, 4, 2), (3, 3))
+    assert get_item(chunks, (0, 0)) == (slice(0, 4), slice(0, 3))
+    assert get_item(chunks, (2, 1)) == (slice(8, 10), slice(3, 6))
+
+
+def test_offset_block_id_roundtrip():
+    numblocks = (3, 4, 2)
+    for offset in range(3 * 4 * 2):
+        bid = offset_to_block_id(offset, numblocks)
+        assert block_id_to_offset(bid, numblocks) == offset
+
+
+def test_join_path():
+    assert join_path("/tmp/work", "a.zarr") == "/tmp/work/a.zarr"
+    assert join_path("/tmp/work/", "a.zarr") == "/tmp/work/a.zarr"
+    # URL-style paths keep their scheme
+    assert join_path("s3://bucket/dir", "a.zarr") == "s3://bucket/dir/a.zarr"
+
+
+def test_peak_measured_mem():
+    assert peak_measured_mem() > 1_000_000  # a real process RSS
+
+
+def test_split_into():
+    assert list(split_into(range(6), [2, 3, 1])) == [[0, 1], [2, 3, 4], [5]]
+
+
+def test_map_nested_and_flatten():
+    nested = [1, [2, [3, 4]], 5]
+    doubled = map_nested(lambda x: x * 2, nested)
+    assert doubled == [2, [4, [6, 8]], 10]
+    assert list(flatten_nested(nested)) == [1, 2, 3, 4, 5]
+
+
+def test_broadcast_trick():
+    full = broadcast_trick(np.full)
+    a = full((1000, 1000), 3.0, dtype=np.float64)
+    assert a.shape == (1000, 1000)
+    assert float(a[7, 11]) == 3.0
+    # the trick: O(1) real memory behind the broadcast view
+    assert a.base is not None or a.strides == (0, 0)
+
+
+def test_extract_stack_summaries_maps_variables(spec):
+    import cubed_tpu as ct
+
+    my_special_var = ct.from_array(np.zeros((4, 4)), chunks=(2, 2), spec=spec)
+    import sys
+
+    frame = sys._getframe()
+    summaries = extract_stack_summaries(frame)
+    assert summaries  # walked at least this frame
+    this = summaries[-1]
+    assert this.name == "test_extract_stack_summaries_maps_variables"
+    assert my_special_var.name in this.array_names_to_variable_names
+    assert (
+        this.array_names_to_variable_names[my_special_var.name]
+        == "my_special_var"
+    )
