@@ -119,10 +119,13 @@ print("smoke ok", float(x), round(time.perf_counter() - t0, 2), flush=True)
 
 
 def _scrubbed_cpu_env() -> dict:
-    """Tunnel-free env: no plugin-gating vars, jax pinned to 8 CPU devices."""
+    """Tunnel-free env: no plugin-gating vars, ONE CPU device.
+
+    Virtual CPU devices split the host threadpool; the fallback runs
+    unsharded on device 0, so 8 virtual devices would throttle it ~8x."""
     from __graft_entry__ import _scrubbed_cpu_env as scrub
 
-    return scrub(8)
+    return scrub(1)
 
 
 def _run_phase(

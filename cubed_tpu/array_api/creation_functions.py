@@ -111,13 +111,23 @@ def eye(n_rows, n_cols=None, /, *, k=0, dtype=None, device=None, chunks="auto", 
     chunks = normalize_chunks(chunks, shape, dtype=dtype)
     chunksize = to_chunksize(chunks)
 
-    def _eye_chunk(chunk, block_id=None):
-        i0 = block_id[0] * chunksize[0]
-        j0 = block_id[1] * chunksize[1]
+    nb1 = len(chunks[1])
+
+    def _eye_chunk(chunk, block_id=None, offset=None, numblocks=None):
         m, n = chunk.shape
-        ii = nxp.arange(i0, i0 + m)[:, None]
-        jj = nxp.arange(j0, j0 + n)[None, :]
+        if offset is not None:
+            # offset-native: the linear block offset may be a traced value,
+            # so the diagonal predicate stays jit/vmap-safe (static-length
+            # aranges + traced starts)
+            off = nxp.asarray(offset).ravel()[0]
+            b0, b1 = off // nb1, off % nb1
+        else:
+            b0, b1 = block_id
+        ii = (b0 * chunksize[0] + nxp.arange(m))[:, None]
+        jj = (b1 * chunksize[1] + nxp.arange(n))[None, :]
         return nxp.asarray(jj - ii == k, dtype=dtype)
+
+    _eye_chunk.supports_offset = True
 
     return map_blocks(_eye_chunk, empty(shape, dtype=dtype, chunks=chunks, spec=spec), dtype=dtype)
 

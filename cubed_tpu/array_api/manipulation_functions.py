@@ -153,6 +153,11 @@ def concat(arrays, /, *, axis=0):
             return pieces[0]
         return nxp.concatenate(pieces, axis=axis)
 
+    # residency-based executors can realize the WHOLE op as one device
+    # concatenate of the (resident) sources along this axis — traceable into
+    # fused segments instead of a storage-reading eager boundary
+    _read_concat_chunk.whole_concat = axis
+
     return map_direct(
         _read_concat_chunk,
         *arrays,

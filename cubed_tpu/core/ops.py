@@ -412,7 +412,7 @@ def map_blocks(
             # not hand this kernel traced offsets (no vmap, no jit of offsets)
             func_with_block_id.host_block_id = True
         for attr in ("side_inputs", "whole_select", "resident_identity",
-                     "host_data_nbytes"):
+                     "whole_concat", "host_data_nbytes"):
             if hasattr(func, attr):
                 setattr(func_with_block_id, attr, getattr(func, attr))
         blockwise_args.extend([offsets, tuple(range(in_ndim))])
@@ -525,7 +525,7 @@ def map_direct(
     # storage before this op's tasks read them directly; propagate fast-path
     # markers from the inner task body
     new_func.side_inputs = side_arrays
-    for attr in ("whole_select", "resident_identity"):
+    for attr in ("whole_select", "resident_identity", "whole_concat"):
         if hasattr(func, attr):
             setattr(new_func, attr, getattr(func, attr))
 

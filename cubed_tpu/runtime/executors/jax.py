@@ -136,11 +136,14 @@ class JaxExecutor(DagExecutor):
         self._placement = None  # factorized placement mesh, built lazily
         #: execution-path counters for the last ``execute_dag`` call, reported
         #: via ``ComputeEndEvent.executor_stats``. Keys: ``segments_traced``,
-        #: ``segments_compiled``, ``segment_cache_hits``, ``segment_mem_aborts``,
-        #: ``whole_array_hits``, ``batched_ops``, ``chunked_ops``,
-        #: ``rechunk_alias``, ``pallas_region_hits``, ``eager_ops``, and the
+        #: ``segments_compiled``, ``segment_cache_hits``, ``segment_struct_hits``,
+        #: ``segment_mem_aborts``, ``segment_hbm_footprint``,
+        #: ``whole_array_hits``, ``whole_concat_hits``, ``batched_ops``,
+        #: ``chunked_ops``, ``rechunk_alias`` (zero-copy), ``rechunk_virtual``
+        #: (materialized), ``pallas_region_hits``, ``eager_ops``, and the
         #: failure counters ``eager_fallbacks`` / ``trace_failures`` /
         #: ``whole_array_errors`` / ``batched_errors`` / ``whole_select_errors``
+        #: / ``pallas_errors`` / ``jit_kernel_errors``
         #: (``eager_fallbacks`` must stay 0 on fused-path plans — tests pin it)
         self.stats: Counter = Counter()
 
@@ -316,11 +319,14 @@ class JaxExecutor(DagExecutor):
             return "eager"
         side_inputs = getattr(f, "side_inputs", None)
         if side_inputs and not (
-            len(side_inputs) == 1
-            and (
-                getattr(f, "resident_identity", False)
-                or getattr(f, "whole_select", None) is not None
+            (
+                len(side_inputs) == 1
+                and (
+                    getattr(f, "resident_identity", False)
+                    or getattr(f, "whole_select", None) is not None
+                )
             )
+            or getattr(f, "whole_concat", None) is not None
         ):
             # generic map_direct: the task body reads storage directly
             return "eager"
@@ -807,6 +813,35 @@ class JaxExecutor(DagExecutor):
         out_store = str(target.store)
 
         side_inputs = getattr(spec.function, "side_inputs", None)
+
+        # whole-op concat: every source resident -> ONE device concatenate
+        # along the declared axis (traceable; no storage round-trip)
+        wc_axis = getattr(spec.function, "whole_concat", None)
+        if side_inputs and wc_axis is not None:
+            jnp = jax.numpy
+            vals = []
+            for arr in side_inputs:
+                skey = str(getattr(arr, "store", id(arr)))
+                res = resident.get(skey)
+                if res is not None and not isinstance(res.value, dict):
+                    res.touch()
+                    vals.append(res.value)
+                elif isinstance(arr, VirtualInMemoryArray):
+                    vals.append(jnp.asarray(np.asarray(arr.array)))
+                elif isinstance(arr, (VirtualEmptyArray, VirtualFullArray)):
+                    fill = getattr(arr, "fill_value", 0)
+                    vals.append(jnp.full(arr.shape, fill, dtype=arr.dtype))
+                else:
+                    vals = None
+                    break
+            if vals is not None:
+                value = (
+                    vals[0] if len(vals) == 1 else jnp.concatenate(vals, axis=wc_axis)
+                )
+                if tuple(value.shape) == out_shape:
+                    self.stats["whole_concat_hits"] += 1
+                    self._admit(resident, out_store, value, target, budget)
+                    return
 
         # residency-native fast paths for map_direct-family ops whose task
         # bodies declared their access pattern
@@ -1429,6 +1464,20 @@ class JaxExecutor(DagExecutor):
             res.touch()
             self.stats["rechunk_alias"] += 1
             self._admit(resident, dst_key, res.value, dst, budget)
+            return
+
+        # virtual sources materialize on device directly (trace-safe) — a
+        # real materialization, counted apart from zero-copy aliases
+        if isinstance(src, VirtualInMemoryArray):
+            value = self._device_put(np.asarray(src.array), tuple(src.shape))
+            self.stats["rechunk_virtual"] += 1
+            self._admit(resident, dst_key, value, dst, budget)
+            return
+        if isinstance(src, (VirtualEmptyArray, VirtualFullArray)):
+            fill = getattr(src, "fill_value", 0)
+            value = self._full(tuple(src.shape), fill, src.dtype)
+            self.stats["rechunk_virtual"] += 1
+            self._admit(resident, dst_key, value, dst, budget)
             return
 
         # source lives in storage: load whole if it fits, else host-side copy

@@ -80,8 +80,8 @@ def test_fused_random_seed_hoisting(spec):
 
 
 def test_fused_segment_boundary_concat(spec):
-    # concat is a storage-reading map_direct body: it must break the segment
-    # and still produce correct results around it
+    # concat declares whole_concat: with resident sources it becomes one
+    # device concatenate INSIDE the traced segment (no eager boundary)
     an = np.arange(24, dtype=np.float64).reshape(4, 6)
     a = ct.from_array(an, chunks=(2, 3), spec=spec)
     b = ct.from_array(an + 1, chunks=(2, 3), spec=spec)
@@ -89,6 +89,51 @@ def test_fused_segment_boundary_concat(spec):
     expect = np.concatenate([an * 2, an + 1], axis=0).sum()
     np.testing.assert_allclose(fused, expect)
     np.testing.assert_allclose(eager, expect)
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["stack", "reshape", "broadcast_to", "eye", "flip", "repeat", "concat"],
+)
+def test_op_families_trace_without_fallback(name, spec):
+    """These plan shapes must all run as traced segments — a regression here
+    silently costs the eager path's per-op overhead."""
+    an = np.arange(24, dtype=np.float64).reshape(4, 6)
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    b = ct.from_array(an + 1, chunks=(2, 3), spec=spec)
+    exprs = {
+        "stack": (xp.sum(xp.stack([a, b], axis=0)), an.sum() + (an + 1).sum()),
+        "reshape": (xp.sum(xp.reshape(a, (24,))), an.sum()),
+        "broadcast_to": (xp.sum(xp.broadcast_to(a, (3, 4, 6))), 3 * an.sum()),
+        "eye": (xp.sum(xp.eye(7, chunks=3, spec=spec)), 7.0),
+        "flip": (xp.sum(xp.flip(a, axis=0)), an.sum()),
+        "repeat": (xp.sum(xp.repeat(a, 2, axis=1)), 2 * an.sum()),
+        "concat": (xp.sum(xp.concat([a, b], axis=0)), an.sum() + (an + 1).sum()),
+    }
+    expr, expect = exprs[name]
+    ex = JaxExecutor()
+    val = float(expr.compute(executor=ex))
+    np.testing.assert_allclose(val, expect)
+    assert ex.stats["segments_traced"] >= 1
+    assert ex.stats["trace_failures"] == 0
+    assert ex.stats["eager_fallbacks"] == 0
+
+
+def test_concat_traces_into_one_segment(spec):
+    from cubed_tpu.runtime.executors import jax as jxm
+
+    jxm._STRUCT_CACHE.clear()
+    an = np.arange(24, dtype=np.float64).reshape(4, 6)
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    b = ct.from_array(an + 1, chunks=(2, 3), spec=spec)
+    s = xp.sum(xp.concat([xp.multiply(a, 2.0), b], axis=1))
+    ex = JaxExecutor()
+    val = float(s.compute(executor=ex))
+    np.testing.assert_allclose(val, np.concatenate([an * 2, an + 1], axis=1).sum())
+    assert ex.stats["segments_traced"] == 1  # one fused program, no break
+    assert ex.stats["whole_concat_hits"] >= 1
+    assert ex.stats["eager_fallbacks"] == 0
+    assert ex.stats["trace_failures"] == 0
 
 
 def test_fused_structured_mean_intermediates(spec):
