@@ -187,6 +187,16 @@ class JaxExecutor(DagExecutor):
 
         return sharding_for_chunks(self._placement_mesh(), chunkset, shape)
 
+    def _virtual_to_device(self, arr):
+        """Materialize a whole virtual array on device, mesh-aware (sharded
+        placement under a mesh); None when ``arr`` isn't a virtual type."""
+        if isinstance(arr, VirtualInMemoryArray):
+            return self._device_put(np.asarray(arr.array), tuple(arr.shape))
+        if isinstance(arr, (VirtualEmptyArray, VirtualFullArray)):
+            fill = getattr(arr, "fill_value", 0)
+            return self._full(tuple(arr.shape), fill, arr.dtype)
+        return None
+
     def _full(self, shape, fill_value, dtype):
         """Materialize a constant array, sharded over the mesh if present."""
         jax = _jax()
@@ -826,14 +836,12 @@ class JaxExecutor(DagExecutor):
                 if res is not None and not isinstance(res.value, dict):
                     res.touch()
                     vals.append(res.value)
-                elif isinstance(arr, VirtualInMemoryArray):
-                    vals.append(jnp.asarray(np.asarray(arr.array)))
-                elif isinstance(arr, (VirtualEmptyArray, VirtualFullArray)):
-                    fill = getattr(arr, "fill_value", 0)
-                    vals.append(jnp.full(arr.shape, fill, dtype=arr.dtype))
-                else:
+                    continue
+                virt = self._virtual_to_device(arr)
+                if virt is None:
                     vals = None
                     break
+                vals.append(virt)
             if vals is not None:
                 value = (
                     vals[0] if len(vals) == 1 else jnp.concatenate(vals, axis=wc_axis)
@@ -961,12 +969,10 @@ class JaxExecutor(DagExecutor):
             if key in resident:
                 resident[key].touch()
                 out[name] = resident[key].value
-            elif isinstance(arr, VirtualFullArray):
-                out[name] = self._full(arr.shape, arr.fill_value, arr.dtype)
-            elif isinstance(arr, VirtualEmptyArray):
-                out[name] = self._full(arr.shape, 0, arr.dtype)
-            elif isinstance(arr, VirtualInMemoryArray):
-                out[name] = self._device_put(np.asarray(arr.array), arr.shape)
+            elif isinstance(
+                arr, (VirtualFullArray, VirtualEmptyArray, VirtualInMemoryArray)
+            ):
+                out[name] = self._virtual_to_device(arr)
             elif isinstance(arr, VirtualOffsetsArray):
                 return None  # block-id arrays have no whole-array meaning
             elif isinstance(arr, ZarrV2Array):
@@ -1468,16 +1474,10 @@ class JaxExecutor(DagExecutor):
 
         # virtual sources materialize on device directly (trace-safe) — a
         # real materialization, counted apart from zero-copy aliases
-        if isinstance(src, VirtualInMemoryArray):
-            value = self._device_put(np.asarray(src.array), tuple(src.shape))
+        virt = self._virtual_to_device(src)
+        if virt is not None:
             self.stats["rechunk_virtual"] += 1
-            self._admit(resident, dst_key, value, dst, budget)
-            return
-        if isinstance(src, (VirtualEmptyArray, VirtualFullArray)):
-            fill = getattr(src, "fill_value", 0)
-            value = self._full(tuple(src.shape), fill, src.dtype)
-            self.stats["rechunk_virtual"] += 1
-            self._admit(resident, dst_key, value, dst, budget)
+            self._admit(resident, dst_key, virt, dst, budget)
             return
 
         # source lives in storage: load whole if it fits, else host-side copy

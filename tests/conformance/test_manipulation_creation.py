@@ -258,3 +258,57 @@ def test_finfo_iinfo_fields():
         i = xp.iinfo(dt)
         ni = np.iinfo(dt)
         assert i.bits == ni.bits and i.max == ni.max and i.min == ni.min
+
+
+@given(data=st.data())
+def test_meshgrid(data, spec):
+    import cubed_tpu as ct
+
+    n1 = data.draw(st.integers(min_value=1, max_value=5))
+    n2 = data.draw(st.integers(min_value=1, max_value=5))
+    indexing = data.draw(st.sampled_from(["xy", "ij"]))
+    a1 = np.arange(float(n1))
+    a2 = np.arange(float(n2)) + 10
+    g = xp.meshgrid(
+        ct.from_array(a1, chunks=(2,), spec=spec),
+        ct.from_array(a2, chunks=(2,), spec=spec),
+        indexing=indexing,
+    )
+    expect = np.meshgrid(a1, a2, indexing=indexing)
+    assert len(g) == len(expect)
+    for got, exp in zip(g, expect):
+        assert_matches(run(got), exp)
+
+
+@given(data=st.data())
+def test_broadcast_arrays(data, spec):
+    sh = data.draw(
+        hnp.mutually_broadcastable_shapes(num_shapes=2, min_dims=1, max_dims=3, max_side=4)
+    )
+    an = data.draw(arrays(dtypes=(np.float64,), shape=sh.input_shapes[0]))
+    bn = data.draw(arrays(dtypes=(np.float64,), shape=sh.input_shapes[1]))
+    ga, gb = xp.broadcast_arrays(wrap(an, spec), wrap(bn, spec))
+    ea, eb = np.broadcast_arrays(an, bn)
+    assert_matches(run(ga), ea)
+    assert_matches(run(gb), eb)
+
+
+def test_can_cast_matrix():
+    # spec-defined casts within kinds (dtype objects per the spec signature)
+    dt = np.dtype
+    assert xp.can_cast(dt(np.int8), dt(np.int16))
+    assert not xp.can_cast(dt(np.int16), dt(np.int8))
+    assert xp.can_cast(dt(np.float32), dt(np.float64))
+    assert not xp.can_cast(dt(np.float64), dt(np.float32))
+    assert xp.can_cast(dt(np.uint8), dt(np.uint16))
+
+
+def test_isdtype_categories():
+    assert xp.isdtype(np.dtype(np.float32), "real floating")
+    assert xp.isdtype(np.dtype(np.int16), "signed integer")
+    assert xp.isdtype(np.dtype(np.uint32), "unsigned integer")
+    assert xp.isdtype(np.dtype(np.bool_), "bool")
+    assert xp.isdtype(np.dtype(np.int64), "integral")
+    assert xp.isdtype(np.dtype(np.float64), "numeric")
+    assert not xp.isdtype(np.dtype(np.float64), "integral")
+    assert xp.isdtype(np.dtype(np.int32), (np.dtype(np.int32),))

@@ -91,6 +91,34 @@ def test_fused_segment_boundary_concat(spec):
     np.testing.assert_allclose(eager, expect)
 
 
+def test_segment_task_events_partition_wall_time(spec):
+    """Per-op TaskEndEvents of a fused segment must PARTITION the segment's
+    wall time (contiguous, non-overlapping, summing to the total) — not each
+    span the whole segment (which over-reports history totals len(ops)x)."""
+    from cubed_tpu.runtime.types import Callback
+
+    events = []
+
+    class Capture(Callback):
+        def on_task_end(self, event):
+            events.append(event)
+
+    an = np.arange(400, dtype=np.float64).reshape(20, 20)
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    xp.mean(xp.multiply(a, 2.0)).compute(
+        executor=JaxExecutor(), callbacks=[Capture()]
+    )
+    assert len(events) >= 2
+    spans = sorted(
+        (e.function_start_tstamp, e.function_end_tstamp) for e in events
+    )
+    for (s1, e1), (s2, e2) in zip(spans, spans[1:]):
+        assert e1 <= s2 + 1e-9  # non-overlapping
+    total = sum(e - s for s, e in spans)
+    wall = max(e for _, e in spans) - min(s for s, _ in spans)
+    assert total <= wall + 1e-6  # durations sum to (at most) the wall time
+
+
 @pytest.mark.parametrize(
     "name",
     ["stack", "reshape", "broadcast_to", "eye", "flip", "repeat", "concat"],
@@ -98,6 +126,9 @@ def test_fused_segment_boundary_concat(spec):
 def test_op_families_trace_without_fallback(name, spec):
     """These plan shapes must all run as traced segments — a regression here
     silently costs the eager path's per-op overhead."""
+    from cubed_tpu.runtime.executors import jax as jxm
+
+    jxm._STRUCT_CACHE.clear()  # a struct hit would skip tracing legitimately
     an = np.arange(24, dtype=np.float64).reshape(4, 6)
     a = ct.from_array(an, chunks=(2, 3), spec=spec)
     b = ct.from_array(an + 1, chunks=(2, 3), spec=spec)
