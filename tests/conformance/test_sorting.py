@@ -1,0 +1,133 @@
+"""Sorting conformance (extension beyond the reference, which skips these).
+
+Parity role: array-api-tests test_sorting_functions.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+from hypothesis import given
+from hypothesis import strategies as st
+
+import cubed_tpu.array_api as xp
+
+from .harness import REAL_FLOAT_DTYPES, arrays, assert_matches, run, wrap
+
+
+@given(data=st.data())
+def test_sort(data, spec):
+    an = data.draw(arrays(dtypes=REAL_FLOAT_DTYPES))
+    axis = data.draw(st.integers(-an.ndim, an.ndim - 1))
+    descending = data.draw(st.booleans())
+    got = run(xp.sort(wrap(an, spec), axis=axis, descending=descending))
+    expect = np.sort(an, axis=axis)
+    if descending:
+        expect = np.flip(expect, axis=axis)
+    assert_matches(got, expect)
+
+
+@given(data=st.data())
+def test_argsort_values(data, spec):
+    # indices themselves may differ on ties across implementations when
+    # stable=False; validate by GATHERING — the reordered values must match
+    an = data.draw(arrays(dtypes=(np.float64,)))
+    axis = data.draw(st.integers(0, an.ndim - 1))
+    descending = data.draw(st.booleans())
+    idx = run(xp.argsort(wrap(an, spec), axis=axis, descending=descending))
+    assert idx.dtype == np.int64
+    gathered = np.take_along_axis(an, idx, axis=axis)
+    expect = np.sort(an, axis=axis)
+    if descending:
+        expect = np.flip(expect, axis=axis)
+    np.testing.assert_allclose(gathered, expect)
+
+
+def test_argsort_stable_ties(spec):
+    # stable: equal elements keep their original relative order
+    an = np.asarray([3.0, 1.0, 3.0, 1.0, 2.0, 1.0])
+    import cubed_tpu as ct
+
+    a = ct.from_array(an, chunks=(2,), spec=spec)
+    idx = run(xp.argsort(a, stable=True))
+    np.testing.assert_array_equal(idx, np.argsort(an, stable=True))
+    idx_desc = run(xp.argsort(a, descending=True, stable=True))
+    # descending stable: among equal values, earlier positions first
+    np.testing.assert_array_equal(idx_desc, np.asarray([0, 2, 4, 1, 3, 5]))
+
+
+@given(data=st.data())
+def test_argsort_integer_dtypes(data, spec):
+    # uints and INT_MIN broke a negation-based descending implementation
+    from .harness import INT_DTYPES, UINT_DTYPES
+
+    dt = data.draw(st.sampled_from(INT_DTYPES + UINT_DTYPES))
+    an = data.draw(arrays(dtypes=(dt,), min_dims=1))
+    lo = np.iinfo(dt).min
+    if data.draw(st.booleans()) and an.size:
+        an = an.copy()
+        an.flat[0] = lo  # plant the dtype minimum
+    axis = data.draw(st.integers(0, an.ndim - 1))
+    descending = data.draw(st.booleans())
+    idx = run(xp.argsort(wrap(an, spec), axis=axis, descending=descending))
+    gathered = np.take_along_axis(an, idx, axis=axis)
+    expect = np.sort(an, axis=axis)
+    if descending:
+        expect = np.flip(expect, axis=axis)
+    np.testing.assert_array_equal(gathered, expect)
+
+
+def test_argsort_descending_numpy_backend(tmp_path):
+    """The numpy-backend branch (flip/remap, no negation) on uint + INT_MIN."""
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+spec = ct.Spec(work_dir={wd!r}, allowed_mem="100MB")
+for an in [
+    np.asarray([0, 5, 3], dtype=np.uint8),
+    np.asarray([np.iinfo(np.int8).min, 4, -2, 4], dtype=np.int8),
+    np.asarray([2.0, 1.0, 2.0, 0.0]),
+]:
+    a = ct.from_array(an, chunks=(2,), spec=spec)
+    idx = np.asarray(xp.argsort(a, descending=True).compute())
+    got = np.take_along_axis(an, idx, axis=0)
+    expect = np.flip(np.sort(an))
+    assert np.array_equal(got, expect), (an.dtype, idx, got, expect)
+    # stability: ties keep first-appearance order
+    order = np.lexsort((np.arange(len(an)), -an.astype(np.float64)))
+    assert np.array_equal(idx, order), (an.dtype, idx, order)
+print("numpy-backend descending argsort OK")
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = {k: v for k, v in os.environ.items()}
+    env["CUBED_TPU_BACKEND"] = "numpy"
+    out = subprocess.run(
+        [sys.executable, "-c", script.format(repo=repo, wd=str(tmp_path))],
+        env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "OK" in out.stdout
+
+
+def test_sort_rechunks_multi_chunk_axis(spec):
+    import cubed_tpu as ct
+
+    an = np.random.default_rng(0).random((9, 12))
+    a = ct.from_array(an, chunks=(3, 4), spec=spec)  # 3 chunks along axis 1
+    got = run(xp.sort(a, axis=1))
+    np.testing.assert_allclose(got, np.sort(an, axis=1))
+
+
+def test_sort_rejects_bool(spec):
+    import cubed_tpu as ct
+
+    a = ct.from_array(np.zeros(4, dtype=bool), chunks=(2,), spec=spec)
+    with pytest.raises(TypeError):
+        xp.sort(a)
