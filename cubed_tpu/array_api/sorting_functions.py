@@ -18,6 +18,16 @@ from ..core.ops import map_blocks
 from .dtypes import _real_numeric_dtypes
 
 
+def _normalize_axis(x, axis: int) -> int:
+    if x.ndim == 0:
+        raise ValueError("sorting requires at least one dimension")
+    if not (-x.ndim <= axis < x.ndim):
+        raise IndexError(
+            f"axis {axis} is out of bounds for array of dimension {x.ndim}"
+        )
+    return axis % x.ndim
+
+
 def _single_chunk_along(x, axis: int):
     if x.numblocks[axis] == 1:
         return x
@@ -30,7 +40,7 @@ def _single_chunk_along(x, axis: int):
 def sort(x, /, *, axis=-1, descending=False, stable=True):
     if x.dtype not in _real_numeric_dtypes:
         raise TypeError("Only real numeric dtypes are allowed in sort")
-    axis = axis % x.ndim
+    axis = _normalize_axis(x, axis)
     x = _single_chunk_along(x, axis)
 
     def _sort_chunk(a):
@@ -47,7 +57,7 @@ def sort(x, /, *, axis=-1, descending=False, stable=True):
 def argsort(x, /, *, axis=-1, descending=False, stable=True):
     if x.dtype not in _real_numeric_dtypes:
         raise TypeError("Only real numeric dtypes are allowed in argsort")
-    axis = axis % x.ndim
+    axis = _normalize_axis(x, axis)
     x = _single_chunk_along(x, axis)
 
     def _argsort_chunk(a):

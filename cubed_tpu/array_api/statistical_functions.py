@@ -204,17 +204,19 @@ def _var_func(a, axis=None, keepdims=True, **kwargs):
 
 
 def _var_combine(a, axis=None, keepdims=True, **kwargs):
-    # pairwise Chan/Welford merge folded over the concatenated axis
+    # n-ary Chan/Welford merge over ALL reduced axes at once. Reducing only
+    # axis[0] broke the executor's region combine, which hands a multi-axis
+    # block region in one call (the streaming path masked it by always
+    # concatenating along one axis) — caught by the differential fuzzer.
     n = a["n"]
     mu = a["mu"]
     M2 = a["M2"]
-    ax = axis[0] if isinstance(axis, tuple) else axis
-    total_n = nxp.sum(n, axis=ax, keepdims=True)
-    total = nxp.sum(nxp.multiply(mu, n), axis=ax, keepdims=True)
+    total_n = nxp.sum(n, axis=axis, keepdims=True)
+    total = nxp.sum(nxp.multiply(mu, n), axis=axis, keepdims=True)
     new_mu = nxp.divide(total, total_n)
     # M2_total = sum(M2_i) + sum(n_i * (mu_i - new_mu)^2)
-    new_M2 = nxp.sum(M2, axis=ax, keepdims=True) + nxp.sum(
-        nxp.multiply(n, nxp.square(nxp.subtract(mu, new_mu))), axis=ax, keepdims=True
+    new_M2 = nxp.sum(M2, axis=axis, keepdims=True) + nxp.sum(
+        nxp.multiply(n, nxp.square(nxp.subtract(mu, new_mu))), axis=axis, keepdims=True
     )
     return {"n": total_n, "mu": new_mu, "M2": new_M2}
 

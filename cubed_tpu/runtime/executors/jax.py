@@ -924,6 +924,13 @@ class JaxExecutor(DagExecutor):
             value = self._exec_chunked(op, spec, resident)
             self.stats["chunked_ops"] += 1
 
+        if not isinstance(value, dict) and tuple(value.shape) != out_shape:
+            # chunked is the last resort: a shape mismatch here is a kernel
+            # contract violation that must fail loudly, not assemble garbage
+            raise ValueError(
+                f"op produced shape {tuple(value.shape)}, target expects "
+                f"{out_shape} (kernel/block-function contract violation)"
+            )
         self._admit(resident, out_store, value, target, budget)
 
     def _apply_whole_select(self, value, selections):

@@ -96,6 +96,43 @@ def test_random_plans_match_oracle(data, spec):
     np.testing.assert_allclose(fused, oracle, rtol=1e-12, atol=1e-12)
 
 
+@settings(max_examples=12, deadline=None)
+@given(data=st.data())
+def test_random_linalg_and_stats_match_oracle(data, spec):
+    """matmul/tensordot contractions, var/std, nan functions, int-array
+    indexing, and sort — the op families the main fuzzer doesn't reach."""
+    m, k, n = (data.draw(st.integers(2, 6)) for _ in range(3))
+    an = data.draw(arrays(dtypes=(np.float64,), shape=(m, k)))
+    bn = data.draw(arrays(dtypes=(np.float64,), shape=(k, n)))
+    a = ct.from_array(an, chunks=(max(1, m // 2), max(1, k // 2)), spec=spec)
+    b = ct.from_array(bn, chunks=(max(1, k // 2), max(1, n // 2)), spec=spec)
+
+    kind = data.draw(st.sampled_from(
+        ["matmul", "tensordot", "var", "std", "nanmean", "index", "sort"]
+    ))
+    if kind == "matmul":
+        expr = xp.matmul(a, b)
+    elif kind == "tensordot":
+        expr = xp.tensordot(a, b, axes=1)
+    elif kind == "var":
+        expr = xp.var(a, axis=data.draw(st.one_of(st.none(), st.integers(0, 1))))
+    elif kind == "std":
+        expr = xp.std(a, axis=data.draw(st.one_of(st.none(), st.integers(0, 1))))
+    elif kind == "nanmean":
+        expr = ct.nanmean(a, axis=data.draw(st.one_of(st.none(), st.integers(0, 1))))
+    elif kind == "index":
+        rows = data.draw(
+            st.lists(st.integers(0, m - 1), min_size=1, max_size=m, unique=True)
+        )
+        expr = a[sorted(rows), :]
+    else:
+        expr = xp.sort(a, axis=data.draw(st.integers(0, 1)))
+
+    oracle = np.asarray(expr.compute(executor=PythonDagExecutor()))
+    fused = np.asarray(expr.compute(executor=JaxExecutor()))
+    np.testing.assert_allclose(fused, oracle, rtol=1e-10, atol=1e-12)
+
+
 def _mesh_or_none():
     import jax
 

@@ -131,3 +131,16 @@ def test_sort_rejects_bool(spec):
     a = ct.from_array(np.zeros(4, dtype=bool), chunks=(2,), spec=spec)
     with pytest.raises(TypeError):
         xp.sort(a)
+
+
+def test_sort_axis_validation(spec):
+    import cubed_tpu as ct
+
+    a = ct.from_array(np.zeros((3, 4)), chunks=(2, 2), spec=spec)
+    with pytest.raises(IndexError):
+        xp.sort(a, axis=5)
+    with pytest.raises(IndexError):
+        xp.argsort(a, axis=-3)
+    s0 = ct.from_array(np.float64(3.0).reshape(()), chunks=(), spec=spec)
+    with pytest.raises(ValueError):
+        xp.sort(s0)

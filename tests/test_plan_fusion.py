@@ -91,6 +91,22 @@ def test_fused_segment_boundary_concat(spec):
     np.testing.assert_allclose(eager, expect)
 
 
+def test_var_multiaxis_region_combine(spec):
+    """var/std with axis=None over a multi-chunk 2-d grid: the executor's
+    region combine hands _var_combine a MULTI-AXIS block region in one call
+    (regression: it reduced only axis 0, silently corrupting the result —
+    found by the differential fuzzer)."""
+    an = np.asarray([[0.0, 1.0], [1.0, 1.0]])
+    a = ct.from_array(an, chunks=(1, 1), spec=spec)  # 4 single-element blocks
+    got = float(xp.var(a).compute(executor=JaxExecutor()))
+    np.testing.assert_allclose(got, an.var())
+    an2 = np.random.default_rng(0).random((6, 9))
+    b = ct.from_array(an2, chunks=(2, 3), spec=spec)
+    np.testing.assert_allclose(
+        float(xp.std(b).compute(executor=JaxExecutor())), an2.std(), rtol=1e-12
+    )
+
+
 def test_segment_task_events_partition_wall_time(spec):
     """Per-op TaskEndEvents of a fused segment must PARTITION the segment's
     wall time (contiguous, non-overlapping, summing to the total) — not each
