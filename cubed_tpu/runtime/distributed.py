@@ -1,0 +1,429 @@
+"""Multi-host control plane: a TCP coordinator/worker task fabric.
+
+This is the >1-host analogue of the reference's fleet executors
+(cubed/runtime/executors/lithops.py, modal.py, dask_distributed_async.py):
+those ship ``(function, input, config)`` payloads to cloud workers and rely
+on strongly-consistent object storage plus idempotent whole-chunk writes for
+correctness under retries and speculative duplicates. Here the fleet is a
+set of host processes — one per machine (on a TPU pod slice, one per TPU
+host) — connected to the coordinator over TCP (DCN in a pod deployment).
+All inter-task data still moves through the shared Zarr store (a shared
+filesystem or object store mount), exactly like the reference; the fabric
+carries only control messages and kilobyte-scale task payloads.
+
+Design choices, and why:
+
+- **Futures, not a new scheduler.** The coordinator exposes a
+  ``concurrent.futures``-shaped ``submit`` so the existing completion-ordered
+  machinery (``map_unordered``: retries, speculative straggler backups,
+  batched submission — cubed/runtime/executors/asyncio.py:11-102 in the
+  reference) drives remote tasks unchanged.
+- **Op payloads ship once per worker.** A task message carries the op's
+  ``(function, config)`` cloudpickle blob only the first time a given worker
+  sees that op (content-addressed by SHA-1); subsequent tasks reference the
+  blob id. This mirrors lithops' "upload the function once, map over inputs"
+  split without needing a side channel.
+- **Worker loss is an ordinary task failure.** A dropped connection fails
+  that worker's in-flight futures with ``WorkerLostError``; ``map_unordered``
+  resubmits (tasks are idempotent whole-chunk writes), and ``submit`` routes
+  to the surviving workers. No global restart, unlike the in-process pool
+  executor where a dead process breaks the whole pool.
+- **Worker clocks stamp task stats.** ``execute_with_stats`` runs on the
+  worker, so per-task timing/peak-RSS are measured where the work happens
+  (reference lithops.py:221-231 standardizes worker timestamps the same
+  way); cross-host clock skew is visible to timeline callbacks, as it is in
+  any distributed trace.
+
+Wire format: 8-byte big-endian length prefix + cloudpickle frame. The
+fabric trusts its peers (same trust model as dask/lithops workers — they
+already execute arbitrary user functions by design); deployments must scope
+the listen address/network accordingly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import socket
+import struct
+import threading
+import traceback
+from concurrent.futures import Future
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">Q")
+#: frames above this are rejected as corrupt/hostile length prefixes
+MAX_FRAME = 1 << 31
+
+
+class WorkerLostError(RuntimeError):
+    """The worker executing a task disconnected before reporting a result."""
+
+
+class RemoteTaskError(RuntimeError):
+    """A task raised on a worker; carries the remote traceback text."""
+
+
+class NoWorkersError(RuntimeError):
+    """No live workers are connected to the coordinator."""
+
+
+def send_frame(sock: socket.socket, obj: Any, lock: Optional[threading.Lock] = None) -> None:
+    import cloudpickle
+
+    payload = cloudpickle.dumps(obj)
+    data = _LEN.pack(len(payload)) + payload
+    if lock is not None:
+        with lock:
+            sock.sendall(data)
+    else:
+        sock.sendall(data)
+
+
+def recv_frame(sock: socket.socket) -> Any:
+    import cloudpickle
+
+    header = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise ConnectionError(f"frame length {n} exceeds limit")
+    return cloudpickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class _WorkerConn:
+    """Coordinator-side handle for one connected worker."""
+
+    def __init__(self, sock: socket.socket, address, hello: dict):
+        self.sock = sock
+        self.address = address
+        self.name = hello.get("name") or f"{address[0]}:{address[1]}"
+        self.nthreads = int(hello.get("nthreads", 1))
+        self.send_lock = threading.Lock()
+        self.outstanding: Dict[int, Future] = {}
+        self.blobs_sent: set[str] = set()
+        self.alive = True
+
+
+class Coordinator:
+    """Accepts worker connections and fans tasks out to them.
+
+    ``submit(execute_with_stats, function, input, config=...)`` matches how
+    ``map_unordered`` drives a ``concurrent.futures`` pool; the stats wrapper
+    runs worker-side, and the returned Future resolves to
+    ``(result, stats_dict)``.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = socket.create_server((host, port))
+        self._server.settimeout(0.2)
+        self.address = self._server.getsockname()[:2]
+        self._workers: list[_WorkerConn] = []
+        self._lock = threading.Lock()
+        self._next_task_id = 0
+        self._closed = threading.Event()
+        self._worker_joined = threading.Condition(self._lock)
+        self._blob_cache: Dict[tuple, tuple[str, bytes]] = {}
+        #: diagnostics: blob bytes actually sent vs referenced by id
+        self.stats: Dict[str, int] = {"blobs_sent": 0, "tasks_sent": 0}
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="coordinator-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- worker management ---------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                sock, addr = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                hello = recv_frame(sock)
+                if hello.get("type") != "hello":
+                    raise ConnectionError(f"bad hello: {hello!r}")
+            except Exception:
+                logger.exception("rejecting connection from %s", addr)
+                sock.close()
+                continue
+            conn = _WorkerConn(sock, addr, hello)
+            with self._lock:
+                self._workers.append(conn)
+                self._worker_joined.notify_all()
+            threading.Thread(
+                target=self._recv_loop,
+                args=(conn,),
+                name=f"coordinator-recv-{conn.name}",
+                daemon=True,
+            ).start()
+            logger.info("worker %s joined (%d threads)", conn.name, conn.nthreads)
+
+    def wait_for_workers(self, count: int, timeout: float = 60.0) -> None:
+        with self._lock:
+            ok = self._worker_joined.wait_for(
+                lambda: len([w for w in self._workers if w.alive]) >= count,
+                timeout=timeout,
+            )
+        if not ok:
+            raise TimeoutError(
+                f"only {self.n_workers} of {count} workers joined within {timeout}s"
+            )
+
+    @property
+    def n_workers(self) -> int:
+        with self._lock:
+            return len([w for w in self._workers if w.alive])
+
+    def _drop_worker(self, conn: _WorkerConn, reason: str) -> None:
+        with self._lock:
+            conn.alive = False
+            if conn in self._workers:
+                self._workers.remove(conn)
+            orphans = list(conn.outstanding.items())
+            conn.outstanding.clear()
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        for task_id, fut in orphans:
+            if not fut.done():
+                fut.set_exception(
+                    WorkerLostError(f"worker {conn.name} lost: {reason}")
+                )
+        if orphans or reason != "shutdown":
+            logger.warning(
+                "worker %s dropped (%s); failed %d in-flight tasks",
+                conn.name, reason, len(orphans),
+            )
+
+    def _recv_loop(self, conn: _WorkerConn) -> None:
+        try:
+            while conn.alive:
+                msg = recv_frame(conn.sock)
+                mtype = msg.get("type")
+                if mtype in ("result", "error"):
+                    with self._lock:
+                        fut = conn.outstanding.pop(msg["task_id"], None)
+                    if fut is None or fut.done():
+                        continue  # duplicate/late reply, or a cancelled twin
+                    if mtype == "result":
+                        fut.set_result((msg.get("result"), msg.get("stats", {})))
+                    else:
+                        fut.set_exception(RemoteTaskError(msg.get("error", "")))
+                else:
+                    logger.warning("unknown message from %s: %r", conn.name, mtype)
+        except (ConnectionError, OSError) as e:
+            if not self._closed.is_set():
+                self._drop_worker(conn, str(e) or type(e).__name__)
+        except Exception:
+            logger.exception("receiver for %s crashed", conn.name)
+            self._drop_worker(conn, "receiver crash")
+
+    # -- task submission -----------------------------------------------
+
+    def _blob_for(self, function, config) -> tuple[str, bytes]:
+        import cloudpickle
+
+        # the cached value keeps (function, config) alive so the id()-pair
+        # key can never be reused by a different object after GC
+        key = (id(function), id(config))
+        hit = self._blob_cache.get(key)
+        if hit is not None:
+            return hit[2], hit[3]
+        blob = cloudpickle.dumps((function, config))
+        blob_id = hashlib.sha1(blob).hexdigest()
+        self._blob_cache[key] = (function, config, blob_id, blob)
+        return blob_id, blob
+
+    def submit(self, _stats_wrapper, function, task_input, *, config=None) -> Future:
+        """Ship one task to the least-loaded live worker.
+
+        The first positional argument exists to mirror
+        ``pool.submit(execute_with_stats, function, input, config=...)``; the
+        wrapper always runs worker-side.
+        """
+        blob_id, blob = self._blob_for(function, config)
+        fut: Future = Future()
+        # routing may need a second try if a send races a worker death
+        while True:
+            with self._lock:
+                live = [w for w in self._workers if w.alive]
+                if not live:
+                    raise NoWorkersError("no live workers connected")
+                conn = min(live, key=lambda w: len(w.outstanding) / max(w.nthreads, 1))
+                task_id = self._next_task_id
+                self._next_task_id += 1
+                conn.outstanding[task_id] = fut
+                first_use = blob_id not in conn.blobs_sent
+            msg = {
+                "type": "task",
+                "task_id": task_id,
+                "blob_id": blob_id,
+                "blob": blob if first_use else None,
+                "input": task_input,
+            }
+            try:
+                send_frame(conn.sock, msg, conn.send_lock)
+            except (ConnectionError, OSError) as e:
+                with self._lock:
+                    conn.outstanding.pop(task_id, None)
+                self._drop_worker(conn, f"send failed: {e}")
+                continue  # pick another worker for the same future
+            except Exception:
+                # e.g. an unpicklable task input: the worker never saw the
+                # message, so only this submission's bookkeeping rolls back
+                with self._lock:
+                    conn.outstanding.pop(task_id, None)
+                raise
+            with self._lock:
+                # only mark the blob delivered once the send has succeeded
+                conn.blobs_sent.add(blob_id)
+            self.stats["tasks_sent"] += 1
+            if first_use:
+                self.stats["blobs_sent"] += 1
+            return fut
+
+    def close(self) -> None:
+        self._closed.set()
+        with self._lock:
+            workers = list(self._workers)
+        for conn in workers:
+            try:
+                send_frame(conn.sock, {"type": "shutdown"}, conn.send_lock)
+            except (ConnectionError, OSError):
+                pass
+            self._drop_worker(conn, "shutdown")
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+# ----------------------------------------------------------------------
+# worker side
+# ----------------------------------------------------------------------
+
+
+def run_worker(
+    coordinator: str,
+    nthreads: int = 1,
+    name: Optional[str] = None,
+) -> None:
+    """Connect to ``host:port`` and execute tasks until shutdown/EOF.
+
+    One process per host; ``nthreads`` concurrent task slots (chunk tasks are
+    IO + numpy/jax compute, so a few threads per host overlap IO with
+    compute the same way the threaded local executor does).
+    """
+    import cloudpickle
+    from concurrent.futures import ThreadPoolExecutor
+
+    from .utils import execute_with_stats
+
+    host, _, port = coordinator.rpartition(":")
+    sock = socket.create_connection((host or "127.0.0.1", int(port)))
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    send_lock = threading.Lock()
+    send_frame(
+        sock,
+        {
+            "type": "hello",
+            "name": name or f"{socket.gethostname()}:{os.getpid()}",
+            "nthreads": nthreads,
+            "pid": os.getpid(),
+        },
+        send_lock,
+    )
+    raw_blobs: Dict[str, bytes] = {}
+    decoded_blobs: Dict[str, tuple] = {}
+    stop = threading.Event()
+
+    def run_task(msg: dict) -> None:
+        task_id = msg["task_id"]
+        try:
+            blob_id = msg["blob_id"]
+            pair = decoded_blobs.get(blob_id)
+            if pair is None:
+                raw = raw_blobs.get(blob_id)
+                if raw is None:
+                    raise RuntimeError(
+                        f"unknown blob {blob_id!r} (coordinator/worker "
+                        "state disagree)"
+                    )
+                # decode here, inside the task try: an undeserializable op
+                # (missing module on this host, version skew) fails THIS
+                # task with a real traceback instead of killing the worker
+                pair = cloudpickle.loads(raw)
+                decoded_blobs[blob_id] = pair
+            function, config = pair
+            if config is not None:
+                result, stats = execute_with_stats(
+                    function, msg["input"], config=config
+                )
+            else:
+                result, stats = execute_with_stats(function, msg["input"])
+            try:
+                send_frame(
+                    sock,
+                    {"type": "result", "task_id": task_id, "result": result,
+                     "stats": stats},
+                    send_lock,
+                )
+            except (ConnectionError, OSError):
+                stop.set()
+            except Exception:
+                # unpicklable result (TypeError, PicklingError, ...): the
+                # value lives in the shared store anyway (tasks communicate
+                # through Zarr) — the task SUCCEEDED, so report completion
+                send_frame(
+                    sock,
+                    {"type": "result", "task_id": task_id, "result": None,
+                     "stats": stats},
+                    send_lock,
+                )
+        except Exception:
+            try:
+                send_frame(
+                    sock,
+                    {"type": "error", "task_id": task_id,
+                     "error": traceback.format_exc()},
+                    send_lock,
+                )
+            except (ConnectionError, OSError):
+                stop.set()
+
+    with ThreadPoolExecutor(max_workers=max(nthreads, 1)) as pool:
+        try:
+            while not stop.is_set():
+                msg = recv_frame(sock)
+                mtype = msg.get("type")
+                if mtype == "task":
+                    if msg.get("blob") is not None:
+                        raw_blobs[msg["blob_id"]] = msg["blob"]
+                    pool.submit(run_task, msg)
+                elif mtype == "shutdown":
+                    break
+                else:
+                    logger.warning("worker: unknown message %r", mtype)
+        except (ConnectionError, OSError):
+            pass  # coordinator gone: drain and exit
+    try:
+        sock.close()
+    except OSError:
+        pass

@@ -1,0 +1,38 @@
+"""Worker entry point: ``python -m cubed_tpu.runtime.worker HOST:PORT``.
+
+Start one per host, pointing at the coordinator created by
+``DistributedDagExecutor`` (its listen address; DCN-reachable in a TPU pod
+deployment). The shared ``Spec.work_dir`` must be reachable from every host
+(shared filesystem or object-store mount) — all chunk data moves through it,
+the socket carries control messages only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from .distributed import run_worker
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("coordinator", help="coordinator address, host:port")
+    parser.add_argument(
+        "--threads", type=int, default=1,
+        help="concurrent task slots in this worker process (default 1)",
+    )
+    parser.add_argument("--name", default=None, help="worker display name")
+    parser.add_argument(
+        "-v", "--verbose", action="store_true", help="log at INFO level"
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    run_worker(args.coordinator, nthreads=args.threads, name=args.name)
+
+
+if __name__ == "__main__":
+    main()
