@@ -18,6 +18,29 @@ import random as pyrandom
 import numpy as np
 
 from .backend_array_api import BACKEND, nxp
+
+if BACKEND == "jax":
+    # Counter-parallel threefry lowering: generates each element
+    # independently instead of odd/even halves + strided interleave — the
+    # interleave was measured as the dominant kernel in the vorticity
+    # benchmark's device profile (a 2-tuple "select_select" fusion at
+    # ~11 GB/s). This selects a DIFFERENT (still deterministic,
+    # platform-invariant) stream than the default lowering, which is fine
+    # for the per-block contract: the flag is set here, at import, before
+    # any generation, so every executor and worker sees the same stream
+    # (the numpy backend already has its own Philox stream, as the
+    # reference's backends do). The flag is process-global and not part of
+    # jax's jit cache key, so programs the APPLICATION jitted before this
+    # import keep the old lowering while new traces use the new one —
+    # set ``CUBED_TPU_THREEFRY_PARTITIONABLE=0`` to leave jax's default
+    # untouched if that matters more than generation speed
+    # (tests/test_random.py::test_partitionable_threefry_pinned).
+    import os as _os
+
+    if _os.environ.get("CUBED_TPU_THREEFRY_PARTITIONABLE", "1") != "0":
+        import jax as _jax_mod
+
+        _jax_mod.config.update("jax_threefry_partitionable", True)
 from .chunks import normalize_chunks
 from .core.ops import general_blockwise, new_array
 from .core.plan import Plan, gensym

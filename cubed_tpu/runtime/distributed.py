@@ -241,7 +241,10 @@ class Coordinator:
         import cloudpickle
 
         # the cached value keeps (function, config) alive so the id()-pair
-        # key can never be reused by a different object after GC
+        # key can never be reused by a different object after GC; the cache
+        # grows by one entry per op for the coordinator's lifetime (bytes
+        # must stay resendable: workers joining later, or losing tasks to a
+        # crash, receive the blob on their first task of that op)
         key = (id(function), id(config))
         hit = self._blob_cache.get(key)
         if hit is not None:
@@ -352,25 +355,31 @@ def run_worker(
     )
     raw_blobs: Dict[str, bytes] = {}
     decoded_blobs: Dict[str, tuple] = {}
+    blob_lock = threading.Lock()
     stop = threading.Event()
 
     def run_task(msg: dict) -> None:
         task_id = msg["task_id"]
         try:
             blob_id = msg["blob_id"]
-            pair = decoded_blobs.get(blob_id)
-            if pair is None:
-                raw = raw_blobs.get(blob_id)
-                if raw is None:
-                    raise RuntimeError(
-                        f"unknown blob {blob_id!r} (coordinator/worker "
-                        "state disagree)"
-                    )
-                # decode here, inside the task try: an undeserializable op
-                # (missing module on this host, version skew) fails THIS
-                # task with a real traceback instead of killing the worker
-                pair = cloudpickle.loads(raw)
-                decoded_blobs[blob_id] = pair
+            # decode under a lock (concurrent same-blob tasks must not race
+            # the decode/pop), inside the task try: an undeserializable op
+            # (missing module on this host, version skew) fails THIS task
+            # with a real traceback instead of killing the worker
+            with blob_lock:
+                pair = decoded_blobs.get(blob_id)
+                if pair is None:
+                    raw = raw_blobs.get(blob_id)
+                    if raw is None:
+                        raise RuntimeError(
+                            f"unknown blob {blob_id!r} (coordinator/worker "
+                            "state disagree)"
+                        )
+                    pair = cloudpickle.loads(raw)
+                    decoded_blobs[blob_id] = pair
+                    # raw bytes are dead weight once decoded (late
+                    # duplicate tasks hit decoded_blobs first)
+                    raw_blobs.pop(blob_id, None)
             function, config = pair
             if config is not None:
                 result, stats = execute_with_stats(

@@ -33,6 +33,7 @@ from typing import Optional
 from ..distributed import Coordinator
 from ..pipeline import visit_node_generations, visit_nodes
 from ..types import DagExecutor, OperationStartEvent, callbacks_on
+from ..utils import merge_generation
 from .multiprocess import _PLUGIN_ENV_PREFIXES
 from .python_async import DEFAULT_RETRIES, map_unordered
 
@@ -110,8 +111,8 @@ class DistributedDagExecutor(DagExecutor):
             host, _, port = self.listen.rpartition(":")
             coord = Coordinator(host or "0.0.0.0", int(port or 0))
             logger.info(
-                "coordinator listening on %s; waiting for %d workers",
-                self.coordinator_address, self.min_workers,
+                "coordinator listening on %s:%s; waiting for %d workers",
+                coord.address[0], coord.address[1], self.min_workers,
             )
         else:
             coord = Coordinator("127.0.0.1", 0)
@@ -193,22 +194,11 @@ class DistributedDagExecutor(DagExecutor):
 
         if compute_arrays_in_parallel:
             for generation in visit_node_generations(dag, resume=resume):
-                merged = []
-                fns = {}
-                for name, node in generation:
-                    primitive_op = node["primitive_op"]
-                    callbacks_on(
-                        callbacks, "on_operation_start",
-                        OperationStartEvent(name, primitive_op.num_tasks),
-                    )
-                    fns[name] = node["primitive_op"].pipeline
-                    for m in primitive_op.pipeline.mappable:
-                        merged.append((name, m))
+                merged, pipelines = merge_generation(generation, callbacks)
                 if not merged:
                     continue
-                pool = _InterleavedPool(coord, fns)
                 map_unordered(
-                    pool,
+                    _InterleavedPool(coord, pipelines),
                     None,
                     merged,
                     retries=retries,

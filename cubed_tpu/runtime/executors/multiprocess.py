@@ -31,6 +31,7 @@ from typing import Optional
 
 from ..pipeline import visit_node_generations, visit_nodes
 from ..types import DagExecutor, OperationStartEvent, callbacks_on
+from ..utils import merge_generation
 from .python_async import DEFAULT_RETRIES, map_unordered
 
 logger = logging.getLogger(__name__)
@@ -149,22 +150,11 @@ class MultiprocessDagExecutor(DagExecutor):
         try:
             if compute_arrays_in_parallel:
                 for generation in visit_node_generations(dag, resume=resume):
-                    for name, node in generation:
-                        callbacks_on(
-                            callbacks, "on_operation_start",
-                            OperationStartEvent(
-                                name, node["primitive_op"].num_tasks
-                            ),
-                        )
-                    merged = []
-                    runners = {}
-                    for name, node in generation:
-                        pipeline = node["primitive_op"].pipeline
-                        runners[name] = _ProcessTaskRunner(
-                            pipeline.function, pipeline.config
-                        )
-                        for m in pipeline.mappable:
-                            merged.append((name, m))
+                    merged, pipelines = merge_generation(generation, callbacks)
+                    runners = {
+                        name: _ProcessTaskRunner(p.function, p.config)
+                        for name, p in pipelines.items()
+                    }
 
                     # interleaved tasks still go through one unordered map
                     pool = self._map_surviving_pool_crash(

@@ -22,7 +22,7 @@ from ..types import (
     OperationStartEvent,
     callbacks_on,
 )
-from ..utils import batched, execute_with_stats, handle_callbacks
+from ..utils import batched, execute_with_stats, handle_callbacks, merge_generation
 
 logger = logging.getLogger(__name__)
 
@@ -213,18 +213,11 @@ class AsyncPythonDagExecutor(DagExecutor):
             if compute_arrays_in_parallel:
                 # ops in the same topological generation interleave their tasks
                 for generation in visit_node_generations(dag, resume=resume):
-                    merged = []
-                    for name, node in generation:
-                        primitive_op = node["primitive_op"]
-                        callbacks_on(
-                            callbacks, "on_operation_start",
-                            OperationStartEvent(name, primitive_op.num_tasks),
-                        )
-                        pipeline = primitive_op.pipeline
-                        for m in pipeline.mappable:
-                            merged.append((name, pipeline, m))
-                    # run the merged generation
-                    self._run_tasks(pool, merged, retries, use_backups, batch_size, callbacks)
+                    merged, pipelines = merge_generation(generation, callbacks)
+                    self._run_tasks(
+                        pool, merged, pipelines, retries, use_backups,
+                        batch_size, callbacks,
+                    )
             else:
                 for name, node in visit_nodes(dag, resume=resume):
                     primitive_op = node["primitive_op"]
@@ -245,27 +238,21 @@ class AsyncPythonDagExecutor(DagExecutor):
                         config=pipeline.config,
                     )
 
-    def _run_tasks(self, pool, merged, retries, use_backups, batch_size, callbacks):
-        def run_one(item):
-            name, pipeline, m = item
+    def _run_tasks(
+        self, pool, merged, pipelines, retries, use_backups, batch_size, callbacks
+    ):
+        def fn(item):
+            name, m = item
+            pipeline = pipelines[name]
             return pipeline.function(m, config=pipeline.config)
-
-        # reuse map_unordered by currying per-item functions
-        inputs = list(range(len(merged)))
-
-        def fn(i):
-            name, pipeline, m = merged[i]
-            return pipeline.function(m, config=pipeline.config)
-
-        names = [m[0] for m in merged]
 
         map_unordered(
             pool,
             fn,
-            inputs,
+            merged,
             retries=retries,
             use_backups=use_backups,
             batch_size=batch_size,
             callbacks=callbacks,
-            array_names=names,
+            array_names=[name for name, _ in merged],
         )

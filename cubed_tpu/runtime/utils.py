@@ -11,7 +11,7 @@ from functools import partial
 from typing import Iterable, Iterator, Optional, Sequence
 
 from ..utils import peak_measured_mem
-from .types import Callback, TaskEndEvent
+from .types import Callback, OperationStartEvent, TaskEndEvent, callbacks_on
 
 
 def execute_with_stats(function, *args, **kwargs):
@@ -42,6 +42,30 @@ def handle_callbacks(callbacks: Optional[Sequence[Callback]], stats: dict) -> No
     event = TaskEndEvent(**stats)
     for cb in callbacks:
         cb.on_task_end(event)
+
+
+def merge_generation(generation, callbacks) -> tuple[list, dict]:
+    """Interleave one topological generation's tasks for a single map.
+
+    Fires ``on_operation_start`` for every op in the generation and returns
+    ``(items, pipelines)``: ``items`` is the merged ``(op_name, task_input)``
+    list and ``pipelines`` maps op name → its pipeline, so the caller can
+    resolve each item's ``(function, config)``. Shared by every executor
+    that supports ``compute_arrays_in_parallel`` (reference:
+    cubed/runtime/executors/python_async.py:93-114).
+    """
+    items: list = []
+    pipelines: dict = {}
+    for name, node in generation:
+        primitive_op = node["primitive_op"]
+        callbacks_on(
+            callbacks, "on_operation_start",
+            OperationStartEvent(name, primitive_op.num_tasks),
+        )
+        pipelines[name] = primitive_op.pipeline
+        for m in primitive_op.pipeline.mappable:
+            items.append((name, m))
+    return items, pipelines
 
 
 def batched(iterable: Iterable, n: int) -> Iterator[list]:

@@ -34,3 +34,49 @@ def test_random_blocks_differ(spec):
     a = cubed_tpu.random.random((8, 8), chunks=(4, 4), spec=spec)
     x = a.compute()
     assert not np.array_equal(x[:4, :4], x[4:, 4:])
+
+
+def test_partitionable_threefry_pinned():
+    """cubed_tpu.random pins jax_threefry_partitionable=True (a different —
+    still deterministic — stream than jax's default lowering, chosen for
+    TPU generation speed). The flag must be set before any generation and
+    never flipped: it is not part of jax's jit cache key, so a mid-process
+    flip would silently serve programs with the old lowering."""
+    import os
+
+    import pytest
+
+    from cubed_tpu.backend_array_api import BACKEND
+
+    if BACKEND != "jax" or os.environ.get(
+        "CUBED_TPU_THREEFRY_PARTITIONABLE", "1"
+    ) == "0":
+        pytest.skip("flag only pinned on the jax backend without the opt-out")
+    import jax
+
+    assert jax.config.jax_threefry_partitionable  # set at import
+
+
+def test_random_deterministic_across_processes(spec):
+    """The stream definition is process-invariant: a fresh interpreter
+    generating the same block with the same seed matches this process."""
+    import subprocess
+    import sys
+
+    code = (
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        "import cubed_tpu.random  # pins the flag\n"
+        "k = jax.random.fold_in(jax.random.key(0), 42)\n"
+        "print(repr(np.asarray(jax.random.uniform(k, (4,), jnp.float32)).tolist()))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, check=True,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    import jax
+    import jax.numpy as jnp
+
+    k = jax.random.fold_in(jax.random.key(0), 42)
+    here = np.asarray(jax.random.uniform(k, (4,), jnp.float32)).tolist()
+    assert eval(out.stdout.strip()) == here
