@@ -231,3 +231,183 @@ def std(x, /, *, axis=None, correction=0.0, keepdims=False, split_every=None):
 
     return sqrt(var(x, axis=axis, correction=correction, keepdims=keepdims,
                     split_every=split_every))
+
+
+# -- cumulative_sum / cumulative_prod (2023.12 standard; beyond-reference) --
+#
+# The reference has no cumulative scan at all. Chunked prefix scan in two
+# passes, both XLA-friendly (cumsum lowers to an associative scan):
+#   1. per-block inclusive scan (embarrassingly parallel);
+#   2. per-block totals -> one tiny single-chunk exclusive scan along the
+#      axis -> per-block offsets, combined into the local scans blockwise.
+# All intermediates are bounded: the totals array has one element per block
+# along the scanned axis.
+
+
+def _cumsum_backend(a, axis, dtype):
+    return nxp.cumsum(a, axis=axis, dtype=dtype)
+
+
+def _cumprod_backend(a, axis, dtype):
+    return nxp.cumprod(a, axis=axis, dtype=dtype)
+
+
+def _scan_default_dtype(x_dtype):
+    if x_dtype in _signed_integer_dtypes:
+        return int64
+    if x_dtype in _unsigned_integer_dtypes:
+        return uint64
+    return x_dtype
+
+
+def _cumulative(x, axis, dtype, include_initial, *, scan, reduce_fn, identity):
+    from ..core.ops import general_blockwise, rechunk
+
+    if x.dtype not in _numeric_dtypes:
+        raise TypeError("Only numeric dtypes are allowed in cumulative scans")
+    if axis is None:
+        if x.ndim > 1:
+            raise ValueError(
+                "axis must be specified for multi-dimensional cumulative scans"
+            )
+        axis = 0
+    if not -x.ndim <= axis < x.ndim:
+        raise IndexError(f"axis {axis} out of bounds for ndim {x.ndim}")
+    axis = axis % x.ndim
+    if dtype is None:
+        dtype = _scan_default_dtype(x.dtype)
+    dtype = np.dtype(dtype)
+
+    # CoreArray grids are always the regular blockdims of chunksize, so the
+    # offsets pipeline below can rebuild every stage's grid from x.chunksize
+    # with block coordinates staying 1:1 with x's
+    chunkset = x.chunks
+    nb = len(chunkset[axis])
+
+    # 1. per-block inclusive scan
+    def _local(a):
+        return scan(a, axis, dtype)
+
+    local = general_blockwise(
+        _local,
+        _same_block(x.name),
+        x,
+        shape=x.shape,
+        dtype=dtype,
+        chunks=chunkset,
+        op_name="cumulative-local",
+    )
+
+    if nb > 1:
+        # 2a. per-block totals: grid unchanged except size-1 blocks on axis
+        def _totals(a):
+            return reduce_fn(a, axis=(axis,), keepdims=True, dtype=dtype)
+
+        totals_chunks = tuple(
+            (1,) * nb if d == axis else chunkset[d] for d in range(x.ndim)
+        )
+        totals_shape = tuple(
+            nb if d == axis else s for d, s in enumerate(x.shape)
+        )
+        totals = general_blockwise(
+            _totals,
+            _same_block(x.name),
+            x,
+            shape=totals_shape,
+            dtype=dtype,
+            chunks=totals_chunks,
+            op_name="cumulative-totals",
+        )
+        # 2b. exclusive scan of the totals along the (now tiny) axis
+        one_chunk = tuple(
+            nb if d == axis else x.chunksize[d] for d in range(x.ndim)
+        )
+        gathered = rechunk(totals, one_chunk)
+
+        def _exclusive(t):
+            # shift the inclusive scan right by one block-slot, filling with
+            # the identity (no subtract/divide: exact for unsigned wrap and
+            # for products containing zeros)
+            incl = scan(t, axis, dtype)
+            head = tuple(
+                slice(0, 1) if d == axis else slice(None) for d in range(t.ndim)
+            )
+            body = tuple(
+                slice(0, -1) if d == axis else slice(None) for d in range(t.ndim)
+            )
+            lead = nxp.full_like(incl[head], identity)
+            return nxp.concatenate([lead, incl[body]], axis=axis)
+
+        excl = general_blockwise(
+            _exclusive,
+            _same_block(gathered.name),
+            gathered,
+            shape=totals_shape,
+            dtype=dtype,
+            chunks=gathered.chunks,
+            op_name="cumulative-exclusive",
+        )
+        offsets = rechunk(excl, tuple(
+            1 if d == axis else x.chunksize[d] for d in range(x.ndim)
+        ))
+
+        # 3. combine: out block i = local block i (+ or *) offsets block i
+        l_name, o_name = local.name, offsets.name
+
+        def _block_function(out_key):
+            coords = out_key[1:]
+            return ((l_name, *coords), (o_name, *coords))
+
+        combine = _combine_add if identity == 0 else _combine_mul
+        local = general_blockwise(
+            combine,
+            _block_function,
+            local,
+            offsets,
+            shape=x.shape,
+            dtype=dtype,
+            chunks=chunkset,
+            op_name="cumulative-combine",
+        )
+
+    if include_initial:
+        from .creation_functions import full
+        from .manipulation_functions import concat
+
+        lead_shape = tuple(
+            1 if d == axis else s for d, s in enumerate(x.shape)
+        )
+        lead = full(lead_shape, identity, dtype=dtype, spec=x.spec)
+        return concat([lead, local], axis=axis)
+    return local
+
+
+def _same_block(name):
+    def block_function(out_key):
+        return ((name, *out_key[1:]),)
+
+    return block_function
+
+
+def _combine_add(a, o):
+    return nxp.add(a, o)
+
+
+def _combine_mul(a, o):
+    return nxp.multiply(a, o)
+
+
+def cumulative_sum(x, /, *, axis=None, dtype=None, include_initial=False):
+    """Cumulative sum along ``axis`` (array-api 2023.12; reference gap)."""
+    return _cumulative(
+        x, axis, dtype, include_initial,
+        scan=_cumsum_backend, reduce_fn=_sum_with_dtype, identity=0,
+    )
+
+
+def cumulative_prod(x, /, *, axis=None, dtype=None, include_initial=False):
+    """Cumulative product along ``axis`` (array-api 2023.12; reference gap)."""
+    return _cumulative(
+        x, axis, dtype, include_initial,
+        scan=_cumprod_backend, reduce_fn=_prod_with_dtype, identity=1,
+    )

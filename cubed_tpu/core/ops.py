@@ -123,9 +123,36 @@ def from_zarr(store, path=None, spec=None, storage_options=None) -> "CoreArray":
     return new_array(name, target, spec, plan)
 
 
-def to_zarr(x: CoreArray, store, path=None, executor=None, storage_options=None, **kwargs) -> None:
-    """Compute the array and write it to a new Zarr store (eagerly)."""
-    out = _store_op(x, store if path is None else f"{store}/{path}", storage_options)
+def to_zarr(
+    x: CoreArray,
+    store,
+    path=None,
+    executor=None,
+    storage_options=None,
+    compressor=None,
+    **kwargs,
+) -> None:
+    """Compute the array and write it to a new Zarr store (eagerly).
+
+    ``compressor`` is a Zarr v2 compressor config (e.g.
+    ``{"id": "zlib", "level": 1}``; stdlib codecs zlib/gzip/bz2/lzma). The
+    target metadata is stamped up front, so every chunk write — any
+    executor, any worker — round-trips through the codec (the lazy target
+    creation opens existing metadata rather than clobbering it,
+    reference cubed/core/plan.py:430-432 semantics).
+    """
+    target = str(store) if path is None else f"{store}/{path}"
+    if compressor is not None:
+        open_zarr_array(
+            target,
+            mode="w",
+            shape=x.shape,
+            dtype=x.dtype,
+            chunks=x.chunksize if x.ndim else (),
+            storage_options=storage_options,
+            compressor=compressor,
+        )
+    out = _store_op(x, target, storage_options)
     out.compute(executor=executor, **kwargs)
 
 

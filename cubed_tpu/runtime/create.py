@@ -19,6 +19,10 @@ def create_executor(name: str, executor_options: Optional[dict] = None):
         from .executors.multiprocess import MultiprocessDagExecutor
 
         return MultiprocessDagExecutor(**executor_options)
+    if name == "distributed":
+        from .executors.distributed import DistributedDagExecutor
+
+        return DistributedDagExecutor(**executor_options)
     if name in ("jax", "tpu", "jax-tpu"):
         from .executors.jax import JaxExecutor
 

@@ -169,6 +169,15 @@ class DistributedDagExecutor(DagExecutor):
         except Exception:
             pass
 
+    def __getstate__(self):
+        # the executor can ride inside a Spec that gets serialized into task
+        # payloads; the fleet (sockets, subprocesses) is process-local state
+        # a worker neither needs nor could use
+        state = self.__dict__.copy()
+        state["_coordinator"] = None
+        state["_procs"] = []
+        return state
+
     # -- execution -----------------------------------------------------
 
     def execute_dag(

@@ -119,6 +119,49 @@ def _decode_dtype(d: Any) -> np.dtype:
     return np.dtype(d)
 
 
+def _codec_from_meta(comp: Optional[dict]):
+    """(compress, decompress) callables for a Zarr v2 ``compressor`` config.
+
+    Covers the numcodecs ids expressible with the stdlib — ``zlib``,
+    ``gzip``, ``bz2``, ``lzma`` — which is what this image can run (no
+    numcodecs/blosc wheel; the reference's default blosc-compressed stores
+    need that C library and fail here with a clear message instead of
+    garbage)."""
+    if comp is None:
+        return None
+    cid = comp.get("id")
+    if cid == "zlib":
+        import zlib
+
+        level = int(comp.get("level", 1))
+        return (lambda b: zlib.compress(b, level)), zlib.decompress
+    if cid == "gzip":
+        import gzip
+
+        level = int(comp.get("level", 1))
+        return (lambda b: gzip.compress(b, compresslevel=level)), gzip.decompress
+    if cid == "bz2":
+        import bz2
+
+        level = int(comp.get("level", 1))
+        return (lambda b: bz2.compress(b, level)), bz2.decompress
+    if cid == "lzma":
+        import lzma
+
+        preset = comp.get("preset")
+        fmt = comp.get("format", lzma.FORMAT_XZ)
+        filters = comp.get("filters")
+        return (
+            lambda b: lzma.compress(b, format=fmt, preset=preset, filters=filters),
+            lzma.decompress,
+        )
+    raise ValueError(
+        f"Unsupported Zarr compressor {cid!r}: this store supports the "
+        "stdlib codecs zlib/gzip/bz2/lzma (blosc and friends need the "
+        "numcodecs C library, absent from this environment)"
+    )
+
+
 def _encode_fill(fill_value: Any, dtype: np.dtype) -> Any:
     if fill_value is None:
         return None
@@ -164,6 +207,8 @@ class ZarrV2Array:
         self.chunks: tuple[int, ...] = tuple(meta["chunks"])
         self.dtype: np.dtype = _decode_dtype(meta["dtype"])
         self.fill_value = _decode_fill(meta.get("fill_value"), self.dtype)
+        self.compressor: Optional[dict] = meta.get("compressor")
+        self._codec = _codec_from_meta(self.compressor)
 
     # -- metadata ----------------------------------------------------------
 
@@ -225,12 +270,17 @@ class ZarrV2Array:
         if not self._io.exists(key):
             return None
         data = self._io.read_bytes(key)
+        if self._codec is not None:
+            data = self._codec[1](data)
         arr = np.frombuffer(data, dtype=self.dtype)
         return arr.reshape(self.chunks if self.shape else ())
 
     def _write_chunk(self, idx: tuple[int, ...], arr: np.ndarray) -> None:
         arr = np.ascontiguousarray(arr, dtype=self.dtype)
-        self._io.write_bytes_atomic(self._chunk_key(idx), arr.tobytes())
+        data = arr.tobytes()
+        if self._codec is not None:
+            data = self._codec[0](data)
+        self._io.write_bytes_atomic(self._chunk_key(idx), data)
 
     def _empty_chunk(self) -> np.ndarray:
         fill = self.fill_value if self.fill_value is not None else 0
@@ -436,6 +486,7 @@ def open_zarr_array(
     chunks: Optional[Sequence[int]] = None,
     fill_value: Any = None,
     storage_options: Optional[dict] = None,
+    compressor: Optional[dict] = None,
 ) -> ZarrV2Array:
     """Open (or create) a Zarr v2 array at *store*.
 
@@ -458,12 +509,14 @@ def open_zarr_array(
         chunks = shape
     chunks = tuple(int(c) for c in chunks) if shape else ()
     chunks = tuple(min(c, s) if s > 0 else max(1, c) for c, s in zip(chunks, shape))
+    if compressor is not None:
+        _codec_from_meta(compressor)  # unsupported ids fail at create time
     meta = {
         "zarr_format": 2,
         "shape": list(shape),
         "chunks": [max(1, c) for c in chunks] if shape else [],
         "dtype": _encode_dtype(dtype),
-        "compressor": None,
+        "compressor": dict(compressor) if compressor is not None else None,
         "fill_value": _encode_fill(fill_value if fill_value is not None else 0, dtype),
         "order": "C",
         "filters": None,

@@ -195,6 +195,20 @@ def test_distributed_undeserializable_blob_fails_task_not_worker(spec, fleet):
     assert ok == 16.0
 
 
+def test_distributed_by_name(tmp_path):
+    """Registry path: Spec(executor_name='distributed') builds the fleet."""
+    spec = ct.Spec(
+        work_dir=str(tmp_path), allowed_mem="500MB",
+        executor_name="distributed",
+        executor_options=dict(n_local_workers=2),
+    )
+    a = ct.from_array(np.ones((6, 6)), chunks=(3, 3), spec=spec)
+    try:
+        assert float(xp.sum(a).compute()) == 36.0
+    finally:
+        spec.executor.close()
+
+
 def test_distributed_out_of_band_worker(spec):
     """The real multi-host path: a fixed listen address and a worker started
     by hand (as it would be on another host), no local spawning."""

@@ -226,3 +226,81 @@ def test_raw_chunk_layout_c_order_readback(tmp_path):
             j1 = min(j0 + chunks[1], meta["shape"][1])
             out[i0:i1, j0:j1] = block[: i1 - i0, : j1 - j0]
     np.testing.assert_array_equal(out, an)
+
+
+@pytest.mark.parametrize(
+    "compressor",
+    [
+        {"id": "zlib", "level": 5},
+        {"id": "gzip", "level": 1},
+        {"id": "bz2", "level": 1},
+        {"id": "lzma", "preset": 0},
+    ],
+)
+def test_compressed_roundtrip(tmp_path, compressor):
+    store = str(tmp_path / "c.zarr")
+    z = open_zarr_array(
+        store, "w", shape=(5, 7), dtype=np.float64, chunks=(2, 3),
+        compressor=compressor,
+    )
+    an = np.arange(35.0).reshape(5, 7)
+    z[...] = an
+    np.testing.assert_array_equal(z[...], an)
+    # reopened array picks the codec up from the on-disk metadata
+    z2 = open_zarr_array(store, "r")
+    assert z2.compressor["id"] == compressor["id"]
+    np.testing.assert_array_equal(z2[...], an)
+    # chunk objects on disk really are compressed (not raw C-order bytes)
+    meta = json.loads(open(os.path.join(store, ".zarray")).read())
+    assert meta["compressor"]["id"] == compressor["id"]
+    raw = open(os.path.join(store, "0.0"), "rb").read()
+    assert raw != an[:2, :3].tobytes()
+
+
+def test_compressed_interop_zlib(tmp_path):
+    """Read a zlib-compressed chunk written byte-for-byte the way any other
+    Zarr v2 implementation would write it (spec fixture, no zarr-python)."""
+    import zlib
+
+    store = tmp_path / "other.zarr"
+    store.mkdir()
+    an = np.arange(6.0).reshape(2, 3)
+    meta = {
+        "zarr_format": 2,
+        "shape": [2, 3],
+        "chunks": [2, 3],
+        "dtype": "<f8",
+        "compressor": {"id": "zlib", "level": 1},
+        "fill_value": 0.0,
+        "order": "C",
+        "filters": None,
+    }
+    (store / ".zarray").write_text(json.dumps(meta))
+    (store / "0.0").write_bytes(zlib.compress(an.tobytes(), 1))
+    z = open_zarr_array(str(store), "r")
+    np.testing.assert_array_equal(z[...], an)
+
+
+def test_unsupported_compressor_raises(tmp_path):
+    with pytest.raises(ValueError, match="blosc"):
+        open_zarr_array(
+            str(tmp_path / "b.zarr"), "w", shape=(2,), dtype=np.float64,
+            chunks=(2,), compressor={"id": "blosc", "cname": "lz4"},
+        )
+
+
+def test_to_zarr_compressed_end_to_end(tmp_path):
+    import cubed_tpu as ct
+    import cubed_tpu.array_api as xp
+
+    spec_ = ct.Spec(work_dir=str(tmp_path / "work"), allowed_mem="500MB")
+    an = np.arange(100.0).reshape(10, 10)
+    a = ct.from_array(an, chunks=(4, 4), spec=spec_)
+    target = str(tmp_path / "out.zarr")
+    ct.to_zarr(xp.add(a, 1.0), target, compressor={"id": "zlib", "level": 1})
+    z = open_zarr_array(target, "r")
+    assert z.compressor == {"id": "zlib", "level": 1}
+    np.testing.assert_array_equal(z[...], an + 1.0)
+    # and from_zarr reads it back through the framework
+    b = ct.from_zarr(target)
+    np.testing.assert_array_equal(b.compute(), an + 1.0)

@@ -362,3 +362,87 @@ def test_scalar_conversions(spec):
     assert float(s) == 3.0
     i = xp.sum(xp.asarray([1, 2, 3], spec=spec))
     assert int(i) == 6
+
+
+# -- cumulative_sum / cumulative_prod (2023.12; beyond-reference) ----------
+
+
+@pytest.mark.parametrize("axis", [0, 1, -1])
+@pytest.mark.parametrize("chunks", [(2, 3), (4, 4), (3, 7)])
+def test_cumulative_sum_matches_numpy(spec, axis, chunks):
+    an = np.arange(28.0).reshape(4, 7)
+    a = ct.from_array(an, chunks=chunks, spec=spec)
+    got = xp.cumulative_sum(a, axis=axis).compute()
+    np.testing.assert_allclose(got, np.cumsum(an, axis=axis))
+
+
+def test_cumulative_sum_1d_default_axis(spec):
+    an = np.arange(11.0)
+    a = ct.from_array(an, chunks=(4,), spec=spec)
+    np.testing.assert_allclose(xp.cumulative_sum(a).compute(), np.cumsum(an))
+
+
+def test_cumulative_sum_multidim_requires_axis(spec):
+    a = ct.from_array(np.ones((4, 4)), chunks=(2, 2), spec=spec)
+    with pytest.raises(ValueError):
+        xp.cumulative_sum(a)
+
+
+def test_cumulative_sum_int_upcast(spec):
+    an = np.arange(10, dtype=np.int32)
+    a = ct.from_array(an, chunks=(3,), spec=spec)
+    r = xp.cumulative_sum(a)
+    assert r.dtype == np.int64
+    np.testing.assert_array_equal(r.compute(), np.cumsum(an, dtype=np.int64))
+
+
+def test_cumulative_sum_include_initial(spec):
+    an = np.arange(12.0).reshape(3, 4)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    got = xp.cumulative_sum(a, axis=1, include_initial=True).compute()
+    expect = np.concatenate(
+        [np.zeros((3, 1)), np.cumsum(an, axis=1)], axis=1
+    )
+    np.testing.assert_allclose(got, expect)
+
+
+def test_cumulative_prod_matches_numpy(spec):
+    rng = np.random.default_rng(0)
+    an = rng.uniform(0.5, 1.5, (5, 6))
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    got = xp.cumulative_prod(a, axis=0).compute()
+    np.testing.assert_allclose(got, np.cumprod(an, axis=0))
+
+
+def test_cumulative_prod_with_zeros(spec):
+    an = np.array([2.0, 0.0, 3.0, 4.0, 5.0, 6.0])
+    a = ct.from_array(an, chunks=(2,), spec=spec)
+    np.testing.assert_allclose(
+        xp.cumulative_prod(a).compute(), np.cumprod(an)
+    )
+
+
+def test_cumulative_prod_include_initial(spec):
+    an = np.arange(1.0, 7.0)
+    a = ct.from_array(an, chunks=(2,), spec=spec)
+    got = xp.cumulative_prod(a, include_initial=True).compute()
+    np.testing.assert_allclose(
+        got, np.concatenate([[1.0], np.cumprod(an)])
+    )
+
+
+def test_cumulative_sum_single_block_axis(spec):
+    an = np.arange(12.0).reshape(3, 4)
+    a = ct.from_array(an, chunks=(3, 2), spec=spec)  # one block on axis 0
+    np.testing.assert_allclose(
+        xp.cumulative_sum(a, axis=0).compute(), np.cumsum(an, axis=0)
+    )
+
+
+def test_cumulative_sum_jax_executor(spec):
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    an = np.arange(60.0).reshape(6, 10)
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    got = xp.cumulative_sum(a, axis=1).compute(executor=JaxExecutor())
+    np.testing.assert_allclose(got, np.cumsum(an, axis=1))
