@@ -1,10 +1,13 @@
 """Benchmark: the BASELINE.json north-star workloads.
 
-Two configs, both measured every run (VERDICT r2 item 3):
+Three configs, all measured every run (VERDICT r2 item 3):
 
 1. ``addsum`` — BASELINE.json config #1: ``xp.add(a, b).sum()`` on
    5000x5000 f64 at (1000, 1000) chunks.
-2. ``vorticity`` — the pangeo-vorticity pipeline (reference
+2. ``matmul`` — BASELINE.json config #4: ``sum(a @ b)`` on 4000x4000 at
+   (1000, 1000) chunks — the blockwise contraction + tree-reduce path,
+   reported in GFLOP/s (the MXU configuration).
+3. ``vorticity`` — the pangeo-vorticity pipeline (reference
    examples/pangeo-vorticity.ipynb): four random arrays,
    ``mean(a[1:]*x + b[1:]*y)`` at (500, 450, 400) f64, chunks=100 (the
    notebook's (1000,900,800) exceeds one chip's HBM; the driver's mesh
@@ -53,6 +56,13 @@ ADDSUM_CHUNK = 1000
 #: 2 generated arrays + 1 fused add+sum pass over both
 ADDSUM_WORK_BYTES = 2 * ADDSUM_SHAPE[0] * ADDSUM_SHAPE[1] * 8
 
+#: BASELINE.json config #4: matmul/tensordot via blockwise contraction.
+#: sum(a @ b) keeps the output on-device (a scalar fetch, not a 128MB
+#: transfer), so the number measures the contraction, not the tunnel.
+MATMUL_N = 4000
+MATMUL_CHUNK = 1000
+MATMUL_FLOPS = 2 * MATMUL_N**3
+
 _T0 = time.monotonic()
 
 
@@ -81,6 +91,11 @@ def build():
         a = cubed_tpu.random.random(shape, chunks=chunk, spec=spec)
         b = cubed_tpu.random.random(shape, chunks=chunk, spec=spec)
         return xp.sum(xp.add(a, b))
+    if workload == "matmul":
+        n, chunk = {matmul_n!r}, {matmul_chunk!r}
+        a = cubed_tpu.random.random((n, n), chunks=chunk, spec=spec)
+        b = cubed_tpu.random.random((n, n), chunks=chunk, spec=spec)
+        return xp.sum(xp.matmul(a, b))
     shape, chunk = {shape!r}, {chunk!r}
     a = cubed_tpu.random.random(shape, chunks=chunk, spec=spec)
     b = cubed_tpu.random.random(shape, chunks=chunk, spec=spec)
@@ -104,6 +119,9 @@ v = float(val)
 if workload == "addsum":
     n = {addsum_shape!r}[0] * {addsum_shape!r}[1]
     assert 0.95 < v / n < 1.05, v  # sum of u1+u2 has mean 1.0 per element
+elif workload == "matmul":
+    n = {matmul_n!r}
+    assert 0.9 < v / (0.25 * n**3) < 1.1, v  # E[sum(A@B)] = n^3/4 for uniforms
 else:
     assert 0.45 < v < 0.55, v  # mean of u1*u2 + u3*u4 over uniforms is ~0.5
 print(json.dumps({{"elapsed": t1 - t0, "value": v}}), flush=True)
@@ -138,6 +156,8 @@ def _run_phase(
         chunk=CHUNK,
         addsum_shape=ADDSUM_SHAPE,
         addsum_chunk=ADDSUM_CHUNK,
+        matmul_n=MATMUL_N,
+        matmul_chunk=MATMUL_CHUNK,
         use_jax_executor=use_jax_executor,
         warmup=warmup,
         workload=workload,
@@ -186,6 +206,7 @@ def get_baselines() -> dict:
     for workload, shape, chunk in [
         ("vorticity", SHAPE, CHUNK),
         ("addsum", ADDSUM_SHAPE, ADDSUM_CHUNK),
+        ("matmul", (MATMUL_N, MATMUL_N), MATMUL_CHUNK),
     ]:
         entry = rec.get(workload)
         if (
@@ -263,11 +284,11 @@ def measure_config(workload: str, device_ok: bool, timeout: float) -> tuple:
         return None, "_unavailable"
 
 
-def emit(metric: str, res, baseline, work_bytes: int) -> None:
+def emit(metric: str, res, baseline, work: int, unit: str = "GB/s/chip") -> None:
     if res is None:
         print(
             json.dumps(
-                {"metric": metric, "value": 0.0, "unit": "GB/s/chip", "vs_baseline": None}
+                {"metric": metric, "value": 0.0, "unit": unit, "vs_baseline": None}
             ),
             flush=True,
         )
@@ -278,8 +299,8 @@ def emit(metric: str, res, baseline, work_bytes: int) -> None:
         json.dumps(
             {
                 "metric": metric,
-                "value": round(work_bytes / elapsed / 1e9, 3),
-                "unit": "GB/s/chip",
+                "value": round(work / elapsed / 1e9, 3),
+                "unit": unit,
                 "vs_baseline": vs,
             }
         ),
@@ -294,8 +315,9 @@ def main() -> None:
         print("device smoke test failed: tunnel dead/wedged; CPU fallback",
               file=sys.stderr)
 
-    # addsum first; vorticity LAST (the driver parses the last line)
+    # addsum + matmul first; vorticity LAST (the driver parses the last line)
     res_a, sfx_a = measure_config("addsum", device_ok, 150)
+    res_m, sfx_m = measure_config("matmul", device_ok, 120)
     res_v, sfx_v = measure_config("vorticity", device_ok, 300)
 
     emit(
@@ -303,6 +325,13 @@ def main() -> None:
         res_a,
         baselines.get("addsum"),
         ADDSUM_WORK_BYTES,
+    )
+    emit(
+        "matmul_4000x4000_blockwise_contraction" + sfx_m,
+        res_m,
+        baselines.get("matmul"),
+        MATMUL_FLOPS,
+        unit="GFLOP/s/chip",
     )
     emit(
         "pangeo_vorticity_500x450x400_f64_throughput" + sfx_v,

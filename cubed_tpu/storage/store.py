@@ -151,9 +151,12 @@ def _codec_from_meta(comp: Optional[dict]):
         preset = comp.get("preset")
         fmt = comp.get("format", lzma.FORMAT_XZ)
         filters = comp.get("filters")
+        # decompression must mirror format/filters: FORMAT_RAW streams are
+        # undecodable without the filter chain (numcodecs.LZMA semantics)
+        dec_fmt = lzma.FORMAT_AUTO if fmt == lzma.FORMAT_XZ else fmt
         return (
             lambda b: lzma.compress(b, format=fmt, preset=preset, filters=filters),
-            lzma.decompress,
+            lambda b: lzma.decompress(b, format=dec_fmt, filters=filters),
         )
     raise ValueError(
         f"Unsupported Zarr compressor {cid!r}: this store supports the "

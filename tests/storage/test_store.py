@@ -304,3 +304,23 @@ def test_to_zarr_compressed_end_to_end(tmp_path):
     # and from_zarr reads it back through the framework
     b = ct.from_zarr(target)
     np.testing.assert_array_equal(b.compute(), an + 1.0)
+
+
+def test_lzma_raw_format_roundtrip(tmp_path):
+    """FORMAT_RAW lzma requires the filter chain on decompression too."""
+    import lzma
+
+    comp = {
+        "id": "lzma",
+        "format": lzma.FORMAT_RAW,
+        "filters": [{"id": lzma.FILTER_LZMA2, "preset": 1}],
+    }
+    store = str(tmp_path / "raw.zarr")
+    z = open_zarr_array(
+        store, "w", shape=(4, 4), dtype=np.float64, chunks=(2, 2),
+        compressor=comp,
+    )
+    an = np.arange(16.0).reshape(4, 4)
+    z[...] = an
+    np.testing.assert_array_equal(z[...], an)
+    np.testing.assert_array_equal(open_zarr_array(store, "r")[...], an)
