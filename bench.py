@@ -1,13 +1,15 @@
-"""Benchmark: the BASELINE.json north-star workloads.
+"""Benchmark: ALL FIVE BASELINE.json configs, measured every run.
 
-Three configs, all measured every run (VERDICT r2 item 3):
-
-1. ``addsum`` — BASELINE.json config #1: ``xp.add(a, b).sum()`` on
-   5000x5000 f64 at (1000, 1000) chunks.
-2. ``matmul`` — BASELINE.json config #4: ``sum(a @ b)`` on 4000x4000 at
-   (1000, 1000) chunks — the blockwise contraction + tree-reduce path,
-   reported in GFLOP/s (the MXU configuration).
-3. ``vorticity`` — the pangeo-vorticity pipeline (reference
+1. ``addsum`` — config #1: ``xp.add(a, b).sum()`` on 5000x5000 f64 at
+   (1000, 1000) chunks.
+2. ``matmul`` — config #4: ``sum(a @ b)`` on 4000x4000 at (1000, 1000)
+   chunks — the blockwise contraction + tree-reduce path, reported in
+   GFLOP/s (the MXU configuration).
+3. ``elemwise`` — config #2: a fused unary+binary elementwise chain
+   ``sum(sqrt(|sin(a)*b + cos(b)|))`` on 6000x6000.
+4. ``reduce`` — config #3: 2-level axis reduction ``max(mean(a, axis=0))``
+   on 8000x8000 via the reduction tree.
+5. ``vorticity`` — config #5: the pangeo-vorticity pipeline (reference
    examples/pangeo-vorticity.ipynb): four random arrays,
    ``mean(a[1:]*x + b[1:]*y)`` at (500, 450, 400) f64, chunks=100 (the
    notebook's (1000,900,800) exceeds one chip's HBM; the driver's mesh
@@ -63,6 +65,19 @@ MATMUL_N = 4000
 MATMUL_CHUNK = 1000
 MATMUL_FLOPS = 2 * MATMUL_N**3
 
+#: BASELINE.json config #2: unary+binary elementwise chain (the Array-API
+#: elementwise suite shape): sum(sqrt(|sin(a)*b + cos(b)|)) — 2 generated
+#: arrays, 5 elementwise ops fused into one pass, then a tree-reduce.
+ELEMWISE_SHAPE = (6000, 6000)
+ELEMWISE_CHUNK = 1000
+ELEMWISE_WORK_BYTES = 2 * ELEMWISE_SHAPE[0] * ELEMWISE_SHAPE[1] * 8
+
+#: BASELINE.json config #3: axis reductions via core.ops.reduction
+#: tree-reduce: max(mean(a, axis=0)) — a 2-level reduction over both axes.
+REDUCE_SHAPE = (8000, 8000)
+REDUCE_CHUNK = 1000
+REDUCE_WORK_BYTES = REDUCE_SHAPE[0] * REDUCE_SHAPE[1] * 8
+
 _T0 = time.monotonic()
 
 
@@ -96,6 +111,17 @@ def build():
         a = cubed_tpu.random.random((n, n), chunks=chunk, spec=spec)
         b = cubed_tpu.random.random((n, n), chunks=chunk, spec=spec)
         return xp.sum(xp.matmul(a, b))
+    if workload == "elemwise":
+        shape, chunk = {elemwise_shape!r}, {elemwise_chunk!r}
+        a = cubed_tpu.random.random(shape, chunks=chunk, spec=spec)
+        b = cubed_tpu.random.random(shape, chunks=chunk, spec=spec)
+        return xp.sum(
+            xp.sqrt(xp.abs(xp.add(xp.multiply(xp.sin(a), b), xp.cos(b))))
+        )
+    if workload == "reduce":
+        shape, chunk = {reduce_shape!r}, {reduce_chunk!r}
+        a = cubed_tpu.random.random(shape, chunks=chunk, spec=spec)
+        return xp.max(xp.mean(a, axis=0))
     shape, chunk = {shape!r}, {chunk!r}
     a = cubed_tpu.random.random(shape, chunks=chunk, spec=spec)
     b = cubed_tpu.random.random(shape, chunks=chunk, spec=spec)
@@ -122,6 +148,11 @@ if workload == "addsum":
 elif workload == "matmul":
     n = {matmul_n!r}
     assert 0.9 < v / (0.25 * n**3) < 1.1, v  # E[sum(A@B)] = n^3/4 for uniforms
+elif workload == "elemwise":
+    n = {elemwise_shape!r}[0] * {elemwise_shape!r}[1]
+    assert 0.5 < v / n < 1.1, v  # E[sqrt(|sin(u)v + cos(v)|)] is O(1)
+elif workload == "reduce":
+    assert 0.45 < v < 0.55, v  # max over 8000 column means of uniforms ~ 0.5
 else:
     assert 0.45 < v < 0.55, v  # mean of u1*u2 + u3*u4 over uniforms is ~0.5
 print(json.dumps({{"elapsed": t1 - t0, "value": v}}), flush=True)
@@ -158,6 +189,10 @@ def _run_phase(
         addsum_chunk=ADDSUM_CHUNK,
         matmul_n=MATMUL_N,
         matmul_chunk=MATMUL_CHUNK,
+        elemwise_shape=ELEMWISE_SHAPE,
+        elemwise_chunk=ELEMWISE_CHUNK,
+        reduce_shape=REDUCE_SHAPE,
+        reduce_chunk=REDUCE_CHUNK,
         use_jax_executor=use_jax_executor,
         warmup=warmup,
         workload=workload,
@@ -207,6 +242,8 @@ def get_baselines() -> dict:
         ("vorticity", SHAPE, CHUNK),
         ("addsum", ADDSUM_SHAPE, ADDSUM_CHUNK),
         ("matmul", (MATMUL_N, MATMUL_N), MATMUL_CHUNK),
+        ("elemwise", ELEMWISE_SHAPE, ELEMWISE_CHUNK),
+        ("reduce", REDUCE_SHAPE, REDUCE_CHUNK),
     ]:
         entry = rec.get(workload)
         if (
@@ -315,9 +352,11 @@ def main() -> None:
         print("device smoke test failed: tunnel dead/wedged; CPU fallback",
               file=sys.stderr)
 
-    # addsum + matmul first; vorticity LAST (the driver parses the last line)
-    res_a, sfx_a = measure_config("addsum", device_ok, 150)
-    res_m, sfx_m = measure_config("matmul", device_ok, 120)
+    # all 5 BASELINE.json configs; vorticity LAST (driver parses the last line)
+    res_a, sfx_a = measure_config("addsum", device_ok, 120)
+    res_m, sfx_m = measure_config("matmul", device_ok, 100)
+    res_e, sfx_e = measure_config("elemwise", device_ok, 100)
+    res_r, sfx_r = measure_config("reduce", device_ok, 100)
     res_v, sfx_v = measure_config("vorticity", device_ok, 300)
 
     emit(
@@ -332,6 +371,18 @@ def main() -> None:
         baselines.get("matmul"),
         MATMUL_FLOPS,
         unit="GFLOP/s/chip",
+    )
+    emit(
+        "elementwise_chain_6000x6000_f64" + sfx_e,
+        res_e,
+        baselines.get("elemwise"),
+        ELEMWISE_WORK_BYTES,
+    )
+    emit(
+        "axis_reductions_8000x8000_f64" + sfx_r,
+        res_r,
+        baselines.get("reduce"),
+        REDUCE_WORK_BYTES,
     )
     emit(
         "pangeo_vorticity_500x450x400_f64_throughput" + sfx_v,

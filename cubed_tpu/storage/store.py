@@ -151,12 +151,18 @@ def _codec_from_meta(comp: Optional[dict]):
         preset = comp.get("preset")
         fmt = comp.get("format", lzma.FORMAT_XZ)
         filters = comp.get("filters")
-        # decompression must mirror format/filters: FORMAT_RAW streams are
-        # undecodable without the filter chain (numcodecs.LZMA semantics)
-        dec_fmt = lzma.FORMAT_AUTO if fmt == lzma.FORMAT_XZ else fmt
+        # FORMAT_RAW streams are undecodable without the filter chain, but
+        # container formats (XZ/ALONE) embed it and lzma.decompress REJECTS
+        # an explicit filters argument for them
+        if fmt == lzma.FORMAT_RAW:
+            decompress = lambda b: lzma.decompress(  # noqa: E731
+                b, format=lzma.FORMAT_RAW, filters=filters
+            )
+        else:
+            decompress = lzma.decompress
         return (
             lambda b: lzma.compress(b, format=fmt, preset=preset, filters=filters),
-            lambda b: lzma.decompress(b, format=dec_fmt, filters=filters),
+            decompress,
         )
     raise ValueError(
         f"Unsupported Zarr compressor {cid!r}: this store supports the "

@@ -324,3 +324,23 @@ def test_lzma_raw_format_roundtrip(tmp_path):
     z[...] = an
     np.testing.assert_array_equal(z[...], an)
     np.testing.assert_array_equal(open_zarr_array(store, "r")[...], an)
+
+
+def test_lzma_xz_with_filters_roundtrip(tmp_path):
+    """Container formats embed the filter chain; decompress must NOT be
+    handed filters (CPython rejects them except with FORMAT_RAW)."""
+    import lzma
+
+    comp = {
+        "id": "lzma",
+        "format": lzma.FORMAT_XZ,
+        "filters": [{"id": lzma.FILTER_LZMA2, "preset": 1}],
+    }
+    store = str(tmp_path / "xzf.zarr")
+    z = open_zarr_array(
+        store, "w", shape=(4, 4), dtype=np.float64, chunks=(2, 2),
+        compressor=comp,
+    )
+    an = np.arange(16.0).reshape(4, 4)
+    z[...] = an
+    np.testing.assert_array_equal(open_zarr_array(store, "r")[...], an)
