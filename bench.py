@@ -67,7 +67,7 @@ MATMUL_FLOPS = 2 * MATMUL_N**3
 
 #: BASELINE.json config #2: unary+binary elementwise chain (the Array-API
 #: elementwise suite shape): sum(sqrt(|sin(a)*b + cos(b)|)) — 2 generated
-#: arrays, 5 elementwise ops fused into one pass, then a tree-reduce.
+#: arrays, 6 elementwise ops fused into one pass, then a tree-reduce.
 ELEMWISE_SHAPE = (6000, 6000)
 ELEMWISE_CHUNK = 1000
 ELEMWISE_WORK_BYTES = 2 * ELEMWISE_SHAPE[0] * ELEMWISE_SHAPE[1] * 8

@@ -11,6 +11,7 @@ across arbitrarily composed plans.
 from __future__ import annotations
 
 import numpy as np
+import pytest
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
@@ -24,7 +25,10 @@ from .harness import arrays
 
 def _unary_step(draw, a):
     op = draw(st.sampled_from(["negative", "abs", "multiply2", "add1", "transpose",
-                               "flip", "slice", "rechunk", "reshape_flat"]))
+                               "flip", "slice", "rechunk", "reshape_flat",
+                               "cumsum"]))
+    if op == "cumsum":
+        return xp.cumulative_sum(a, axis=draw(st.integers(0, a.ndim - 1)))
     if op == "negative":
         return xp.negative(a)
     if op == "abs":
@@ -168,3 +172,38 @@ def test_random_plans_match_oracle_sharded(data, spec):
     oracle = np.asarray(expr.compute(executor=PythonDagExecutor()))
     sharded = np.asarray(expr.compute(executor=JaxExecutor(mesh=mesh)))
     np.testing.assert_allclose(sharded, oracle, rtol=1e-12, atol=1e-12)
+
+
+# -- distributed executor: the fabric must also be invisible ---------------
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+
+    ex = DistributedDagExecutor(n_local_workers=2, worker_threads=2)
+    try:
+        ex._ensure_fleet()
+        yield ex
+    finally:
+        ex.close()
+
+
+@settings(max_examples=6, deadline=None)
+@given(data=st.data())
+def test_random_plans_match_oracle_distributed(data, spec, fleet):
+    """Same fuzz over the TCP coordinator/worker fabric: serialization,
+    blob caching, and completion-ordered remote execution must not change a
+    single bit of any plan's result."""
+    an = data.draw(arrays(dtypes=(np.float64,), shape=(6, 8)))
+    bn = data.draw(arrays(dtypes=(np.float64,), shape=(6, 8)))
+    chunks = (3, 4)
+
+    a = ct.from_array(an, chunks=chunks, spec=spec)
+    b = ct.from_array(bn, chunks=chunks, spec=spec)
+    x = _binary_step(data.draw, _unary_step(data.draw, a), b)
+    expr = _reduce_step(data.draw, _unary_step(data.draw, x))
+
+    oracle = np.asarray(expr.compute(executor=PythonDagExecutor()))
+    remote = np.asarray(expr.compute(executor=fleet))
+    np.testing.assert_allclose(remote, oracle, rtol=1e-12, atol=1e-12)
