@@ -48,6 +48,7 @@ import os
 import socket
 import struct
 import threading
+import time
 import traceback
 from concurrent.futures import Future
 from typing import Any, Dict, Optional
@@ -61,6 +62,10 @@ MAX_FRAME = 1 << 31
 
 class WorkerLostError(RuntimeError):
     """The worker executing a task disconnected before reporting a result."""
+
+
+class TaskTimeoutError(RuntimeError):
+    """A task exceeded the coordinator's ``task_timeout`` without a result."""
 
 
 class RemoteTaskError(RuntimeError):
@@ -113,6 +118,19 @@ class _WorkerConn:
         self.nthreads = int(hello.get("nthreads", 1))
         self.send_lock = threading.Lock()
         self.outstanding: Dict[int, Future] = {}
+        #: task_id -> (monotonic deadline, started) — only under task_timeout.
+        #: ``started`` flips when the worker acks actual execution start (post
+        #: blob decode); a timeout before that is cold-start/queueing load,
+        #: rerouted without counting as a hang
+        self.deadlines: Dict[int, list] = {}
+        #: consecutive timed-out STARTED tasks; reset on any result
+        self.timeout_strikes = 0
+        #: task_ids of threads still burned by timed-out-but-running tasks;
+        #: counted in routing load so retries don't queue behind the very
+        #: hang that timed them out; a ghost is removed when ITS late reply
+        #: arrives (replies for never-started timeouts must not free a
+        #: different ghost's slot)
+        self.ghost_ids: set[int] = set()
         self.blobs_sent: set[str] = set()
         self.alive = True
 
@@ -126,7 +144,13 @@ class Coordinator:
     ``(result, stats_dict)``.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        task_timeout: Optional[float] = None,
+        timeout_strikes: int = 2,
+    ):
         self._server = socket.create_server((host, port))
         self._server.settimeout(0.2)
         self.address = self._server.getsockname()[:2]
@@ -135,13 +159,22 @@ class Coordinator:
         self._next_task_id = 0
         self._closed = threading.Event()
         self._worker_joined = threading.Condition(self._lock)
-        self._blob_cache: Dict[tuple, tuple[str, bytes]] = {}
+        self._blob_cache: Dict[tuple, tuple] = {}
+        self.task_timeout = task_timeout
+        self.timeout_strikes = timeout_strikes
         #: diagnostics: blob bytes actually sent vs referenced by id
-        self.stats: Dict[str, int] = {"blobs_sent": 0, "tasks_sent": 0}
+        self.stats: Dict[str, int] = {
+            "blobs_sent": 0, "tasks_sent": 0, "task_timeouts": 0,
+        }
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="coordinator-accept", daemon=True
         )
         self._accept_thread.start()
+        if task_timeout is not None:
+            threading.Thread(
+                target=self._timeout_loop, name="coordinator-timeouts",
+                daemon=True,
+            ).start()
 
     # -- worker management ---------------------------------------------
 
@@ -197,6 +230,7 @@ class Coordinator:
                 self._workers.remove(conn)
             orphans = list(conn.outstanding.items())
             conn.outstanding.clear()
+            conn.deadlines.clear()
         try:
             conn.sock.close()
         except OSError:
@@ -220,12 +254,26 @@ class Coordinator:
                 if mtype in ("result", "error"):
                     with self._lock:
                         fut = conn.outstanding.pop(msg["task_id"], None)
+                        conn.deadlines.pop(msg["task_id"], None)
+                        conn.timeout_strikes = 0  # it is producing results
+                        # a ghost (started-then-timed-out task) finished:
+                        # its thread is usable again
+                        conn.ghost_ids.discard(msg["task_id"])
                     if fut is None or fut.done():
                         continue  # duplicate/late reply, or a cancelled twin
                     if mtype == "result":
                         fut.set_result((msg.get("result"), msg.get("stats", {})))
                     else:
                         fut.set_exception(RemoteTaskError(msg.get("error", "")))
+                elif mtype == "started":
+                    # execution begins now: restart the timeout clock and
+                    # make a subsequent timeout count as a real hang
+                    if self.task_timeout is not None:
+                        with self._lock:
+                            entry = conn.deadlines.get(msg["task_id"])
+                            if entry is not None:
+                                entry[0] = time.monotonic() + self.task_timeout
+                                entry[1] = True
                 else:
                     logger.warning("unknown message from %s: %r", conn.name, mtype)
         except (ConnectionError, OSError) as e:
@@ -234,6 +282,52 @@ class Coordinator:
         except Exception:
             logger.exception("receiver for %s crashed", conn.name)
             self._drop_worker(conn, "receiver crash")
+
+    def _timeout_loop(self) -> None:
+        """Fail tasks that exceed ``task_timeout`` so the caller's retry
+        machinery reroutes them; a worker that keeps timing out without
+        producing any result is treated as hung and dropped (its remaining
+        tasks fail with WorkerLostError and reroute too). The reference's
+        fleet executors get this from their platforms' per-call timeouts."""
+        interval = max(0.05, min(1.0, (self.task_timeout or 1.0) / 4))
+        while not self._closed.wait(interval):
+            now = time.monotonic()
+            hung: list[_WorkerConn] = []
+            timed_out: list[tuple[Future, str, int]] = []
+            with self._lock:
+                for conn in self._workers:
+                    overdue = [
+                        (tid, entry[1])
+                        for tid, entry in conn.deadlines.items()
+                        if entry[0] < now
+                    ]
+                    for tid, started in overdue:
+                        fut = conn.outstanding.pop(tid, None)
+                        conn.deadlines.pop(tid, None)
+                        if started:
+                            conn.ghost_ids.add(tid)
+                        if fut is not None and not fut.done():
+                            timed_out.append((fut, conn.name, tid))
+                    if overdue:
+                        self.stats["task_timeouts"] += len(overdue)
+                        # only tasks the worker acked as started count as
+                        # hangs; queued/cold-start timeouts just reroute
+                        conn.timeout_strikes += sum(
+                            1 for _, started in overdue if started
+                        )
+                        if conn.timeout_strikes >= self.timeout_strikes:
+                            hung.append(conn)
+            for fut, wname, tid in timed_out:
+                fut.set_exception(
+                    TaskTimeoutError(
+                        f"task {tid} exceeded {self.task_timeout}s on "
+                        f"worker {wname}"
+                    )
+                )
+            for conn in hung:
+                self._drop_worker(
+                    conn, f"hung: {conn.timeout_strikes} consecutive timeouts"
+                )
 
     # -- task submission -----------------------------------------------
 
@@ -269,23 +363,38 @@ class Coordinator:
                 live = [w for w in self._workers if w.alive]
                 if not live:
                     raise NoWorkersError("no live workers connected")
-                conn = min(live, key=lambda w: len(w.outstanding) / max(w.nthreads, 1))
+                conn = min(
+                    live,
+                    key=lambda w: (len(w.outstanding) + len(w.ghost_ids))
+                    / max(w.nthreads, 1),
+                )
                 task_id = self._next_task_id
                 self._next_task_id += 1
                 conn.outstanding[task_id] = fut
                 first_use = blob_id not in conn.blobs_sent
+                if self.task_timeout is not None:
+                    # registered BEFORE the send, under the same lock as
+                    # outstanding: a fast worker's 'started' ack must find
+                    # the entry (racing it would permanently mark the task
+                    # cold-start and exempt a real hang from eviction)
+                    conn.deadlines[task_id] = [
+                        time.monotonic() + self.task_timeout, False
+                    ]
             msg = {
                 "type": "task",
                 "task_id": task_id,
                 "blob_id": blob_id,
                 "blob": blob if first_use else None,
                 "input": task_input,
+                # ack execution start only when someone is watching the clock
+                "ack": self.task_timeout is not None,
             }
             try:
                 send_frame(conn.sock, msg, conn.send_lock)
             except (ConnectionError, OSError) as e:
                 with self._lock:
                     conn.outstanding.pop(task_id, None)
+                    conn.deadlines.pop(task_id, None)
                 self._drop_worker(conn, f"send failed: {e}")
                 continue  # pick another worker for the same future
             except Exception:
@@ -293,6 +402,7 @@ class Coordinator:
                 # message, so only this submission's bookkeeping rolls back
                 with self._lock:
                     conn.outstanding.pop(task_id, None)
+                    conn.deadlines.pop(task_id, None)
                 raise
             with self._lock:
                 # only mark the blob delivered once the send has succeeded
@@ -381,6 +491,18 @@ def run_worker(
                     # duplicate tasks hit decoded_blobs first)
                     raw_blobs.pop(blob_id, None)
             function, config = pair
+            if msg.get("ack"):
+                try:
+                    # ack actual execution start (post decode): the
+                    # coordinator restarts this task's timeout clock,
+                    # separating cold-start/queueing delay from a real hang
+                    send_frame(
+                        sock, {"type": "started", "task_id": task_id},
+                        send_lock,
+                    )
+                except (ConnectionError, OSError):
+                    stop.set()
+                    return
             if config is not None:
                 result, stats = execute_with_stats(
                     function, msg["input"], config=config

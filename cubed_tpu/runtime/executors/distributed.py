@@ -68,6 +68,7 @@ class DistributedDagExecutor(DagExecutor):
         min_workers: Optional[int] = None,
         worker_threads: int = 1,
         worker_start_timeout: float = 60.0,
+        task_timeout: Optional[float] = None,
         retries: int = DEFAULT_RETRIES,
         use_backups: bool = True,
         batch_size: Optional[int] = None,
@@ -83,6 +84,7 @@ class DistributedDagExecutor(DagExecutor):
         )
         self.worker_threads = worker_threads
         self.worker_start_timeout = worker_start_timeout
+        self.task_timeout = task_timeout
         self.retries = retries
         self.use_backups = use_backups
         self.batch_size = batch_size
@@ -109,13 +111,14 @@ class DistributedDagExecutor(DagExecutor):
             return self._coordinator
         if self.listen is not None:
             host, _, port = self.listen.rpartition(":")
-            coord = Coordinator(host or "0.0.0.0", int(port or 0))
+            coord = Coordinator(host or "0.0.0.0", int(port or 0),
+                                task_timeout=self.task_timeout)
             logger.info(
                 "coordinator listening on %s:%s; waiting for %d workers",
                 coord.address[0], coord.address[1], self.min_workers,
             )
         else:
-            coord = Coordinator("127.0.0.1", 0)
+            coord = Coordinator("127.0.0.1", 0, task_timeout=self.task_timeout)
         self._coordinator = coord
         if self.n_local_workers:
             host, port = coord.address

@@ -253,3 +253,127 @@ def test_distributed_out_of_band_worker(spec):
         ex.close()
         if proc is not None:
             proc.wait(timeout=10)
+
+
+class _FleetFaultTask:
+    """Picklable fault-injection task for the fabric (shared harness)."""
+
+    def __init__(self, path, timing_map):
+        self.path = path
+        self.timing_map = timing_map
+
+    def __call__(self, i):
+        from .utils import deterministic_failure
+
+        return deterministic_failure(self.path, self.timing_map, i)
+
+
+def test_distributed_task_timeout_reroutes(tmp_path):
+    """A task stuck on a hung worker times out at the coordinator and the
+    retry succeeds (fresh invocation returns immediately); the worker that
+    kept timing out is evicted as hung."""
+    from cubed_tpu.runtime.distributed import TaskTimeoutError  # noqa: F401
+    from cubed_tpu.runtime.executors.python_async import map_unordered
+
+    path = tmp_path / "counts"
+    path.mkdir()
+    # input 0: first invocation sleeps 60s (far past the timeout), second
+    # invocation (the rerouted retry) succeeds immediately. The timeout must
+    # sit above the worker's first-task cold cost (decoding the blob imports
+    # this test module and with it jax) — the started-ack protects against
+    # hang-eviction during cold start, but attempts still burn.
+    timing_map = {0: [60000]}
+    ex = DistributedDagExecutor(
+        n_local_workers=2, task_timeout=8.0, retries=2, use_backups=False,
+    )
+    try:
+        coord = ex._ensure_fleet()
+        map_unordered(
+            _CoordPool(coord),
+            _FleetFaultTask(str(path), timing_map),
+            list(range(3)),
+            retries=2,
+            use_backups=False,
+        )
+        from .utils import read_int_from_file
+
+        assert read_int_from_file(str(path / "0")) == 2  # timed out once
+        assert coord.stats["task_timeouts"] >= 1
+    finally:
+        ex.close()
+
+
+class _CoordPool:
+    def __init__(self, coordinator):
+        self.coordinator = coordinator
+
+    def submit(self, stats_wrapper, function, task_input, **kwargs):
+        return self.coordinator.submit(stats_wrapper, function, task_input, **kwargs)
+
+
+def test_distributed_hung_threads_avoided(tmp_path):
+    """Started-task timeouts leave ghost threads; routing counts them so
+    retries land on workers with free capacity and the map completes."""
+    from cubed_tpu.runtime.executors.python_async import map_unordered
+
+    path = tmp_path / "counts"
+    path.mkdir()
+    # two poisoned inputs: each sleeps forever on first invocation
+    timing_map = {0: [60000], 1: [60000]}
+    ex = DistributedDagExecutor(
+        n_local_workers=2, worker_threads=2, task_timeout=1.0, retries=3,
+        use_backups=False,
+    )
+    try:
+        coord = ex._ensure_fleet()
+        map_unordered(
+            _CoordPool(coord),
+            _FleetFaultTask(str(path), timing_map),
+            list(range(6)),
+            retries=3,
+            use_backups=False,
+        )
+        # all 6 inputs completed despite two hung tasks
+        from .utils import read_int_from_file
+
+        total = sum(read_int_from_file(str(path / str(i))) for i in range(6))
+        assert total >= 8  # 6 firsts + 2 retries
+        assert coord.stats["task_timeouts"] >= 2
+    finally:
+        ex.close()
+
+
+def test_distributed_hung_worker_evicted(tmp_path):
+    """A worker whose started tasks keep timing out is dropped as hung; with
+    no survivors the plan fails loudly instead of spinning."""
+    from cubed_tpu.runtime.distributed import (
+        NoWorkersError,
+        TaskTimeoutError,
+        WorkerLostError,
+    )
+    from cubed_tpu.runtime.executors.python_async import map_unordered
+
+    path = tmp_path / "counts"
+    path.mkdir()
+    timing_map = {0: [120000, 120000, 120000, 120000],
+                  1: [120000, 120000, 120000, 120000]}
+    ex = DistributedDagExecutor(
+        n_local_workers=1, worker_threads=2, task_timeout=8.0, retries=3,
+        use_backups=False,
+    )
+    try:
+        coord = ex._ensure_fleet()
+        with pytest.raises((TaskTimeoutError, WorkerLostError, NoWorkersError)):
+            map_unordered(
+                _CoordPool(coord),
+                _FleetFaultTask(str(path), timing_map),
+                list(range(2)),
+                retries=3,
+                use_backups=False,
+            )
+        deadline = time.time() + 10
+        while coord.n_workers > 0 and time.time() < deadline:
+            time.sleep(0.1)
+        assert coord.n_workers == 0  # evicted as hung
+    finally:
+        ex.close()
