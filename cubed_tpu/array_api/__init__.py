@@ -142,7 +142,7 @@ from .manipulation_functions import (  # noqa: F401
 )
 
 from .searching_functions import argmax, argmin, where  # noqa: F401
-from .sorting_functions import argsort, sort  # noqa: F401
+from .sorting_functions import argsort, searchsorted, sort  # noqa: F401
 
 from .statistical_functions import (  # noqa: F401
     cumulative_prod,

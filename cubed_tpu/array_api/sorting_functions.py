@@ -76,3 +76,47 @@ def argsort(x, /, *, axis=-1, descending=False, stable=True):
         return idx.astype(np.int64)
 
     return map_blocks(_argsort_chunk, x, dtype=np.dtype(np.int64))
+
+
+def searchsorted(x1, x2, /, *, side="left", sorter=None):
+    """Insertion indices of ``x2`` into sorted 1-d ``x1`` (2023.12 standard;
+    the reference has no searchsorted).
+
+    ``x1`` rechunks to one chunk (each task needs the whole sorted axis —
+    same bounded-memory contract as :func:`sort`); the search itself is
+    blockwise over ``x2``'s grid, each task binary-searching its own block.
+    """
+    if side not in ("left", "right"):
+        raise ValueError(f"side must be 'left' or 'right', got {side!r}")
+    if x1.ndim != 1:
+        raise ValueError("searchsorted requires x1 to be one-dimensional")
+    if x1.dtype not in _real_numeric_dtypes or x2.dtype not in _real_numeric_dtypes:
+        raise TypeError("Only real numeric dtypes are allowed in searchsorted")
+    if sorter is not None:
+        if np.dtype(sorter.dtype).kind not in "iu":
+            raise TypeError("sorter must be of integer type")
+        from .indexing_functions import take
+
+        x1 = take(x1, sorter)
+
+    from ..core.ops import general_blockwise
+
+    x1 = _single_chunk_along(x1, 0)
+    n1, n2 = x1.name, x2.name
+
+    def _block_function(out_key):
+        return ((n1, 0), (n2, *out_key[1:]))
+
+    def _search_block(a1, a2):
+        return nxp.searchsorted(a1, a2, side=side).astype(np.int64)
+
+    return general_blockwise(
+        _search_block,
+        _block_function,
+        x1,
+        x2,
+        shape=x2.shape,
+        dtype=np.dtype(np.int64),
+        chunks=x2.chunks if x2.ndim else (),
+        op_name="searchsorted",
+    )

@@ -98,6 +98,22 @@ def recv_frame(sock: socket.socket) -> Any:
     return cloudpickle.loads(_recv_exact(sock, n))
 
 
+def _fail_future(fut: Future, exc: BaseException) -> None:
+    """set_exception tolerating a caller-cancelled future.
+
+    ``map_unordered`` cancels losing backup twins and pending futures on
+    retry exhaustion from its own thread; racing that with set_exception
+    raises InvalidStateError, which must not kill a coordinator daemon
+    thread (the fleet outlives computes, so a dead timeout/receiver thread
+    would silently disable enforcement for every later plan)."""
+    if fut.done():
+        return
+    try:
+        fut.set_exception(exc)
+    except Exception:
+        pass  # cancelled (or completed) concurrently: the race is benign
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
@@ -236,10 +252,9 @@ class Coordinator:
         except OSError:
             pass
         for task_id, fut in orphans:
-            if not fut.done():
-                fut.set_exception(
-                    WorkerLostError(f"worker {conn.name} lost: {reason}")
-                )
+            _fail_future(
+                fut, WorkerLostError(f"worker {conn.name} lost: {reason}")
+            )
         if orphans or reason != "shutdown":
             logger.warning(
                 "worker %s dropped (%s); failed %d in-flight tasks",
@@ -262,9 +277,14 @@ class Coordinator:
                     if fut is None or fut.done():
                         continue  # duplicate/late reply, or a cancelled twin
                     if mtype == "result":
-                        fut.set_result((msg.get("result"), msg.get("stats", {})))
+                        try:
+                            fut.set_result(
+                                (msg.get("result"), msg.get("stats", {}))
+                            )
+                        except Exception:
+                            pass  # cancelled concurrently (losing twin)
                     else:
-                        fut.set_exception(RemoteTaskError(msg.get("error", "")))
+                        _fail_future(fut, RemoteTaskError(msg.get("error", "")))
                 elif mtype == "started":
                     # execution begins now: restart the timeout clock and
                     # make a subsequent timeout count as a real hang
@@ -318,11 +338,12 @@ class Coordinator:
                         if conn.timeout_strikes >= self.timeout_strikes:
                             hung.append(conn)
             for fut, wname, tid in timed_out:
-                fut.set_exception(
+                _fail_future(
+                    fut,
                     TaskTimeoutError(
                         f"task {tid} exceeded {self.task_timeout}s on "
                         f"worker {wname}"
-                    )
+                    ),
                 )
             for conn in hung:
                 self._drop_worker(

@@ -69,6 +69,7 @@ class DistributedDagExecutor(DagExecutor):
         worker_threads: int = 1,
         worker_start_timeout: float = 60.0,
         task_timeout: Optional[float] = None,
+        timeout_strikes: int = 2,
         retries: int = DEFAULT_RETRIES,
         use_backups: bool = True,
         batch_size: Optional[int] = None,
@@ -85,6 +86,7 @@ class DistributedDagExecutor(DagExecutor):
         self.worker_threads = worker_threads
         self.worker_start_timeout = worker_start_timeout
         self.task_timeout = task_timeout
+        self.timeout_strikes = timeout_strikes
         self.retries = retries
         self.use_backups = use_backups
         self.batch_size = batch_size
@@ -112,13 +114,15 @@ class DistributedDagExecutor(DagExecutor):
         if self.listen is not None:
             host, _, port = self.listen.rpartition(":")
             coord = Coordinator(host or "0.0.0.0", int(port or 0),
-                                task_timeout=self.task_timeout)
+                                task_timeout=self.task_timeout,
+                                timeout_strikes=self.timeout_strikes)
             logger.info(
                 "coordinator listening on %s:%s; waiting for %d workers",
                 coord.address[0], coord.address[1], self.min_workers,
             )
         else:
-            coord = Coordinator("127.0.0.1", 0, task_timeout=self.task_timeout)
+            coord = Coordinator("127.0.0.1", 0, task_timeout=self.task_timeout,
+                                timeout_strikes=self.timeout_strikes)
         self._coordinator = coord
         if self.n_local_workers:
             host, port = coord.address

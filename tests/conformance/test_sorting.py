@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import numpy as np
 import pytest
-from hypothesis import given
+from hypothesis import given, settings
 from hypothesis import strategies as st
 
 import cubed_tpu.array_api as xp
@@ -144,3 +144,20 @@ def test_sort_axis_validation(spec):
     s0 = ct.from_array(np.float64(3.0).reshape(()), chunks=(), spec=spec)
     with pytest.raises(ValueError):
         xp.sort(s0)
+
+
+@settings(deadline=None)
+@given(data=st.data())
+def test_searchsorted_property(data, spec):
+    import cubed_tpu as ct
+
+    n1 = data.draw(st.integers(1, 30))
+    x1n = np.sort(data.draw(arrays(dtypes=(np.float64,), shape=(n1,))))
+    shape2 = data.draw(st.sampled_from([(7,), (3, 5), (2, 2, 3)]))
+    x2n = data.draw(arrays(dtypes=(np.float64,), shape=shape2))
+    side = data.draw(st.sampled_from(["left", "right"]))
+    c1 = data.draw(st.integers(1, n1))
+    x1 = ct.from_array(x1n, chunks=(c1,), spec=spec)
+    x2 = ct.from_array(x2n, chunks=tuple(max(1, s // 2) for s in shape2), spec=spec)
+    got = np.asarray(xp.searchsorted(x1, x2, side=side).compute())
+    np.testing.assert_array_equal(got, np.searchsorted(x1n, x2n, side=side))

@@ -446,3 +446,55 @@ def test_cumulative_sum_jax_executor(spec):
     a = ct.from_array(an, chunks=(2, 3), spec=spec)
     got = xp.cumulative_sum(a, axis=1).compute(executor=JaxExecutor())
     np.testing.assert_allclose(got, np.cumsum(an, axis=1))
+
+
+# -- searchsorted (2023.12; beyond-reference) ------------------------------
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+def test_searchsorted_matches_numpy(spec, side):
+    x1n = np.sort(np.random.default_rng(0).integers(0, 50, 23).astype(np.float64))
+    x2n = np.random.default_rng(1).integers(-5, 55, (4, 9)).astype(np.float64)
+    x1 = ct.from_array(x1n, chunks=(5,), spec=spec)
+    x2 = ct.from_array(x2n, chunks=(2, 4), spec=spec)
+    got = xp.searchsorted(x1, x2, side=side).compute()
+    np.testing.assert_array_equal(got, np.searchsorted(x1n, x2n, side=side))
+
+
+def test_searchsorted_with_sorter(spec):
+    rng = np.random.default_rng(2)
+    x1n = rng.permutation(np.arange(17.0))
+    sorter_n = np.argsort(x1n)
+    x2n = rng.uniform(-1, 18, 11)
+    x1 = ct.from_array(x1n, chunks=(6,), spec=spec)
+    x2 = ct.from_array(x2n, chunks=(4,), spec=spec)
+    sorter = ct.from_array(sorter_n, chunks=(17,), spec=spec)
+    got = xp.searchsorted(x1, x2, sorter=sorter).compute()
+    np.testing.assert_array_equal(got, np.searchsorted(x1n, x2n, sorter=sorter_n))
+
+
+def test_searchsorted_validation(spec):
+    a = ct.from_array(np.ones((3, 3)), chunks=(2, 2), spec=spec)
+    v = ct.from_array(np.arange(3.0), chunks=(3,), spec=spec)
+    with pytest.raises(ValueError):
+        xp.searchsorted(a, v)  # x1 must be 1-d
+    with pytest.raises(ValueError):
+        xp.searchsorted(v, v, side="middle")
+
+
+def test_searchsorted_jax_executor(spec):
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    x1n = np.arange(0.0, 40.0, 2.0)
+    x2n = np.linspace(-3, 45, 24).reshape(6, 4)
+    x1 = ct.from_array(x1n, chunks=(7,), spec=spec)
+    x2 = ct.from_array(x2n, chunks=(3, 2), spec=spec)
+    got = xp.searchsorted(x1, x2).compute(executor=JaxExecutor())
+    np.testing.assert_array_equal(got, np.searchsorted(x1n, x2n))
+
+
+def test_searchsorted_float_sorter_rejected(spec):
+    v = ct.from_array(np.arange(3.0), chunks=(3,), spec=spec)
+    s = ct.from_array(np.array([0.0, 1.0, 2.0]), chunks=(3,), spec=spec)
+    with pytest.raises(TypeError, match="integer"):
+        xp.searchsorted(v, v, sorter=s)
