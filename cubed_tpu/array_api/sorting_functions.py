@@ -95,6 +95,11 @@ def searchsorted(x1, x2, /, *, side="left", sorter=None):
     if sorter is not None:
         if np.dtype(sorter.dtype).kind not in "iu":
             raise TypeError("sorter must be of integer type")
+        if sorter.ndim != 1 or sorter.shape[0] != x1.shape[0]:
+            raise ValueError(
+                f"sorter.shape must equal x1.shape; got {sorter.shape} "
+                f"for x1 of shape {x1.shape}"
+            )
         from .indexing_functions import take
 
         x1 = take(x1, sorter)

@@ -498,3 +498,10 @@ def test_searchsorted_float_sorter_rejected(spec):
     s = ct.from_array(np.array([0.0, 1.0, 2.0]), chunks=(3,), spec=spec)
     with pytest.raises(TypeError, match="integer"):
         xp.searchsorted(v, v, sorter=s)
+
+
+def test_searchsorted_wrong_length_sorter_rejected(spec):
+    v = ct.from_array(np.arange(3.0), chunks=(3,), spec=spec)
+    s = ct.from_array(np.array([0, 1]), chunks=(2,), spec=spec)
+    with pytest.raises(ValueError, match="sorter.shape"):
+        xp.searchsorted(v, v, sorter=s)
