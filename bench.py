@@ -325,9 +325,8 @@ def measure_config(workload: str, device_ok: bool, timeout: float) -> tuple:
 #: time doesn't read as a perf regression (the TPU numbers were measured and
 #: committed when the tunnel was alive — benchmarks/BENCH_PROFILE.md)
 FALLBACK_NOTE = (
-    "device tunnel dead at measurement time; last committed TPU measurement "
-    "(2026-07-30, v5e): vorticity 20.667 GB/s/chip (235x), addsum 5.753 "
-    "GB/s/chip (16.5x) — see benchmarks/BENCH_PROFILE.md"
+    "device tunnel dead at measurement time; NOT a perf regression — see "
+    "benchmarks/BENCH_PROFILE.md for the committed TPU measurements"
 )
 
 

@@ -102,6 +102,14 @@ class DistributedDagExecutor(DagExecutor):
     # -- fleet lifecycle -----------------------------------------------
 
     @property
+    def stats(self) -> dict:
+        """Coordinator counters (blobs_sent, tasks_sent, task_timeouts);
+        empty before the fleet starts."""
+        if self._coordinator is None:
+            return {}
+        return dict(self._coordinator.stats)
+
+    @property
     def coordinator_address(self) -> Optional[str]:
         if self._coordinator is None:
             return None

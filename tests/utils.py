@@ -10,7 +10,15 @@ import platform
 from cubed_tpu.runtime.types import Callback
 
 
+_ALL_EXECUTORS = None
+
+
 def all_executors():
+    # cached: fixture definitions in several test modules call this at
+    # collection; caching keeps ONE distributed fleet for the whole session
+    global _ALL_EXECUTORS
+    if _ALL_EXECUTORS is not None:
+        return _ALL_EXECUTORS
     from cubed_tpu.runtime.executors.python import PythonDagExecutor
 
     executors = [PythonDagExecutor()]
@@ -27,6 +35,16 @@ def all_executors():
         executors.append(JaxExecutor())
     except ImportError:
         pass
+    try:
+        from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+
+        # one instance shared by every parametrized test: the worker fleet
+        # spawns lazily on first compute and is reused (workers exit on
+        # coordinator EOF at interpreter shutdown)
+        executors.append(DistributedDagExecutor(n_local_workers=2, worker_threads=2))
+    except ImportError:
+        pass
+    _ALL_EXECUTORS = executors
     return executors
 
 
