@@ -27,7 +27,7 @@ from .core.ops import (  # noqa: F401
     to_zarr,
 )
 from .core.gufunc import apply_gufunc  # noqa: F401
-from .nan_functions import nanmean, nansum  # noqa: F401
+from .nan_functions import nanmax, nanmean, nanmin, nansum  # noqa: F401
 
 from . import array_api  # noqa: F401
 from .array_api import Array  # noqa: F401  (reference: cubed/__init__.py)

@@ -6,12 +6,15 @@ pytree intermediate counting only non-NaN elements.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from .backend_array_api import nxp
 from .core.ops import reduction
 from .array_api.dtypes import (
     _numeric_dtypes,
+    _real_numeric_dtypes,
     _signed_integer_dtypes,
     _unsigned_integer_dtypes,
     complex64,
@@ -19,6 +22,13 @@ from .array_api.dtypes import (
     int64,
     uint64,
 )
+
+
+def _count_not_nan(a, axis=None, keepdims=True):
+    return nxp.sum(
+        nxp.astype(nxp.logical_not(nxp.isnan(a)), np.int64),
+        axis=axis, keepdims=keepdims,
+    )
 
 
 def nanmean(x, /, *, axis=None, keepdims=False, split_every=None):
@@ -39,10 +49,7 @@ def nanmean(x, /, *, axis=None, keepdims=False, split_every=None):
 
 
 def _nanmean_func(a, axis=None, keepdims=True, **kw):
-    n = nxp.sum(
-        nxp.astype(nxp.logical_not(nxp.isnan(a)), np.int64),
-        axis=axis, keepdims=keepdims,
-    )
+    n = _count_not_nan(a, axis=axis, keepdims=keepdims)
     total = _nansum_arr(a, axis=axis, keepdims=keepdims, dtype=np.float64)
     return {"n": n, "total": total}
 
@@ -96,3 +103,74 @@ def _nansum_arr(a, axis=None, keepdims=True, dtype=None, **kw):
 
 def _sum_arr(a, axis=None, keepdims=True, dtype=None, **kw):
     return nxp.sum(a, axis=axis, keepdims=keepdims, dtype=dtype)
+
+
+# -- nanmax / nanmin (beyond the reference's nanmean/nansum pair) ----------
+#
+# {m, n} pytree intermediates: m is the extremum over NaN-masked values, n
+# counts non-NaN contributors, and the aggregate restores numpy semantics
+# (all-NaN region -> NaN) without numpy's all-NaN-slice RuntimeWarning.
+
+
+def nanmax(x, /, *, axis=None, keepdims=False, split_every=None):
+    """Maximum ignoring NaNs (all-NaN regions yield NaN, warning-free)."""
+    return _nan_extremum(x, axis, keepdims, split_every, op="max")
+
+
+def nanmin(x, /, *, axis=None, keepdims=False, split_every=None):
+    """Minimum ignoring NaNs (all-NaN regions yield NaN, warning-free)."""
+    return _nan_extremum(x, axis, keepdims, split_every, op="min")
+
+
+def _nan_extremum(x, axis, keepdims, split_every, *, op):
+    if x.dtype not in _real_numeric_dtypes:
+        raise TypeError(f"Only real numeric dtypes are allowed in nan{op}")
+    reduced = (
+        tuple(range(x.ndim)) if axis is None
+        else (axis,) if isinstance(axis, int) else tuple(axis)
+    )
+    if any(x.shape[ax % x.ndim] == 0 for ax in reduced):
+        raise ValueError(f"zero-size array to reduction operation nan{op}")
+    if np.dtype(x.dtype).kind in "iub":
+        # integers hold no NaN: a plain exact extremum (routing through the
+        # float64 {m,n} machinery would corrupt int64 values above 2^53)
+        from .array_api.statistical_functions import max as _xmax, min as _xmin
+
+        f = _xmax if op == "max" else _xmin
+        return f(x, axis=axis, keepdims=keepdims, split_every=split_every)
+
+    intermediate_dtype = np.dtype([("m", np.float64), ("n", np.int64)])
+    return reduction(
+        x,
+        functools.partial(_nan_extremum_func, op=op),
+        combine_func=functools.partial(_nan_extremum_combine, op=op),
+        aggregate_func=_nan_extremum_aggregate,
+        axis=axis,
+        intermediate_dtype=intermediate_dtype,
+        dtype=x.dtype,
+        keepdims=keepdims,
+        split_every=split_every,
+    )
+
+
+def _nan_extremum_func(a, axis=None, keepdims=True, op="max", **kw):
+    fill = -np.inf if op == "max" else np.inf
+    masked = nxp.where(nxp.isnan(a), nxp.asarray(fill, dtype=a.dtype), a)
+    n = _count_not_nan(a, axis=axis, keepdims=keepdims)
+    reducer = nxp.max if op == "max" else nxp.min
+    m = reducer(
+        nxp.astype(masked, np.float64), axis=axis, keepdims=keepdims
+    )
+    return {"m": m, "n": n}
+
+
+def _nan_extremum_combine(a, axis=None, keepdims=True, op="max", **kw):
+    reducer = nxp.max if op == "max" else nxp.min
+    return {
+        "m": reducer(a["m"], axis=axis, keepdims=keepdims),
+        "n": nxp.sum(a["n"], axis=axis, keepdims=keepdims),
+    }
+
+
+def _nan_extremum_aggregate(a):
+    return nxp.where(a["n"] > 0, a["m"], np.nan)

@@ -1,6 +1,7 @@
 """NaN-aware reduction tests. Reference parity: cubed/tests/test_nan_functions.py."""
 
 import numpy as np
+import pytest
 
 import cubed_tpu as ct
 
@@ -35,3 +36,75 @@ def test_nansum_int_passthrough(spec):
     an = np.arange(6)
     a = ct.from_array(an, chunks=3, spec=spec)
     assert int(ct.nansum(a).compute()) == an.sum()
+
+
+def test_nanmax_nanmin(spec):
+    an = np.array([[1.0, np.nan, 3.0], [np.nan, np.nan, np.nan], [-2.0, 5.0, np.nan]])
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    import warnings
+
+    # the cubed side is advertised warning-free: compute OUTSIDE suppression
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        got_max1 = ct.nanmax(a, axis=1).compute()
+        got_min0 = ct.nanmin(a, axis=0).compute()
+        got_max = float(ct.nanmax(a).compute())
+        got_min = float(ct.nanmin(a).compute())
+    # only numpy's reference needs the all-NaN-slice warning suppressed
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        np.testing.assert_allclose(got_max1, np.nanmax(an, axis=1))
+        np.testing.assert_allclose(got_min0, np.nanmin(an, axis=0))
+        np.testing.assert_allclose(got_max, np.nanmax(an))
+        np.testing.assert_allclose(got_min, np.nanmin(an))
+
+
+def test_nanmax_all_nan_region_is_nan(spec):
+    an = np.full((4, 4), np.nan)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    out = ct.nanmax(a, axis=0).compute()
+    assert np.isnan(out).all()
+
+
+def test_nanmax_int_dtype(spec):
+    an = np.arange(12, dtype=np.int32).reshape(3, 4)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    got = ct.nanmax(a, axis=0).compute()
+    assert got.dtype == np.int32
+    np.testing.assert_array_equal(got, an.max(axis=0))
+
+
+def test_nanmax_rejects_complex(spec):
+    an = np.ones((2, 2), dtype=np.complex64)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    with pytest.raises(TypeError):
+        ct.nanmax(a)
+
+
+def test_nanmin_multichunk_jax_executor(spec):
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    rng = np.random.default_rng(0)
+    an = rng.uniform(-10, 10, (9, 8))
+    an[rng.uniform(size=an.shape) < 0.3] = np.nan
+    a = ct.from_array(an, chunks=(3, 3), spec=spec)
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        got = ct.nanmin(a, axis=1).compute(executor=JaxExecutor())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        np.testing.assert_allclose(got, np.nanmin(an, axis=1))
+
+
+def test_nanmax_int64_exact_above_2_53(spec):
+    an = np.array([2**53 + 1, 5], dtype=np.int64)
+    a = ct.from_array(an, chunks=(2,), spec=spec)
+    assert int(ct.nanmax(a).compute()) == 2**53 + 1
+
+
+def test_nanmax_empty_raises(spec):
+    a = ct.from_array(np.empty((0,), dtype=np.float64), chunks=(1,), spec=spec)
+    with pytest.raises(ValueError, match="zero-size"):
+        ct.nanmax(a)
