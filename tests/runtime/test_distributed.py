@@ -377,3 +377,48 @@ def test_distributed_hung_worker_evicted(tmp_path):
         assert coord.n_workers == 0  # evicted as hung
     finally:
         ex.close()
+
+
+def test_distributed_resume_after_fleet_failure(spec):
+    """Checkpoint/resume across fleet restarts: a plan that dies mid-way
+    (all workers killed) resumes on a FRESH fleet, skipping ops whose
+    persistent targets are fully initialized — the multi-host recovery
+    story (docs/multihost.md 'Resume / checkpoint')."""
+    an = np.arange(144, dtype=np.float64).reshape(12, 12)
+    a = ct.from_array(an, chunks=(3, 3), spec=spec)
+    b = xp.add(a, 1.0)
+    c = xp.add(b, 1.0)
+
+    ex1 = DistributedDagExecutor(n_local_workers=1, retries=0)
+    kill_state = {}
+
+    class KillFleetMidway:
+        def on_task_end(self, event):
+            kill_state["seen"] = kill_state.get("seen", 0) + 1
+            # event layout: 2 create-arrays + 16 op-b tasks end at event 18;
+            # firing at 20 (two op-c tasks in) guarantees b's target is
+            # FULLY initialized and therefore resumable
+            if kill_state["seen"] == 20:
+                for p in ex1._procs:
+                    os.kill(p.pid, signal.SIGKILL)
+
+    try:
+        with pytest.raises(Exception):
+            c.compute(
+                executor=ex1, callbacks=[KillFleetMidway()],
+                optimize_graph=False,
+            )
+    finally:
+        ex1.close()
+    assert kill_state.get("seen", 0) >= 20
+
+    # fresh fleet; resume skips whatever already hit the shared store
+    counter = TaskCounter()
+    with DistributedDagExecutor(n_local_workers=2) as ex2:
+        result = c.compute(
+            executor=ex2, callbacks=[counter], optimize_graph=False,
+            resume=True,
+        )
+    np.testing.assert_array_equal(result, an + 2.0)
+    # op b (16 tasks) must have been skipped: fewer events than a full run
+    assert counter.value < 32, counter.value
