@@ -70,7 +70,9 @@ from .elementwise_functions import (  # noqa: F401
     bitwise_right_shift,
     bitwise_xor,
     ceil,
+    clip,
     conj,
+    copysign,
     cos,
     cosh,
     divide,
@@ -81,6 +83,7 @@ from .elementwise_functions import (  # noqa: F401
     floor_divide,
     greater,
     greater_equal,
+    hypot,
     imag,
     isfinite,
     isinf,
@@ -88,14 +91,16 @@ from .elementwise_functions import (  # noqa: F401
     less,
     less_equal,
     log,
+    log10,
     log1p,
     log2,
-    log10,
     logaddexp,
     logical_and,
     logical_not,
     logical_or,
     logical_xor,
+    maximum,
+    minimum,
     multiply,
     negative,
     not_equal,
@@ -105,6 +110,7 @@ from .elementwise_functions import (  # noqa: F401
     remainder,
     round,
     sign,
+    signbit,
     sin,
     sinh,
     sqrt,

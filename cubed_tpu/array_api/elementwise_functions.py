@@ -330,3 +330,74 @@ def trunc(x, /):
     if x.dtype in _integer_dtypes:
         return x
     return elemwise(nxp.trunc, x, dtype=x.dtype)
+
+
+# -- 2023.12 additions (beyond the reference's 2022.12 surface) ------------
+
+
+def maximum(x1, x2, /):
+    return _binary(nxp.maximum, x1, x2, _real_numeric_dtypes, "maximum")
+
+
+def minimum(x1, x2, /):
+    return _binary(nxp.minimum, x1, x2, _real_numeric_dtypes, "minimum")
+
+
+def hypot(x1, x2, /):
+    return _binary(nxp.hypot, x1, x2, _real_floating_dtypes, "hypot")
+
+
+def copysign(x1, x2, /):
+    return _binary(nxp.copysign, x1, x2, _real_floating_dtypes, "copysign")
+
+
+def signbit(x, /):
+    from .dtypes import bool as _bool
+
+    return _unary(nxp.signbit, x, _real_floating_dtypes, "signbit",
+                  result_dtype=_bool)
+
+
+def clip(x, /, min=None, max=None):
+    """2023.12 ``clip``: bounds are scalars or arrays, None = unbounded.
+
+    Per spec, the result dtype is x's; bounds participate only by value."""
+    _check(x, _real_numeric_dtypes, "clip")
+    if min is None and max is None:
+        return x  # spec: elements returned unchanged; no kernel needed
+    from ..core.array import CoreArray
+
+    args = [x]
+    spec_parts = []
+    for bound in (min, max):
+        if bound is None:
+            spec_parts.append(None)
+        elif isinstance(bound, CoreArray):
+            if bound.dtype not in _real_numeric_dtypes:
+                raise TypeError("clip bounds must be real numeric")
+            args.append(bound)
+            spec_parts.append("array")
+        elif isinstance(bound, (int, float, np.integer, np.floating)):
+            spec_parts.append(bound)
+        else:
+            # raw ndarrays/lists would bake into the kernel as per-BLOCK
+            # constants — silently wrong on multi-chunk grids
+            raise TypeError(
+                "clip bounds must be None, real scalars, or cubed arrays; "
+                f"got {type(bound).__name__} (wrap with from_array/asarray)"
+            )
+
+    lo_spec, hi_spec = spec_parts
+
+    def _clip(a, *bounds):
+        bounds = list(bounds)
+        lo = bounds.pop(0) if lo_spec == "array" else lo_spec
+        hi = bounds.pop(0) if hi_spec == "array" else hi_spec
+        out = a
+        if lo is not None:
+            out = nxp.maximum(out, nxp.asarray(lo, dtype=a.dtype))
+        if hi is not None:
+            out = nxp.minimum(out, nxp.asarray(hi, dtype=a.dtype))
+        return out
+
+    return elemwise(_clip, *args, dtype=x.dtype)

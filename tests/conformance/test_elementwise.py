@@ -54,6 +54,7 @@ UNARY = {
     "atanh": (REAL_FLOAT_DTYPES, _OPEN_UNIT),
     "ceil": (REAL_FLOAT_DTYPES + INT_DTYPES, None),
     "cos": (REAL_FLOAT_DTYPES, _SMALL),
+    "signbit": (REAL_FLOAT_DTYPES, _SMALL),
     "cosh": (REAL_FLOAT_DTYPES, _SMALL),
     "exp": (REAL_FLOAT_DTYPES, _SMALL),
     "expm1": (REAL_FLOAT_DTYPES, _SMALL),
@@ -91,6 +92,11 @@ BINARY = {
     # operand ratios ~1e300 (pinned in SKIPS.txt)
     "atan2": (REAL_FLOAT_DTYPES, _SMALL),
     "logaddexp": (REAL_FLOAT_DTYPES, _SMALL),
+    # 2023.12 additions
+    "maximum": (NUMERIC_DTYPES, None),
+    "minimum": (NUMERIC_DTYPES, None),
+    "hypot": (REAL_FLOAT_DTYPES, _SMALL),
+    "copysign": (REAL_FLOAT_DTYPES, _SMALL),
     "bitwise_and": (INT_DTYPES + UINT_DTYPES + BOOL_DTYPE, None),
     "bitwise_or": (INT_DTYPES + UINT_DTYPES + BOOL_DTYPE, None),
     "bitwise_xor": (INT_DTYPES + UINT_DTYPES + BOOL_DTYPE, None),

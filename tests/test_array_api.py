@@ -505,3 +505,74 @@ def test_searchsorted_wrong_length_sorter_rejected(spec):
     s = ct.from_array(np.array([0, 1]), chunks=(2,), spec=spec)
     with pytest.raises(ValueError, match="sorter.shape"):
         xp.searchsorted(v, v, sorter=s)
+
+
+# -- 2023.12 elementwise additions (beyond-reference) ----------------------
+
+
+def test_maximum_minimum(spec):
+    an = np.array([[1.0, -5.0], [3.0, 8.0]])
+    bn = np.array([[2.0, -7.0], [3.0, 4.0]])
+    a = ct.from_array(an, chunks=(1, 2), spec=spec)
+    b = ct.from_array(bn, chunks=(1, 2), spec=spec)
+    np.testing.assert_array_equal(xp.maximum(a, b).compute(), np.maximum(an, bn))
+    np.testing.assert_array_equal(xp.minimum(a, b).compute(), np.minimum(an, bn))
+    # scalar promotion
+    np.testing.assert_array_equal(xp.maximum(a, 2.5).compute(), np.maximum(an, 2.5))
+
+
+def test_hypot_copysign_signbit(spec):
+    an = np.array([3.0, -3.0, 0.0, -0.0])
+    bn = np.array([4.0, -4.0, 1.0, -1.0])
+    a = ct.from_array(an, chunks=(2,), spec=spec)
+    b = ct.from_array(bn, chunks=(2,), spec=spec)
+    np.testing.assert_allclose(xp.hypot(a, b).compute(), np.hypot(an, bn))
+    np.testing.assert_array_equal(xp.copysign(a, b).compute(), np.copysign(an, bn))
+    sb = xp.signbit(a)
+    assert sb.dtype == np.bool_
+    np.testing.assert_array_equal(sb.compute(), np.signbit(an))
+
+
+@pytest.mark.parametrize(
+    "lo,hi",
+    [(2.0, 7.0), (None, 5.0), (3.0, None), (None, None)],
+)
+def test_clip_scalars(spec, lo, hi):
+    an = np.arange(10.0)
+    a = ct.from_array(an, chunks=(4,), spec=spec)
+    got = xp.clip(a, min=lo, max=hi).compute()
+    # spec: both bounds None -> x unchanged (np.clip rejects that case)
+    expect = an if lo is None and hi is None else np.clip(an, lo, hi)
+    np.testing.assert_array_equal(got, expect)
+    assert got.dtype == an.dtype
+
+
+def test_clip_array_bounds(spec):
+    an = np.arange(12.0).reshape(3, 4)
+    lon = np.full((3, 4), 2.0)
+    hin = np.full((3, 4), 8.0)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    lo = ct.from_array(lon, chunks=(2, 2), spec=spec)
+    hi = ct.from_array(hin, chunks=(2, 2), spec=spec)
+    np.testing.assert_array_equal(
+        xp.clip(a, min=lo, max=hi).compute(), np.clip(an, lon, hin)
+    )
+
+
+def test_clip_int_dtype_preserved(spec):
+    an = np.arange(10, dtype=np.int32)
+    a = ct.from_array(an, chunks=(4,), spec=spec)
+    got = xp.clip(a, min=2, max=7).compute()
+    assert got.dtype == np.int32
+    np.testing.assert_array_equal(got, np.clip(an, 2, 7))
+
+
+def test_clip_rejects_raw_ndarray_bounds(spec):
+    a = ct.from_array(np.arange(4.0), chunks=(2,), spec=spec)
+    with pytest.raises(TypeError, match="cubed arrays"):
+        xp.clip(a, min=np.array([1.0, 2.0, 3.0, 4.0]))
+
+
+def test_clip_both_none_is_same_plan(spec):
+    a = ct.from_array(np.arange(4.0), chunks=(2,), spec=spec)
+    assert xp.clip(a) is a  # no kernel scheduled
