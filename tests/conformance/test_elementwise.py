@@ -208,3 +208,13 @@ def test_reflected_operators(op, data, spec):
         np.float64(2.0), an
     )
     assert_matches(got, expect)
+
+
+@given(data=st.data())
+def test_clip_property(data, spec):
+    an = data.draw(arrays(dtypes=REAL_FLOAT_DTYPES))
+    lo = data.draw(st.one_of(st.none(), st.floats(-100, 50)))
+    hi = data.draw(st.one_of(st.none(), st.floats(50, 200)))
+    got = run(xp.clip(wrap(an, spec), min=lo, max=hi))
+    expect = an if lo is None and hi is None else np.clip(an, lo, hi)
+    assert_matches(got, expect.astype(an.dtype))
