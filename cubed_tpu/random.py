@@ -51,40 +51,9 @@ from .utils import to_chunksize
 
 def random(size, *, diagnostics=None, chunks=None, spec=None):
     """Uniform [0, 1) float64 array with per-block reproducible randomness."""
-    shape = (size,) if isinstance(size, int) else tuple(size)
-    dtype = np.dtype(np.float64)
-    spec = spec_from_config(spec)
-    chunks = normalize_chunks(chunks, shape, dtype=dtype)
-    numblocks = tuple(len(c) for c in chunks)
-    root_seed = pyrandom.getrandbits(30)
-
-    # hidden inputs: a shape template (virtual, zero-cost) and the seeded
-    # offsets array feeding per-block keys
-    template_t = virtual_empty(shape, dtype=dtype, chunks=to_chunksize(chunks) if shape else ())
-    t_name = gensym("template")
-    t_plan = Plan._new(t_name, "template", template_t, None, True)
-    template = new_array(t_name, template_t, spec, t_plan)
-
-    offsets_t = VirtualOffsetsArray(numblocks, base=root_seed)
-    o_name = gensym("seeds")
-    o_plan = Plan._new(o_name, "seeds", offsets_t, None, True)
-    offsets = new_array(o_name, offsets_t, spec, o_plan)
-
-    ndim = len(shape)
-
-    def block_function(out_key):
-        coords = out_key[1:]
-        return ((t_name, *coords), (o_name, *coords))
-
-    return general_blockwise(
-        _random_block,
-        block_function,
-        template,
-        offsets,
-        shape=shape,
-        dtype=dtype,
-        chunks=chunks,
-        op_name="random",
+    return _distribution(
+        size, chunks, spec, kernel=_random_block, op_name="random",
+        params=None, dtype=np.float64,
     )
 
 
@@ -105,3 +74,110 @@ def _random_block(chunk, seeded_offset):
 
 
 _random_block.traced_offsets = True
+
+
+def normal(size, *, mean=0.0, stddev=1.0, chunks=None, spec=None):
+    """Normal array with the same per-block determinism contract as
+    :func:`random` (beyond the reference, which only has uniform).
+
+    The kernel generates the STANDARD normal (parameter-free, so one
+    compile serves every (mean, stddev)); scaling applies as ordinary
+    elemwise ops, which fuse into the same program."""
+    mean, stddev = float(mean), float(stddev)
+    if stddev < 0:
+        raise ValueError(f"stddev must be non-negative, got {stddev}")
+    out = _distribution(
+        size, chunks, spec, kernel=_normal_block, op_name="normal",
+        params=None, dtype=np.float64,
+    )
+    from .array_api.elementwise_functions import add, multiply
+
+    if stddev != 1.0:
+        out = multiply(out, stddev)
+    if mean != 0.0:
+        out = add(out, mean)
+    return out
+
+
+def randint(low, high, size, *, chunks=None, spec=None):
+    """Uniform integers in [low, high) with per-block determinism.
+
+    The kernel draws from [0, high-low) — its compiled program is keyed by
+    the span only — and the low offset applies as a fused elemwise add."""
+    low, high = int(low), int(high)
+    if high <= low:
+        raise ValueError(f"high ({high}) must be greater than low ({low})")
+    out = _distribution(
+        size, chunks, spec, kernel=_randint_block, op_name="randint",
+        params=(high - low,), dtype=np.int64,
+    )
+    if low != 0:
+        from .array_api.elementwise_functions import add
+
+        out = add(out, low)
+    return out
+
+
+def _distribution(size, chunks, spec, *, kernel, op_name, params, dtype):
+    import functools
+
+    shape = (size,) if isinstance(size, int) else tuple(size)
+    dtype = np.dtype(dtype)
+    spec = spec_from_config(spec)
+    chunks = normalize_chunks(chunks, shape, dtype=dtype)
+    numblocks = tuple(len(c) for c in chunks)
+    root_seed = pyrandom.getrandbits(30)
+
+    template_t = virtual_empty(
+        shape, dtype=dtype, chunks=to_chunksize(chunks) if shape else ()
+    )
+    t_name = gensym("template")
+    t_plan = Plan._new(t_name, "template", template_t, None, True)
+    template = new_array(t_name, template_t, spec, t_plan)
+
+    offsets_t = VirtualOffsetsArray(numblocks, base=root_seed)
+    o_name = gensym("seeds")
+    o_plan = Plan._new(o_name, "seeds", offsets_t, None, True)
+    offsets = new_array(o_name, offsets_t, spec, o_plan)
+
+    def block_function(out_key):
+        coords = out_key[1:]
+        return ((t_name, *coords), (o_name, *coords))
+
+    fn = kernel if params is None else functools.partial(kernel, params=params)
+    fn.traced_offsets = True
+    return general_blockwise(
+        fn,
+        block_function,
+        template,
+        offsets,
+        shape=shape,
+        dtype=dtype,
+        chunks=chunks,
+        op_name=op_name,
+    )
+
+
+def _normal_block(chunk, seeded_offset):
+    if BACKEND == "jax":
+        import jax
+
+        off = seeded_offset.ravel()[0]
+        key = jax.random.fold_in(jax.random.key(0), off)
+        return jax.random.normal(key, chunk.shape, np.float64)
+    off = int(np.asarray(seeded_offset).ravel()[0])
+    rng = np.random.Generator(np.random.Philox(seed=off))
+    return rng.normal(size=chunk.shape)
+
+
+def _randint_block(chunk, seeded_offset, *, params):
+    (span,) = params
+    if BACKEND == "jax":
+        import jax
+
+        off = seeded_offset.ravel()[0]
+        key = jax.random.fold_in(jax.random.key(0), off)
+        return jax.random.randint(key, chunk.shape, 0, span, np.int64)
+    off = int(np.asarray(seeded_offset).ravel()[0])
+    rng = np.random.Generator(np.random.Philox(seed=off))
+    return rng.integers(0, span, size=chunk.shape, dtype=np.int64)

@@ -1,6 +1,7 @@
 """Distributed RNG tests. Reference parity: cubed/tests/test_random.py."""
 
 import numpy as np
+import pytest
 
 import cubed_tpu
 import cubed_tpu.random
@@ -80,3 +81,37 @@ def test_random_deterministic_across_processes(spec):
     k = jax.random.fold_in(jax.random.key(0), 42)
     here = np.asarray(jax.random.uniform(k, (4,), jnp.float32)).tolist()
     assert eval(out.stdout.strip()) == here
+
+
+def test_normal(spec):
+    a = cubed_tpu.random.normal((40, 30), chunks=(10, 10), spec=spec)
+    x = a.compute()
+    assert x.shape == (40, 30) and x.dtype == np.float64
+    assert abs(x.mean()) < 0.2 and abs(x.std() - 1.0) < 0.2
+    np.testing.assert_array_equal(x, a.compute())  # per-block determinism
+
+
+def test_normal_mean_stddev(spec):
+    a = cubed_tpu.random.normal((50, 50), mean=10.0, stddev=3.0,
+                                chunks=(20, 20), spec=spec)
+    x = a.compute()
+    assert abs(x.mean() - 10.0) < 0.5 and abs(x.std() - 3.0) < 0.5
+
+
+def test_randint(spec):
+    a = cubed_tpu.random.randint(5, 15, (30, 30), chunks=(8, 8), spec=spec)
+    x = a.compute()
+    assert x.dtype == np.int64
+    assert x.min() >= 5 and x.max() < 15
+    assert len(np.unique(x)) == 10  # all values hit at this size
+    np.testing.assert_array_equal(x, a.compute())
+
+
+def test_randint_validation(spec):
+    with pytest.raises(ValueError):
+        cubed_tpu.random.randint(5, 5, (4,), chunks=(2,), spec=spec)
+
+
+def test_normal_negative_stddev_rejected(spec):
+    with pytest.raises(ValueError, match="non-negative"):
+        cubed_tpu.random.normal((4,), stddev=-1.0, chunks=(2,), spec=spec)
