@@ -4,6 +4,8 @@ cubed/array_api/elementwise_functions.py (393 LoC)."""
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
 from ..backend_array_api import nxp
@@ -378,6 +380,18 @@ def clip(x, /, min=None, max=None):
             args.append(bound)
             spec_parts.append("array")
         elif isinstance(bound, (int, float, np.integer, np.floating)):
+            # a float bound on an integer array would be cast to x.dtype in
+            # the kernel (min=2.5 silently behaving as min=2; inf/nan have
+            # no integer value at all); the raw-ndarray path already raises
+            # for mixed kinds, so mirror it
+            if x.dtype.kind in "iu" and isinstance(
+                bound, (float, np.floating)
+            ) and not (math.isfinite(bound) and float(bound) == int(bound)):
+                raise TypeError(
+                    "clip: float bound without an exact integer value on "
+                    f"an integer array would truncate (got {bound!r} for "
+                    f"{x.dtype})"
+                )
             spec_parts.append(bound)
         else:
             # raw ndarrays/lists would bake into the kernel as per-BLOCK

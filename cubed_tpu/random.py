@@ -19,28 +19,32 @@ import numpy as np
 
 from .backend_array_api import BACKEND, nxp
 
-if BACKEND == "jax":
-    # Counter-parallel threefry lowering: generates each element
-    # independently instead of odd/even halves + strided interleave — the
-    # interleave was measured as the dominant kernel in the vorticity
-    # benchmark's device profile (a 2-tuple "select_select" fusion at
-    # ~11 GB/s). This selects a DIFFERENT (still deterministic,
-    # platform-invariant) stream than the default lowering, which is fine
-    # for the per-block contract: the flag is set here, at import, before
-    # any generation, so every executor and worker sees the same stream
-    # (the numpy backend already has its own Philox stream, as the
-    # reference's backends do). The flag is process-global and not part of
-    # jax's jit cache key, so programs the APPLICATION jitted before this
-    # import keep the old lowering while new traces use the new one —
-    # set ``CUBED_TPU_THREEFRY_PARTITIONABLE=0`` to leave jax's default
-    # untouched if that matters more than generation speed
-    # (tests/test_random.py::test_partitionable_threefry_pinned).
-    import os as _os
+def _ensure_partitionable_threefry():
+    """Counter-parallel threefry lowering: generates each element
+    independently instead of odd/even halves + strided interleave — the
+    interleave was measured as the dominant kernel in the vorticity
+    benchmark's device profile (a 2-tuple "select_select" fusion at
+    ~11 GB/s). This selects a DIFFERENT (still deterministic,
+    platform-invariant) stream than the default lowering, which is fine
+    for the per-block contract: the flag is set lazily at the FIRST
+    cubed_tpu RNG use in a process — array construction client-side, and
+    kernel trace/execution worker-side — so every executor and worker
+    sees the same stream, while merely importing cubed_tpu leaves the
+    host application's own ``jax.random`` streams untouched (the numpy
+    backend already has its own Philox stream, as the reference's
+    backends do). Set ``CUBED_TPU_THREEFRY_PARTITIONABLE=0`` to never
+    touch jax's default if that matters more than generation speed
+    (tests/test_random.py::test_partitionable_threefry_pinned)."""
+    if BACKEND != "jax":
+        return
+    import os
 
-    if _os.environ.get("CUBED_TPU_THREEFRY_PARTITIONABLE", "1") != "0":
-        import jax as _jax_mod
+    if os.environ.get("CUBED_TPU_THREEFRY_PARTITIONABLE", "1") == "0":
+        return
+    import jax
 
-        _jax_mod.config.update("jax_threefry_partitionable", True)
+    if not jax.config.jax_threefry_partitionable:
+        jax.config.update("jax_threefry_partitionable", True)
 from .chunks import normalize_chunks
 from .core.ops import general_blockwise, new_array
 from .core.plan import Plan, gensym
@@ -65,6 +69,7 @@ def _random_block(chunk, seeded_offset):
     if BACKEND == "jax":
         import jax
 
+        _ensure_partitionable_threefry()
         off = seeded_offset.ravel()[0]
         key = jax.random.fold_in(jax.random.key(0), off)
         return jax.random.uniform(key, chunk.shape, dtype=np.float64)
@@ -121,6 +126,7 @@ def randint(low, high, size, *, chunks=None, spec=None):
 def _distribution(size, chunks, spec, *, kernel, op_name, params, dtype):
     import functools
 
+    _ensure_partitionable_threefry()
     shape = (size,) if isinstance(size, int) else tuple(size)
     dtype = np.dtype(dtype)
     spec = spec_from_config(spec)
@@ -162,6 +168,7 @@ def _normal_block(chunk, seeded_offset):
     if BACKEND == "jax":
         import jax
 
+        _ensure_partitionable_threefry()
         off = seeded_offset.ravel()[0]
         key = jax.random.fold_in(jax.random.key(0), off)
         return jax.random.normal(key, chunk.shape, np.float64)
@@ -175,6 +182,7 @@ def _randint_block(chunk, seeded_offset, *, params):
     if BACKEND == "jax":
         import jax
 
+        _ensure_partitionable_threefry()
         off = seeded_offset.ravel()[0]
         key = jax.random.fold_in(jax.random.key(0), off)
         return jax.random.randint(key, chunk.shape, 0, span, np.int64)

@@ -50,6 +50,7 @@ import struct
 import threading
 import time
 import traceback
+from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Any, Dict, Optional
 
@@ -166,6 +167,7 @@ class Coordinator:
         port: int = 0,
         task_timeout: Optional[float] = None,
         timeout_strikes: int = 2,
+        blob_cache_size: int = 1024,
     ):
         self._server = socket.create_server((host, port))
         self._server.settimeout(0.2)
@@ -175,7 +177,13 @@ class Coordinator:
         self._next_task_id = 0
         self._closed = threading.Event()
         self._worker_joined = threading.Condition(self._lock)
-        self._blob_cache: Dict[tuple, tuple] = {}
+        #: LRU over (id(function), id(config)) — bounded so a long-lived
+        #: listen-mode coordinator serving many plans doesn't pin every
+        #: op's objects forever; an evicted pair is simply re-pickled on
+        #: the next submit (same bytes -> same blob_id -> workers that
+        #: already hold it are not resent)
+        self._blob_cache: OrderedDict[tuple, tuple] = OrderedDict()
+        self._blob_cache_size = max(1, blob_cache_size)
         self.task_timeout = task_timeout
         self.timeout_strikes = timeout_strikes
         #: diagnostics: blob bytes actually sent vs referenced by id
@@ -294,6 +302,14 @@ class Coordinator:
                             if entry is not None:
                                 entry[0] = time.monotonic() + self.task_timeout
                                 entry[1] = True
+                elif mtype == "blob_dropped":
+                    # the worker evicted this blob from its bounded caches;
+                    # forget we sent it so the next task of that op
+                    # re-ships the bytes (a task already in flight when the
+                    # eviction raced it fails with unknown-blob and heals
+                    # through the normal retry -> resend path)
+                    with self._lock:
+                        conn.blobs_sent.discard(msg.get("blob_id"))
                 else:
                     logger.warning("unknown message from %s: %r", conn.name, mtype)
         except (ConnectionError, OSError) as e:
@@ -356,17 +372,20 @@ class Coordinator:
         import cloudpickle
 
         # the cached value keeps (function, config) alive so the id()-pair
-        # key can never be reused by a different object after GC; the cache
-        # grows by one entry per op for the coordinator's lifetime (bytes
-        # must stay resendable: workers joining later, or losing tasks to a
-        # crash, receive the blob on their first task of that op)
+        # key can never be reused by a different object while the entry
+        # lives (bytes must stay resendable: workers joining later, or
+        # losing tasks to a crash, receive the blob on their first task of
+        # that op); eviction is safe because a miss just re-pickles
         key = (id(function), id(config))
         hit = self._blob_cache.get(key)
         if hit is not None:
+            self._blob_cache.move_to_end(key)
             return hit[2], hit[3]
         blob = cloudpickle.dumps((function, config))
         blob_id = hashlib.sha1(blob).hexdigest()
         self._blob_cache[key] = (function, config, blob_id, blob)
+        while len(self._blob_cache) > self._blob_cache_size:
+            self._blob_cache.popitem(last=False)
         return blob_id, blob
 
     def submit(self, _stats_wrapper, function, task_input, *, config=None) -> Future:
@@ -485,7 +504,19 @@ def run_worker(
         send_lock,
     )
     raw_blobs: Dict[str, bytes] = {}
-    decoded_blobs: Dict[str, tuple] = {}
+    #: LRU of decoded (function, config) pairs, bounded so a worker serving
+    #: a long-lived coordinator across many plans doesn't pin every op's
+    #: live objects (raw bytes are freed at decode, as before). Evicting
+    #: notifies the coordinator (``blob_dropped``) so it re-ships the bytes
+    #: with the next task of that op instead of assuming the worker still
+    #: holds them.
+    decoded_blobs: OrderedDict[str, tuple] = OrderedDict()
+    try:
+        decoded_cap = max(
+            1, int(os.environ.get("CUBED_TPU_WORKER_BLOB_CAP", "256"))
+        )
+    except ValueError:
+        decoded_cap = 256
     blob_lock = threading.Lock()
     stop = threading.Event()
 
@@ -497,20 +528,34 @@ def run_worker(
             # the decode/pop), inside the task try: an undeserializable op
             # (missing module on this host, version skew) fails THIS task
             # with a real traceback instead of killing the worker
+            dropped = []
             with blob_lock:
                 pair = decoded_blobs.get(blob_id)
                 if pair is None:
                     raw = raw_blobs.get(blob_id)
                     if raw is None:
                         raise RuntimeError(
-                            f"unknown blob {blob_id!r} (coordinator/worker "
-                            "state disagree)"
+                            f"unknown blob {blob_id!r} (evicted or never "
+                            "sent); the coordinator re-ships it on retry"
                         )
                     pair = cloudpickle.loads(raw)
                     decoded_blobs[blob_id] = pair
                     # raw bytes are dead weight once decoded (late
                     # duplicate tasks hit decoded_blobs first)
                     raw_blobs.pop(blob_id, None)
+                    while len(decoded_blobs) > decoded_cap:
+                        dropped.append(decoded_blobs.popitem(last=False)[0])
+                else:
+                    decoded_blobs.move_to_end(blob_id)
+            for gone in dropped:
+                try:
+                    send_frame(
+                        sock, {"type": "blob_dropped", "blob_id": gone},
+                        send_lock,
+                    )
+                except (ConnectionError, OSError):
+                    stop.set()
+                    return
             function, config = pair
             if msg.get("ack"):
                 try:
@@ -542,7 +587,17 @@ def run_worker(
             except Exception:
                 # unpicklable result (TypeError, PicklingError, ...): the
                 # value lives in the shared store anyway (tasks communicate
-                # through Zarr) — the task SUCCEEDED, so report completion
+                # through Zarr) — the task SUCCEEDED, so report completion.
+                # Loud, not silent: this is only safe while pipeline task
+                # RESULTS are never consumed; a future value-returning
+                # pipeline must not quietly receive None
+                logger.warning(
+                    "task %s: result of type %s is not picklable; "
+                    "reporting completion with result=None (safe only "
+                    "because pipeline results flow through the store, "
+                    "not the return value)",
+                    task_id, type(result).__name__,
+                )
                 send_frame(
                     sock,
                     {"type": "result", "task_id": task_id, "result": None,

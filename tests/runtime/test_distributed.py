@@ -422,3 +422,23 @@ def test_distributed_resume_after_fleet_failure(spec):
     np.testing.assert_array_equal(result, an + 2.0)
     # op b (16 tasks) must have been skipped: fewer events than a full run
     assert counter.value < 32, counter.value
+
+
+def test_distributed_blob_eviction_self_heals(spec, monkeypatch):
+    """With the worker's decoded-blob LRU capped at 1, every new op evicts
+    the previous one; the ``blob_dropped`` notification makes the
+    coordinator re-ship bytes, so plans reusing earlier ops still succeed
+    (the bounded caches are invisible to correctness)."""
+    monkeypatch.setenv("CUBED_TPU_WORKER_BLOB_CAP", "1")
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    with DistributedDagExecutor(n_local_workers=1, worker_threads=1) as ex:
+        a = ct.from_array(an, chunks=(4, 4), spec=spec)
+        r1 = float(xp.sum(a).compute(executor=ex))
+        # distinct ops across several plans cycle the cap-1 cache hard
+        r2 = float(xp.sum(xp.add(a, 1.0)).compute(executor=ex))
+        r3 = float(xp.mean(xp.multiply(a, 2.0)).compute(executor=ex))
+        # and the first plan's shape again, after its blobs were evicted
+        r4 = float(xp.sum(a).compute(executor=ex))
+    assert r1 == r4 == an.sum()
+    assert r2 == (an + 1.0).sum()
+    assert np.isclose(r3, (an * 2.0).mean())
