@@ -32,6 +32,7 @@ Reference parity: replaces cubed's serverless executors
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import logging
 import math
@@ -109,6 +110,21 @@ class JaxExecutor(DagExecutor):
     device_mem : int | None
         HBM residency budget in bytes (default: 75% of one device's memory,
         times the number of mesh devices when sharded).
+    compute_dtype : str | None
+        ``"float32"`` opts f64 plans into single-precision on-device
+        compute ("f32 ingestion"): the executor runs every trace with jax
+        x64 canonicalization disabled, so float64 kernels — including
+        threefry random GENERATION, the dominant device cost of f64
+        pipelines on v5e, which has no native f64 — produce float32, and
+        results cast back to the declared f64 dtype at the Zarr store
+        boundary (storage/store.py:380). Error bounds: each elementwise
+        op contributes relative error <= f32 eps (1.19e-7); a k-element
+        tree-sum accumulates <= (log2(k)+chunks) * eps * sum|a| absolute
+        error — ~1e-4 relative for the 1e8-element bench reductions —
+        versus ~1e-13 in f64. Accuracy-sensitive pipelines should stay on
+        the default. Conformance runs exclude this mode (it intentionally
+        diverges from the f64 oracle past f32 eps;
+        tests/conformance/SKIPS.txt).
     """
 
     def __init__(
@@ -117,10 +133,36 @@ class JaxExecutor(DagExecutor):
         device_mem: Optional[int] = None,
         fuse_plan: bool = True,
         use_pallas: Optional[bool] = None,
+        compute_dtype: Optional[str] = None,
+        matmul_precision: Optional[str] = None,
         **kwargs,
     ):
         self.mesh = mesh
         self.device_mem = device_mem
+        if compute_dtype not in (None, "float32", "float64"):
+            raise ValueError(
+                "compute_dtype must be None, 'float32' or 'float64'; "
+                f"got {compute_dtype!r}"
+            )
+        self.compute_dtype = compute_dtype
+        if matmul_precision not in (
+            None, "bfloat16", "bfloat16_3x", "tensorfloat32", "float32",
+            "highest", "default",
+        ):
+            raise ValueError(
+                "matmul_precision must be one of None, 'bfloat16', "
+                "'bfloat16_3x', 'tensorfloat32', 'float32', 'highest', "
+                f"'default'; got {matmul_precision!r}"
+            )
+        #: contraction precision for every dot/conv in the DAG, applied as
+        #: the thread-local ``jax.default_matmul_precision`` scope. On TPU
+        #: the MXU is a native bf16xbf16->f32 systolic array: 'bfloat16'
+        #: is one MXU pass per contraction (fastest, ~3 decimal digits of
+        #: input precision), 'bfloat16_3x' error-compensates with 3 passes,
+        #: 'highest' emulates full f32 (6 passes). Combine with
+        #: ``compute_dtype='float32'`` for the canonical f64-source opt-in:
+        #: f32 storage/elementwise, bf16 MXU contractions.
+        self.matmul_precision = matmul_precision
         #: trace consecutive traceable ops into ONE jitted XLA program
         self.fuse_plan = fuse_plan
         #: route eligible reduction combines through the Pallas streaming
@@ -226,6 +268,44 @@ class JaxExecutor(DagExecutor):
     # ------------------------------------------------------------------
 
     def execute_dag(
+        self,
+        dag,
+        callbacks: Optional[list[Callback]] = None,
+        array_names=None,
+        resume=None,
+        spec=None,
+        **kwargs,
+    ) -> None:
+        jax = _jax()
+        with contextlib.ExitStack() as stack:
+            if self.compute_dtype == "float32" and jax.config.jax_enable_x64:
+                # f32 ingestion: run the whole DAG with x64 canonicalization
+                # off. ``jax.enable_x64(False)`` is THREAD-LOCAL, so a
+                # concurrent thread computing with a default executor keeps
+                # f64, and an exception anywhere in the DAG restores the
+                # flag on context exit. The structural segment cache keys on
+                # jax_enable_x64 (thread-local-aware), so f32 and f64
+                # executions of one plan shape never share a compiled
+                # program. jax warns per f64 request it truncates; that's
+                # this mode working as designed, so silence it for the
+                # DAG's scope.
+                import warnings
+
+                w = stack.enter_context(warnings.catch_warnings())  # noqa: F841
+                warnings.filterwarnings(
+                    "ignore", message=".*requested dtype.*is not available.*"
+                )
+                stack.enter_context(jax.enable_x64(False))
+            if self.matmul_precision is not None:
+                # thread-local contraction-precision scope (MXU pass count)
+                stack.enter_context(
+                    jax.default_matmul_precision(self.matmul_precision)
+                )
+            return self._execute_dag_inner(
+                dag, callbacks, array_names, resume, spec, **kwargs
+            )
+
+    def _execute_dag_inner(
         self,
         dag,
         callbacks: Optional[list[Callback]] = None,
@@ -640,7 +720,9 @@ class JaxExecutor(DagExecutor):
                 jax.devices()[0].platform,
                 # executor config that changes the traced program: the Pallas
                 # opt-in swaps combine kernels; the mesh SHAPE (not just the
-                # flat device order) determines shardings
+                # flat device order) determines shardings; the contraction
+                # precision changes MXU pass counts inside the same HLO shape
+                str(self.matmul_precision),
                 bool(self.use_pallas),
                 tuple(self.mesh.devices.shape) if self.mesh is not None else None,
                 tuple(self.mesh.axis_names) if self.mesh is not None else None,

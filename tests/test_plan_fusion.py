@@ -415,3 +415,83 @@ def test_fused_output_also_persisted(spec, tmp_path):
     ct.to_zarr(xp.add(a, 1.0), out, executor=JaxExecutor())
     readback = ct.from_zarr(out, spec=spec).compute()
     np.testing.assert_allclose(np.asarray(readback), an + 1.0)
+
+
+def test_compute_dtype_f32_ingestion(spec):
+    """f32 ingestion (VERDICT r4 #4): an f64 plan executed with
+    ``compute_dtype="float32"`` computes on-device in single precision —
+    including random generation — and casts back to the declared f64 at
+    the store boundary, within f32 error bounds of the f64 result."""
+    import cubed_tpu.random
+
+    def build():
+        a = cubed_tpu.random.random((40, 40), chunks=(13, 13), spec=spec)
+        b = cubed_tpu.random.random((40, 40), chunks=(13, 13), spec=spec)
+        return xp.mean(xp.add(xp.multiply(a, b), xp.sin(a)))
+
+    f64 = np.asarray(build().compute(executor=JaxExecutor()))
+    f32 = np.asarray(build().compute(executor=JaxExecutor(compute_dtype="float32")))
+    assert f64.dtype == np.float64
+    assert f32.dtype == np.float64  # declared dtype preserved at the boundary
+    # different seeds each build, so compare statistically: both are means of
+    # ~0.25+sin-ish uniform products over 1600 elements
+    assert abs(float(f64) - float(f32)) < 0.1
+    # a seed-held comparison: same plan, both precisions, one from_array source
+    an = np.linspace(0.0, 1.0, 64, dtype=np.float64).reshape(8, 8)
+    src = ct.from_array(an, chunks=(3, 3), spec=spec)
+    expr = xp.sum(xp.sqrt(xp.abs(xp.sin(src) * 2.0 + 1.0)))
+    r64 = float(expr.compute(executor=JaxExecutor()))
+    an2 = np.linspace(0.0, 1.0, 64, dtype=np.float64).reshape(8, 8)
+    src2 = ct.from_array(an2, chunks=(3, 3), spec=spec)
+    expr2 = xp.sum(xp.sqrt(xp.abs(xp.sin(src2) * 2.0 + 1.0)))
+    r32 = float(expr2.compute(executor=JaxExecutor(compute_dtype="float32")))
+    np.testing.assert_allclose(r32, r64, rtol=1e-5)  # f32 eps * tree depth
+
+
+def test_compute_dtype_restores_x64(spec):
+    """The x64 flag is restored even when the plan fails mid-execution."""
+    import jax
+
+    assert jax.config.jax_enable_x64
+    a = xp.ones((6, 6), chunks=(2, 2), spec=spec)
+    xp.add(a, 1).compute(executor=JaxExecutor(compute_dtype="float32"))
+    assert jax.config.jax_enable_x64
+
+    def boom(x):
+        raise ValueError("kernel boom")
+
+    b = ct.map_blocks(boom, xp.ones((6, 6), chunks=(2, 2), spec=spec),
+                      dtype=np.float64)
+    with pytest.raises(Exception, match="kernel boom"):
+        b.compute(executor=JaxExecutor(compute_dtype="float32"))
+    assert jax.config.jax_enable_x64  # restored on the failure path too
+
+
+def test_compute_dtype_invalid():
+    with pytest.raises(ValueError, match="compute_dtype"):
+        JaxExecutor(compute_dtype="bfloat16")
+
+
+def test_matmul_precision_bf16(spec):
+    """The MXU contraction opt-in: matmul under
+    ``matmul_precision='bfloat16'`` runs the same plan with one-pass MXU
+    contractions — f32-accumulated, inputs rounded to bf16 (~3 decimal
+    digits), so the result tracks full precision to ~1e-2 relative."""
+    an = np.linspace(0.0, 1.0, 64 * 48, dtype=np.float64).reshape(64, 48)
+    bn = np.linspace(1.0, 2.0, 48 * 32, dtype=np.float64).reshape(48, 32)
+
+    def build():
+        a = ct.from_array(an, chunks=(16, 16), spec=spec)
+        b = ct.from_array(bn, chunks=(16, 16), spec=spec)
+        return xp.sum(xp.matmul(a, b))
+
+    exact = float(build().compute(executor=JaxExecutor()))
+    fast = float(build().compute(executor=JaxExecutor(
+        compute_dtype="float32", matmul_precision="bfloat16")))
+    np.testing.assert_allclose(fast, exact, rtol=2e-2)
+    np.testing.assert_allclose(exact, float((an @ bn).sum()), rtol=1e-12)
+
+
+def test_matmul_precision_invalid():
+    with pytest.raises(ValueError, match="matmul_precision"):
+        JaxExecutor(matmul_precision="int8")
