@@ -1,4 +1,5 @@
-"""Benchmark: ALL FIVE BASELINE.json configs, measured every run.
+"""Benchmark: ALL FIVE BASELINE.json configs (+ a scaled addsum), measured
+every run.
 
 1. ``addsum`` — config #1: ``xp.add(a, b).sum()`` on 5000x5000 f64 at
    (1000, 1000) chunks.
@@ -15,12 +16,20 @@
    notebook's (1000,900,800) exceeds one chip's HBM; the driver's mesh
    dryrun covers the sharded path).
 
+A sixth metric line, ``addsum_scaled`` (16000x16000), keeps config #1
+informative: the canonical 400 MB shape completes inside the ~70 ms
+dispatch/sync latency floor on device, so only the scaled variant can
+detect framework-level changes.
+
 Driver-survivable by construction: the parent process never imports jax and
 never touches the device tunnel; each phase runs in a subprocess with its
 own timeout; a cheap smoke subprocess detects a dead/wedged tunnel up front
 so its budget isn't burned by hangs; and one JSON line per config is always
 printed before the overall deadline (the driver parses the LAST line — the
-vorticity headline).
+vorticity headline). A dead tunnel is retried, not just tolerated: the CPU
+fallbacks are measured first (numbers in hand), with bounded re-probes of
+the tunnel in between — it has recovered mid-round before — and a revival
+switches the run back to device measurement.
 
 - The numpy baselines (reference's single-process PythonDagExecutor
   semantics) are measured once and recorded in ``BASELINE_RECORDED.json``
@@ -58,6 +67,14 @@ ADDSUM_CHUNK = 1000
 #: 2 generated arrays + 1 fused add+sum pass over both
 ADDSUM_WORK_BYTES = 2 * ADDSUM_SHAPE[0] * ADDSUM_SHAPE[1] * 8
 
+#: scaled addsum variant: the canonical 400 MB config finishes in the ~70 ms
+#: dispatch/sync latency floor on device (BENCH_PROFILE.md), so it can no
+#: longer detect framework changes; 16000x16000 (4.1 GB through the pipe)
+#: runs ~10x the floor while keeping the same op shape
+ADDSUM_SCALED_SHAPE = (16000, 16000)
+ADDSUM_SCALED_CHUNK = 2000
+ADDSUM_SCALED_WORK_BYTES = 2 * ADDSUM_SCALED_SHAPE[0] * ADDSUM_SCALED_SHAPE[1] * 8
+
 #: BASELINE.json config #4: matmul/tensordot via blockwise contraction.
 #: sum(a @ b) keeps the output on-device (a scalar fetch, not a 128MB
 #: transfer), so the number measures the contraction, not the tunnel.
@@ -93,20 +110,31 @@ import cubed_tpu.array_api as xp
 import cubed_tpu.random
 
 spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="4GB")
+workload = {workload!r}
 executor = None
 if {use_jax_executor!r}:
     from cubed_tpu.runtime.executors.jax import JaxExecutor
-    executor = JaxExecutor()
-
-workload = {workload!r}
+    if workload == "matmul_bf16":
+        # the MXU opt-in: f32 storage/elementwise, one-pass bf16 contractions
+        executor = JaxExecutor(
+            compute_dtype="float32", matmul_precision="bfloat16"
+        )
+    elif workload == "vorticity_f32":
+        # f32 ingestion for the f64 pipeline (v5e has no native f64)
+        executor = JaxExecutor(compute_dtype="float32")
+    else:
+        executor = JaxExecutor()
 
 def build():
-    if workload == "addsum":
-        shape, chunk = {addsum_shape!r}, {addsum_chunk!r}
+    if workload in ("addsum", "addsum_scaled"):
+        if workload == "addsum":
+            shape, chunk = {addsum_shape!r}, {addsum_chunk!r}
+        else:
+            shape, chunk = {addsum_scaled_shape!r}, {addsum_scaled_chunk!r}
         a = cubed_tpu.random.random(shape, chunks=chunk, spec=spec)
         b = cubed_tpu.random.random(shape, chunks=chunk, spec=spec)
         return xp.sum(xp.add(a, b))
-    if workload == "matmul":
+    if workload in ("matmul", "matmul_bf16"):
         n, chunk = {matmul_n!r}, {matmul_chunk!r}
         a = cubed_tpu.random.random((n, n), chunks=chunk, spec=spec)
         b = cubed_tpu.random.random((n, n), chunks=chunk, spec=spec)
@@ -142,12 +170,15 @@ t0 = time.perf_counter()
 val = s.compute(**kw)
 t1 = time.perf_counter()
 v = float(val)
-if workload == "addsum":
-    n = {addsum_shape!r}[0] * {addsum_shape!r}[1]
+if workload in ("addsum", "addsum_scaled"):
+    sh = {addsum_shape!r} if workload == "addsum" else {addsum_scaled_shape!r}
+    n = sh[0] * sh[1]
     assert 0.95 < v / n < 1.05, v  # sum of u1+u2 has mean 1.0 per element
-elif workload == "matmul":
+elif workload in ("matmul", "matmul_bf16"):
     n = {matmul_n!r}
-    assert 0.9 < v / (0.25 * n**3) < 1.1, v  # E[sum(A@B)] = n^3/4 for uniforms
+    # E[sum(A@B)] = n^3/4 for uniforms; bf16 input rounding widens the window
+    lo, hi = (0.85, 1.15) if workload == "matmul_bf16" else (0.9, 1.1)
+    assert lo < v / (0.25 * n**3) < hi, v
 elif workload == "elemwise":
     n = {elemwise_shape!r}[0] * {elemwise_shape!r}[1]
     assert 0.5 < v / n < 1.1, v  # E[sqrt(|sin(u)v + cos(v)|)] is O(1)
@@ -187,6 +218,8 @@ def _run_phase(
         chunk=CHUNK,
         addsum_shape=ADDSUM_SHAPE,
         addsum_chunk=ADDSUM_CHUNK,
+        addsum_scaled_shape=ADDSUM_SCALED_SHAPE,
+        addsum_scaled_chunk=ADDSUM_SCALED_CHUNK,
         matmul_n=MATMUL_N,
         matmul_chunk=MATMUL_CHUNK,
         elemwise_shape=ELEMWISE_SHAPE,
@@ -209,17 +242,17 @@ def _run_phase(
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
-def device_smoke_ok() -> bool:
+def device_smoke_ok(timeout: float = SMOKE_TIMEOUT_S) -> bool:
     """A trivial jitted dispatch through the inherited (device) env. A dead
-    or wedged tunnel hangs here for SMOKE_TIMEOUT_S instead of eating a full
-    phase budget."""
+    or wedged tunnel hangs here for the probe timeout instead of eating a
+    full phase budget."""
     try:
         out = subprocess.run(
             [sys.executable, "-c", SMOKE],
             env=dict(os.environ),
             capture_output=True,
             text=True,
-            timeout=_remaining(SMOKE_TIMEOUT_S),
+            timeout=_remaining(timeout),
         )
         return out.returncode == 0 and "smoke ok" in out.stdout
     except Exception:
@@ -241,6 +274,7 @@ def get_baselines() -> dict:
     for workload, shape, chunk in [
         ("vorticity", SHAPE, CHUNK),
         ("addsum", ADDSUM_SHAPE, ADDSUM_CHUNK),
+        ("addsum_scaled", ADDSUM_SCALED_SHAPE, ADDSUM_SCALED_CHUNK),
         ("matmul", (MATMUL_N, MATMUL_N), MATMUL_CHUNK),
         ("elemwise", ELEMWISE_SHAPE, ELEMWISE_CHUNK),
         ("reduce", REDUCE_SHAPE, REDUCE_CHUNK),
@@ -287,38 +321,35 @@ def get_baselines() -> dict:
     return rec
 
 
-def measure_config(workload: str, device_ok: bool, timeout: float) -> tuple:
-    """Returns (result dict or None, metric suffix)."""
-    if device_ok:
-        try:
-            return (
-                _run_phase(
-                    env=dict(os.environ),
-                    timeout=_remaining(timeout),
-                    use_jax_executor=True,
-                    warmup=True,
-                    workload=workload,
-                ),
-                "",
-            )
-        except Exception as e:
-            print(f"{workload} TPU phase failed: {str(e)[:1200]}", file=sys.stderr)
-    # tunnel-free CPU fallback: still the real framework + JaxExecutor,
-    # labelled honestly as not-a-TPU number
+def measure_device(workload: str, timeout: float):
+    """One device-phase attempt; None on failure (caller decides fallback)."""
     try:
-        return (
-            _run_phase(
-                env=_scrubbed_cpu_env(),
-                timeout=_remaining(timeout),
-                use_jax_executor=True,
-                warmup=True,
-                workload=workload,
-            ),
-            "_cpu_fallback",
+        return _run_phase(
+            env=dict(os.environ),
+            timeout=_remaining(timeout),
+            use_jax_executor=True,
+            warmup=True,
+            workload=workload,
+        )
+    except Exception as e:
+        print(f"{workload} TPU phase failed: {str(e)[:1200]}", file=sys.stderr)
+        return None
+
+
+def measure_cpu(workload: str, timeout: float):
+    """Tunnel-free CPU fallback: still the real framework + JaxExecutor,
+    labelled honestly as not-a-TPU number."""
+    try:
+        return _run_phase(
+            env=_scrubbed_cpu_env(),
+            timeout=_remaining(timeout),
+            use_jax_executor=True,
+            warmup=True,
+            workload=workload,
         )
     except Exception as e:
         print(f"{workload} CPU fallback failed too: {str(e)[:800]}", file=sys.stderr)
-        return None, "_unavailable"
+        return None
 
 
 #: context attached to degraded emissions so a dead tunnel at measurement
@@ -351,51 +382,106 @@ def emit(metric: str, res, baseline, work: int, unit: str = "GB/s/chip") -> None
     print(json.dumps(line), flush=True)
 
 
+#: (workload — doubles as the baselines key, metric name, work units, unit,
+#: cpu-phase timeout cap)
+CONFIGS = [
+    ("addsum", "blockwise_addsum_5000x5000_f64", ADDSUM_WORK_BYTES,
+     "GB/s/chip", 120),
+    ("addsum_scaled", "blockwise_addsum_16000x16000_f64_scaled",
+     ADDSUM_SCALED_WORK_BYTES, "GB/s/chip", 150),
+    ("matmul", "matmul_4000x4000_blockwise_contraction", MATMUL_FLOPS,
+     "GFLOP/s/chip", 100),
+    ("matmul_bf16", "matmul_4000x4000_bf16_mxu", MATMUL_FLOPS,
+     "GFLOP/s/chip", 100),
+    ("elemwise", "elementwise_chain_6000x6000_f64", ELEMWISE_WORK_BYTES,
+     "GB/s/chip", 100),
+    ("reduce", "axis_reductions_8000x8000_f64", REDUCE_WORK_BYTES,
+     "GB/s/chip", 100),
+    # physical bytes under f32 ingestion are half the declared-f64 bytes
+    ("vorticity_f32", "pangeo_vorticity_500x450x400_f32_ingest",
+     WORK_BYTES // 2, "GB/s/chip", 200),
+    # vorticity LAST (the driver parses the last line)
+    ("vorticity", "pangeo_vorticity_500x450x400_f64_throughput", WORK_BYTES,
+     "GB/s/chip", 300),
+]
+
+#: precision-opt-in variants compare against their full-precision config's
+#: numpy baseline (the speedup the opt-in buys over the same reference math)
+BASELINE_KEY = {"matmul_bf16": "matmul", "vorticity_f32": "vorticity"}
+
+#: measured after the canonical BASELINE.json configs when budget is tight
+VARIANT_WORKLOADS = {"addsum_scaled", "matmul_bf16", "vorticity_f32"}
+
+#: don't start re-probing a dead tunnel unless this much budget remains —
+#: a revival needs enough room to actually re-measure on device
+REPROBE_MIN_BUDGET_S = 200
+REPROBE_TIMEOUT_S = 45
+
+
 def main() -> None:
     baselines = get_baselines()
     device_ok = device_smoke_ok()
+    cpu_results: dict = {}
+
     if not device_ok:
-        print("device smoke test failed: tunnel dead/wedged; CPU fallback",
-              file=sys.stderr)
+        # The tunnel has recovered mid-round before (BENCH_PROFILE.md §TPU
+        # re-measurement), so don't give up after one probe: measure the CPU
+        # fallbacks now (numbers in hand whatever happens), re-probing the
+        # tunnel between configs while enough budget remains to use a
+        # revival.
+        print("device smoke failed: tunnel dead/wedged; measuring CPU "
+              "fallbacks while re-probing", file=sys.stderr)
+        cpu_order = sorted(
+            CONFIGS, key=lambda c: (c[0] in VARIANT_WORKLOADS, c[0] == "vorticity")
+        )
+        probes_left = 3  # a dead-tunnel probe costs its full timeout
+        for workload, _, _, _, cap in cpu_order:
+            cpu_results[workload] = measure_cpu(workload, cap)
+            budget = OVERALL_DEADLINE_S - (time.monotonic() - _T0)
+            if probes_left > 0 and budget > REPROBE_MIN_BUDGET_S:
+                probes_left -= 1
+                if device_smoke_ok(timeout=REPROBE_TIMEOUT_S):
+                    device_ok = True
+                    print("tunnel recovered mid-run; switching to device "
+                          "measurement", file=sys.stderr)
+                    break
 
-    # all 5 BASELINE.json configs; vorticity LAST (driver parses the last line)
-    res_a, sfx_a = measure_config("addsum", device_ok, 120)
-    res_m, sfx_m = measure_config("matmul", device_ok, 100)
-    res_e, sfx_e = measure_config("elemwise", device_ok, 100)
-    res_r, sfx_r = measure_config("reduce", device_ok, 100)
-    res_v, sfx_v = measure_config("vorticity", device_ok, 300)
+    device_results: dict = {}
+    if device_ok:
+        for workload, _, _, _, _cap in CONFIGS:
+            res = measure_device(workload, 300 if workload == "vorticity" else 120)
+            if res is None:
+                if device_smoke_ok(timeout=REPROBE_TIMEOUT_S):
+                    # phase-specific failure with a live tunnel: one retry
+                    res = measure_device(workload, 90)
+                else:
+                    # the documented MID-RUN wedge (smoke passed, tunnel
+                    # died later): stop burning budget on device phases so
+                    # the CPU fallback pass below still fits the deadline
+                    print("tunnel wedged mid-run; remaining configs go to "
+                          "CPU fallback", file=sys.stderr)
+                    break
+            device_results[workload] = res
 
-    emit(
-        "blockwise_addsum_5000x5000_f64" + sfx_a,
-        res_a,
-        baselines.get("addsum"),
-        ADDSUM_WORK_BYTES,
+    # CPU fallbacks for anything the device path didn't cover, in priority
+    # order (canonical BASELINE.json configs before variants) so a tight
+    # budget spends itself on the required metrics first
+    cpu_order = sorted(
+        CONFIGS, key=lambda c: (c[0] in VARIANT_WORKLOADS, c[0] == "vorticity")
     )
-    emit(
-        "matmul_4000x4000_blockwise_contraction" + sfx_m,
-        res_m,
-        baselines.get("matmul"),
-        MATMUL_FLOPS,
-        unit="GFLOP/s/chip",
-    )
-    emit(
-        "elementwise_chain_6000x6000_f64" + sfx_e,
-        res_e,
-        baselines.get("elemwise"),
-        ELEMWISE_WORK_BYTES,
-    )
-    emit(
-        "axis_reductions_8000x8000_f64" + sfx_r,
-        res_r,
-        baselines.get("reduce"),
-        REDUCE_WORK_BYTES,
-    )
-    emit(
-        "pangeo_vorticity_500x450x400_f64_throughput" + sfx_v,
-        res_v,
-        baselines.get("vorticity"),
-        WORK_BYTES,
-    )
+    for workload, _, _, _, cap in cpu_order:
+        if device_results.get(workload) is None and workload not in cpu_results:
+            if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 30:
+                cpu_results[workload] = measure_cpu(workload, cap)
+
+    for workload, metric, work, unit, cap in CONFIGS:
+        res, sfx = device_results.get(workload), ""
+        if res is None:
+            res, sfx = cpu_results.get(workload), "_cpu_fallback"
+            if res is None:
+                sfx = "_unavailable"
+        base = baselines.get(BASELINE_KEY.get(workload, workload))
+        emit(metric + sfx, res, base, work, unit=unit)
 
 
 if __name__ == "__main__":

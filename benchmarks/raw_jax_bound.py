@@ -1,0 +1,182 @@
+"""Raw-JAX lower bounds for every bench.py config (VERDICT r3 #3).
+
+Each BASELINE.json config re-expressed as ONE hand-written ``jax.jit`` of
+the same math (including RNG), with the cache/latency-robust harness from
+``benchmarks/BENCH_PROFILE.md``:
+
+- every timed iteration consumes a DISTINCT seed (defeats the tunnel's
+  (executable, args) result cache — trap #1);
+- timing forces a scalar fetch (``float(...)``), because
+  ``block_until_ready`` does not actually block through the tunnel
+  (trap #2);
+- the ~70 ms dispatch/sync latency floor is measured separately and
+  reported so short phases can be floor-subtracted.
+
+Dividing the framework's ``bench.py`` elapsed by these numbers gives the
+framework-overhead ratio per config. Run with the inherited (device) env
+for TPU numbers, or ``--cpu`` for a tunnel-free scrubbed-env run.
+
+Output: one JSON line per config plus a ``latency_floor`` line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: shapes mirror bench.py exactly (import-free so this file runs standalone)
+ADDSUM_SHAPE, ADDSUM_CHUNK = (5000, 5000), 1000
+MATMUL_N = 4000
+ELEMWISE_SHAPE = (6000, 6000)
+REDUCE_SHAPE = (8000, 8000)
+VORT_SHAPE = (500, 450, 400)
+
+REPS = 3
+
+
+def _work(config: str) -> tuple[float, str]:
+    """(work units, unit) matching bench.py's accounting."""
+    if config == "addsum":
+        return 2 * ADDSUM_SHAPE[0] * ADDSUM_SHAPE[1] * 8, "GB/s"
+    if config in ("matmul", "matmul_bf16"):
+        return 2 * MATMUL_N**3, "GFLOP/s"
+    if config == "elemwise":
+        return 2 * ELEMWISE_SHAPE[0] * ELEMWISE_SHAPE[1] * 8, "GB/s"
+    if config == "reduce":
+        return REDUCE_SHAPE[0] * REDUCE_SHAPE[1] * 8, "GB/s"
+    n = VORT_SHAPE[0] * VORT_SHAPE[1] * VORT_SHAPE[2]
+    itemsize = 4 if config == "vorticity_f32" else 8
+    return 6 * n * itemsize, "GB/s"
+
+
+def build_fns():
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_threefry_partitionable", True)
+
+    def _u(seed, salt, shape, dtype=jnp.float64):
+        key = jax.random.fold_in(jax.random.key(0), seed * 7919 + salt)
+        return jax.random.uniform(key, shape, dtype=dtype)
+
+    @jax.jit
+    def addsum(seed):
+        a = _u(seed, 1, ADDSUM_SHAPE)
+        b = _u(seed, 2, ADDSUM_SHAPE)
+        return jnp.sum(a + b)
+
+    @jax.jit
+    def matmul(seed):
+        a = _u(seed, 1, (MATMUL_N, MATMUL_N))
+        b = _u(seed, 2, (MATMUL_N, MATMUL_N))
+        return jnp.sum(a @ b)
+
+    @jax.jit
+    def matmul_bf16(seed):
+        # the MXU configuration the framework's opt-in targets: f32
+        # generation, one-pass bf16 contraction, f32 accumulation
+        a = _u(seed, 1, (MATMUL_N, MATMUL_N), jnp.float32)
+        b = _u(seed, 2, (MATMUL_N, MATMUL_N), jnp.float32)
+        with jax.default_matmul_precision("bfloat16"):
+            return jnp.sum(a @ b)
+
+    @jax.jit
+    def elemwise(seed):
+        a = _u(seed, 1, ELEMWISE_SHAPE)
+        b = _u(seed, 2, ELEMWISE_SHAPE)
+        return jnp.sum(jnp.sqrt(jnp.abs(jnp.sin(a) * b + jnp.cos(b))))
+
+    @jax.jit
+    def reduce(seed):
+        a = _u(seed, 1, REDUCE_SHAPE)
+        return jnp.max(jnp.mean(a, axis=0))
+
+    @jax.jit
+    def vorticity(seed):
+        a = _u(seed, 1, VORT_SHAPE)
+        b = _u(seed, 2, VORT_SHAPE)
+        x = _u(seed, 3, VORT_SHAPE)
+        y = _u(seed, 4, VORT_SHAPE)
+        return jnp.mean(a[1:] * x[1:] + b[1:] * y[1:])
+
+    @jax.jit
+    def trivial(seed):
+        return jnp.sum(jnp.full((8, 8), seed, jnp.float32))
+
+    @jax.jit
+    def vorticity_f32(seed):
+        a = _u(seed, 1, VORT_SHAPE, jnp.float32)
+        b = _u(seed, 2, VORT_SHAPE, jnp.float32)
+        x = _u(seed, 3, VORT_SHAPE, jnp.float32)
+        y = _u(seed, 4, VORT_SHAPE, jnp.float32)
+        return jnp.mean(a[1:] * x[1:] + b[1:] * y[1:])
+
+    return {
+        "addsum": addsum,
+        "matmul": matmul,
+        "matmul_bf16": matmul_bf16,
+        "elemwise": elemwise,
+        "reduce": reduce,
+        "vorticity": vorticity,
+        "vorticity_f32": vorticity_f32,
+        "_trivial": trivial,
+    }
+
+
+def time_fn(fn, *, reps=REPS, seed0=100) -> float:
+    """Best-of-reps wall seconds, distinct seed each rep, scalar-fetch sync."""
+    float(fn(seed0 - 1))  # warmup compile + first dispatch
+    best = float("inf")
+    for i in range(reps):
+        t0 = time.perf_counter()
+        float(fn(seed0 + i))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    fns = build_fns()
+    import jax
+
+    platform = jax.devices()[0].platform
+    floor = time_fn(fns["_trivial"], reps=5, seed0=900)
+    print(json.dumps({
+        "config": "latency_floor", "platform": platform,
+        "elapsed_s": round(floor, 4),
+    }), flush=True)
+    for config in (
+        "addsum", "matmul", "matmul_bf16", "elemwise", "reduce",
+        "vorticity", "vorticity_f32",
+    ):
+        elapsed = time_fn(fns[config])
+        work, unit = _work(config)
+        print(json.dumps({
+            "config": config,
+            "platform": platform,
+            "elapsed_s": round(elapsed, 4),
+            "rate": round(work / elapsed / 1e9, 3),
+            "unit": unit,
+            "rate_floor_subtracted": round(
+                work / max(elapsed - floor, 1e-9) / 1e9, 3
+            ),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    if "--cpu" in sys.argv and os.environ.get("_RAW_BOUND_CHILD") != "1":
+        sys.path.insert(0, REPO)
+        from __graft_entry__ import _scrubbed_cpu_env
+
+        env = _scrubbed_cpu_env(1)
+        env["_RAW_BOUND_CHILD"] = "1"
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cpu"], env=env
+        )
+        sys.exit(out.returncode)
+    main()
