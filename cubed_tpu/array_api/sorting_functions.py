@@ -2,11 +2,15 @@
 skips sort/argsort entirely, reference .github/workflows/array-api-tests.yml
 skip list).
 
-A global sort needs every element of the sorted axis in one task, so the
-axis is rechunked to a single chunk first (bounded-memory honest: if one
-axis-slab exceeds ``allowed_mem`` the plan-time projected check raises, the
-same behavior any other op has) and the sort itself is a blockwise kernel —
-on the TPU executor one fused ``jnp.sort``/``argsort`` over resident data.
+Two regimes:
+
+- axis already in one chunk: the sort is a single blockwise kernel — on
+  the TPU executor one fused ``jnp.sort``/``argsort`` over resident data.
+- multi-chunk axis: a bitonic merge-split network over chunks
+  (``_block_sort``) — every task merges exactly two chunks, so an axis
+  LARGER than ``allowed_mem`` sorts fine (the plan-time memory check
+  bounds each merge task, not the axis). Descending uses the global flip
+  identities, so the network only ever sorts ascending.
 """
 
 from __future__ import annotations
@@ -41,6 +45,17 @@ def sort(x, /, *, axis=-1, descending=False, stable=True):
     if x.dtype not in _real_numeric_dtypes:
         raise TypeError("Only real numeric dtypes are allowed in sort")
     axis = _normalize_axis(x, axis)
+
+    if x.numblocks[axis] > 1 and x.shape[axis] > 1:
+        from ._block_sort import block_sort
+
+        out = block_sort(x, axis)
+        if descending:
+            from .manipulation_functions import flip
+
+            out = flip(out, axis=axis)
+        return out
+
     x = _single_chunk_along(x, axis)
 
     def _sort_chunk(a):
@@ -58,6 +73,25 @@ def argsort(x, /, *, axis=-1, descending=False, stable=True):
     if x.dtype not in _real_numeric_dtypes:
         raise TypeError("Only real numeric dtypes are allowed in argsort")
     axis = _normalize_axis(x, axis)
+
+    if x.numblocks[axis] > 1 and x.shape[axis] > 1:
+        from ._block_sort import block_argsort
+        from ..core.ops import elemwise
+
+        if not descending:
+            return block_argsort(x, axis)
+        # stable-descending identity (see the numpy branch below), applied
+        # globally: argsort_desc(x) = flip(m-1 - argsort_asc(flip(x)))
+        from .manipulation_functions import flip
+
+        m = x.shape[axis]
+        idx_r = block_argsort(flip(x, axis=axis), axis)
+        mapped = elemwise(
+            lambda i: (m - 1 - i).astype(np.int64), idx_r,
+            dtype=np.dtype(np.int64),
+        )
+        return flip(mapped, axis=axis)
+
     x = _single_chunk_along(x, axis)
 
     def _argsort_chunk(a):
