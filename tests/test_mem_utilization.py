@@ -110,23 +110,27 @@ class PeakCapture(Callback):
         if event.peak_measured_mem_end:
             self.peak = max(self.peak, event.peak_measured_mem_end)
 
-OPS = {{
+ALL_OPS = {{
     "add": lambda a, b: xp.add(a, b),
     "negative": lambda a, b: xp.negative(a),
     "sum": lambda a, b: xp.sum(a, axis=0),
     "mean": lambda a, b: xp.mean(a, axis=0),
     "transpose": lambda a, b: xp.permute_dims(a, (1, 0)),
     "matmul": lambda a, b: xp.matmul(a, b),
-    "rechunk": lambda a, b: a.rechunk((4000, 500)),
+    "rechunk": lambda a, b: a.rechunk((SHAPE[0], CHUNKS[1] // 2)),
 }}
+OP_NAMES = {op_names!r}
+SHAPE = {shape!r}
+CHUNKS = {chunks!r}
 
 results = {{}}
-for name, op in OPS.items():
+for name in OP_NAMES:
+    op = ALL_OPS[name]
     spec = ct.Spec(work_dir=work_dir, allowed_mem="2GB", reserved_mem=reserved)
     # virtual (never-materialized) inputs: nothing ships in task closures, so
     # worker RSS reflects ONLY per-task chunk traffic + the measured baseline
-    a = xp.ones((4000, 4000), chunks=(1000, 1000), spec=spec)
-    b = xp.ones((4000, 4000), chunks=(1000, 1000), spec=spec)
+    a = xp.ones(SHAPE, chunks=CHUNKS, spec=spec)
+    b = xp.ones(SHAPE, chunks=CHUNKS, spec=spec)
     out = op(a, b)
     projected = out.plan.max_projected_mem()
     cap = PeakCapture()
@@ -141,12 +145,7 @@ print(json.dumps({{"reserved": int(reserved), "ops": results}}))
 """
 
 
-@pytest.mark.slow
-def test_measured_worker_peak_rss_within_projected(tmp_path):
-    """Per-op worker peak RSS (getrusage in the worker process) must stay
-    within the plan-time projected_mem bound — the projected model's upper
-    bound validated against real processes, on the numpy backend where the
-    per-chunk working set is exactly what the model prices."""
+def _run_measured_rss(tmp_path, *, op_names, shape, chunks, timeout=600):
     import json
     import os
     import subprocess
@@ -160,13 +159,16 @@ def test_measured_worker_peak_rss_within_projected(tmp_path):
     }
     env["CUBED_TPU_BACKEND"] = "numpy"
     env["JAX_PLATFORMS"] = "cpu"
-    script = _MEASURE_SCRIPT.format(repo=repo, work_dir=str(tmp_path))
+    script = _MEASURE_SCRIPT.format(
+        repo=repo, work_dir=str(tmp_path), op_names=list(op_names),
+        shape=tuple(shape), chunks=tuple(chunks),
+    )
     out = subprocess.run(
         [sys.executable, "-c", script],
         env=env,
         capture_output=True,
         text=True,
-        timeout=600,
+        timeout=timeout,
     )
     assert out.returncode == 0, out.stderr[-3000:]
     data = json.loads(out.stdout.strip().splitlines()[-1])
@@ -178,9 +180,36 @@ def test_measured_worker_peak_rss_within_projected(tmp_path):
     }
     assert not bad, f"ops exceeding projected_mem: {bad} (all: {data['ops']})"
     # the measurement must be real: every op reports a worker-process peak
-    # (interpreter baseline is tens of MB at minimum), and at least one op
-    # lands near its bound so a trivially-loose model still gets caught
+    # (interpreter baseline is tens of MB at minimum)
     assert all(r["peak_measured"] > 30 * 2**20 for r in data["ops"].values()), data
+    return data
+
+
+def test_measured_worker_peak_rss_fast(tmp_path):
+    """Fast-mode slice of the flagship guarantee, in the DEFAULT suite: a
+    real fresh-worker-process RSS measurement for two representative ops
+    must stay within projected_mem — a memory-model regression can't land
+    without failing a plain ``pytest tests/`` (VERDICT r3 #10)."""
+    _run_measured_rss(
+        tmp_path, op_names=["add", "sum"], shape=(2000, 2000),
+        chunks=(1000, 1000), timeout=300,
+    )
+
+
+@pytest.mark.slow
+def test_measured_worker_peak_rss_within_projected(tmp_path):
+    """Per-op worker peak RSS (getrusage in the worker process) must stay
+    within the plan-time projected_mem bound — the projected model's upper
+    bound validated against real processes, on the numpy backend where the
+    per-chunk working set is exactly what the model prices."""
+    data = _run_measured_rss(
+        tmp_path,
+        op_names=["add", "negative", "sum", "mean", "transpose", "matmul",
+                  "rechunk"],
+        shape=(4000, 4000), chunks=(1000, 1000),
+    )
+    # at least one op lands near its bound so a trivially-loose model
+    # still gets caught
     assert any(r["utilization"] > 0.5 for r in data["ops"].values()), data
 
 
