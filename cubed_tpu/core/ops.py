@@ -45,8 +45,6 @@ from ..utils import (
 from .array import CoreArray, check_array_specs, compute
 from .plan import Plan, gensym, new_temp_path
 
-TaskEndEvent = None  # re-exported elsewhere
-
 
 # ---------------------------------------------------------------------------
 # Creation from / export to storage

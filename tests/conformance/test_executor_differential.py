@@ -112,7 +112,8 @@ def test_random_linalg_and_stats_match_oracle(data, spec):
     b = ct.from_array(bn, chunks=(max(1, k // 2), max(1, n // 2)), spec=spec)
 
     kind = data.draw(st.sampled_from(
-        ["matmul", "tensordot", "var", "std", "nanmean", "index", "sort"]
+        ["matmul", "tensordot", "var", "std", "nanmean", "index", "sort",
+         "argsort"]
     ))
     if kind == "matmul":
         expr = xp.matmul(a, b)
@@ -129,6 +130,11 @@ def test_random_linalg_and_stats_match_oracle(data, spec):
             st.lists(st.integers(0, m - 1), min_size=1, max_size=m, unique=True)
         )
         expr = a[sorted(rows), :]
+    elif kind == "argsort":
+        expr = xp.argsort(
+            a, axis=data.draw(st.integers(0, 1)),
+            descending=data.draw(st.booleans()),
+        )
     else:
         expr = xp.sort(a, axis=data.draw(st.integers(0, 1)))
 

@@ -189,11 +189,24 @@ def test_measured_worker_peak_rss_fast(tmp_path):
     """Fast-mode slice of the flagship guarantee, in the DEFAULT suite: a
     real fresh-worker-process RSS measurement for two representative ops
     must stay within projected_mem — a memory-model regression can't land
-    without failing a plain ``pytest tests/`` (VERDICT r3 #10)."""
-    _run_measured_rss(
-        tmp_path, op_names=["add", "sum"], shape=(2000, 2000),
-        chunks=(1000, 1000), timeout=300,
-    )
+    without failing a plain ``pytest tests/`` (VERDICT r3 #10).
+
+    One retry: the idle margins are wide (utilization 0.31/0.52 for
+    add/sum), but the measurement runs real subprocesses that heavy
+    machine load can make RSS-spiky or slow — a genuine model regression
+    fails both attempts deterministically."""
+    import subprocess
+
+    for attempt in range(2):
+        try:
+            _run_measured_rss(
+                tmp_path, op_names=["add", "sum"], shape=(2000, 2000),
+                chunks=(1000, 1000), timeout=300,
+            )
+            return
+        except (AssertionError, subprocess.TimeoutExpired):
+            if attempt == 1:
+                raise
 
 
 @pytest.mark.slow
