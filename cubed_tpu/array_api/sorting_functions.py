@@ -32,6 +32,44 @@ def _normalize_axis(x, axis: int) -> int:
     return axis % x.ndim
 
 
+def _use_network(x, axis: int, out_itemsize: int | None = None) -> bool:
+    """Multi-chunk network only when the single-chunk slab would strain the
+    memory bound — a slab comfortably inside ``allowed_mem`` sorts faster
+    as ONE kernel (one fused jnp.sort) than as O(log^2 m) merge rounds.
+
+    The "fits" test mirrors the planner's blockwise bound
+    (primitive/blockwise.py: ``reserved + 2*input + 2*output``) over the
+    single-chunk path's two ops — the rechunk-to-one-chunk (in and out at
+    x's dtype) and the sort kernel (output at ``out_itemsize``, int64 for
+    argsort) — so ``auto`` never routes to a plan the planner then
+    rejects.
+
+    ``CUBED_TPU_SORT_NETWORK`` overrides: ``force`` always routes
+    multi-chunk axes through the network (tests pin its coverage with
+    small arrays), ``off`` restores the pre-network single-chunk-only
+    behavior, default ``auto`` applies the memory heuristic."""
+    if x.numblocks[axis] <= 1 or x.shape[axis] <= 1:
+        return False
+    import os
+
+    mode = os.environ.get("CUBED_TPU_SORT_NETWORK", "auto")
+    if mode == "force":
+        return True
+    if mode == "off":
+        return False
+    slab_elems = x.shape[axis]
+    for d in range(x.ndim):
+        if d != axis:
+            slab_elems *= x.chunksize[d]
+    in_bytes = slab_elems * x.dtype.itemsize
+    out_bytes = slab_elems * (out_itemsize or x.dtype.itemsize)
+    projected = x.spec.reserved_mem + max(
+        4 * in_bytes,              # rechunk to one chunk along the axis
+        2 * in_bytes + 2 * out_bytes,  # the sort/argsort kernel itself
+    )
+    return projected > x.spec.allowed_mem
+
+
 def _single_chunk_along(x, axis: int):
     if x.numblocks[axis] == 1:
         return x
@@ -46,7 +84,7 @@ def sort(x, /, *, axis=-1, descending=False, stable=True):
         raise TypeError("Only real numeric dtypes are allowed in sort")
     axis = _normalize_axis(x, axis)
 
-    if x.numblocks[axis] > 1 and x.shape[axis] > 1:
+    if _use_network(x, axis):
         from ._block_sort import block_sort
 
         out = block_sort(x, axis)
@@ -74,7 +112,7 @@ def argsort(x, /, *, axis=-1, descending=False, stable=True):
         raise TypeError("Only real numeric dtypes are allowed in argsort")
     axis = _normalize_axis(x, axis)
 
-    if x.numblocks[axis] > 1 and x.shape[axis] > 1:
+    if _use_network(x, axis, out_itemsize=8):
         from ._block_sort import block_argsort
         from ..core.ops import elemwise
 

@@ -23,6 +23,13 @@ from cubed_tpu.runtime.executors.python import PythonDagExecutor
 from .harness import arrays
 
 
+@pytest.fixture(autouse=True)
+def _force_sort_network(monkeypatch):
+    # keep the bitonic network in the differential fuzz (small shapes would
+    # otherwise take the single-kernel path under the memory heuristic)
+    monkeypatch.setenv("CUBED_TPU_SORT_NETWORK", "force")
+
+
 def _unary_step(draw, a):
     op = draw(st.sampled_from(["negative", "abs", "multiply2", "add1", "transpose",
                                "flip", "slice", "rechunk", "reshape_flat",
@@ -213,3 +220,48 @@ def test_random_plans_match_oracle_distributed(data, spec, fleet):
     oracle = np.asarray(expr.compute(executor=PythonDagExecutor()))
     remote = np.asarray(expr.compute(executor=fleet))
     np.testing.assert_allclose(remote, oracle, rtol=1e-12, atol=1e-12)
+
+
+# -- f32 ingestion: the documented error bound, fuzz-validated --------------
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=st.data())
+def test_f32_ingestion_within_documented_bounds(data, spec):
+    """``compute_dtype="float32"`` promises f32-eps-scale divergence from
+    the f64 result (executor docstring): fuzz random plans and hold every
+    one to a tolerance derived from the f32 bound — declared dtype must
+    stay f64 throughout."""
+    # inputs bounded to 1e3 so a drawn multiply yields terms <= ~1e6; the
+    # atol anchor below still uses scale^2 because the plan may multiply
+    # before a cancelling sum (rounding error scales with the TERMS, not
+    # the result)
+    bounded = st.floats(
+        min_value=-1e3, max_value=1e3, allow_nan=False,
+        allow_infinity=False, allow_subnormal=False, width=64,
+    )
+    an = data.draw(arrays(dtypes=(np.float64,), elements=bounded, shape=data.draw(
+        st.sampled_from([(6, 8), (5, 5, 4), (12,)])
+    )))
+    bn = data.draw(arrays(dtypes=(np.float64,), elements=bounded, shape=an.shape))
+    chunks = tuple(max(1, (s + 1) // 2) for s in an.shape)
+
+    a = ct.from_array(an, chunks=chunks, spec=spec)
+    b = ct.from_array(bn, chunks=chunks, spec=spec)
+    x = _binary_step(data.draw, _unary_step(data.draw, a), b)
+    expr = _reduce_step(data.draw, _unary_step(data.draw, x))
+
+    f64 = np.asarray(expr.compute(executor=JaxExecutor()))
+    f32 = np.asarray(expr.compute(executor=JaxExecutor(compute_dtype="float32")))
+    assert f32.dtype == f64.dtype == np.float64
+    # f32-eps bound anchored to the largest possible intermediate term
+    # (scale^2 from a multiply), not the result, which cancellation can
+    # shrink arbitrarily
+    scale = max(
+        float(np.max(np.abs(an), initial=0.0)),
+        float(np.max(np.abs(bn), initial=0.0)),
+        1.0,
+    )
+    k = max(an.size, 1)
+    atol = 16.0 * k * scale * scale * float(np.finfo(np.float32).eps)
+    np.testing.assert_allclose(f32, f64, rtol=1e-4, atol=atol, equal_nan=True)

@@ -15,6 +15,15 @@ import cubed_tpu.array_api as xp
 from .harness import REAL_FLOAT_DTYPES, arrays, assert_matches, run, wrap
 
 
+@pytest.fixture(autouse=True)
+def _force_network(monkeypatch):
+    # conformance shapes are small enough that the memory heuristic would
+    # route every multi-chunk sort to the one-kernel path; force the
+    # bitonic network so the fuzz covers it (numblocks==1 axes still take
+    # the plain path, keeping both in play)
+    monkeypatch.setenv("CUBED_TPU_SORT_NETWORK", "force")
+
+
 @given(data=st.data())
 def test_sort(data, spec):
     an = data.draw(arrays(dtypes=REAL_FLOAT_DTYPES))

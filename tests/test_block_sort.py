@@ -19,7 +19,10 @@ from cubed_tpu.runtime.executors.jax import JaxExecutor
 
 
 @pytest.fixture
-def spec(tmp_path):
+def spec(tmp_path, monkeypatch):
+    # small arrays would pass the memory heuristic and take the one-kernel
+    # path; force the network so these tests actually cover it
+    monkeypatch.setenv("CUBED_TPU_SORT_NETWORK", "force")
     return ct.Spec(work_dir=str(tmp_path), allowed_mem="100MB", reserved_mem=0)
 
 
@@ -101,3 +104,54 @@ def test_multichunk_sort_traces_on_jax_executor(spec):
     np.testing.assert_array_equal(got, np.sort(an))
     assert ex.stats["trace_failures"] == 0
     assert ex.stats["eager_fallbacks"] == 0
+
+
+# -- 'auto' routing heuristic (no force; the default production path) -------
+
+
+def test_auto_prefers_single_chunk_when_slab_fits(tmp_path, monkeypatch):
+    """Plenty of memory: a multi-chunk axis must take the one-kernel path,
+    not the network (network entry would hit the raising sentinel)."""
+    import cubed_tpu.array_api._block_sort as bs
+
+    def boom(*a, **k):
+        raise AssertionError("network used despite fitting slab")
+
+    monkeypatch.setattr(bs, "block_sort", boom)
+    monkeypatch.setattr(bs, "block_argsort", boom)
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="100MB", reserved_mem=0)
+    an = np.random.default_rng(6).random(1000)
+    a = ct.from_array(an, chunks=(100,), spec=spec)
+    np.testing.assert_array_equal(np.asarray(xp.sort(a).compute()), np.sort(an))
+    a = ct.from_array(an, chunks=(100,), spec=spec)
+    np.testing.assert_array_equal(
+        np.asarray(xp.argsort(a).compute()), np.argsort(an, kind="stable")
+    )
+
+
+def test_auto_network_when_reserved_mem_eats_budget(tmp_path):
+    """reserved_mem counts against the slab fit (review regression): a slab
+    whose 4x estimate fits allowed_mem alone must still go to the network
+    when reserved_mem leaves no room — and the plan must succeed."""
+    # slab 0.8MB f64: 4x = 3.2MB fits 8MB, but reserved 6MB leaves 2MB
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="8MB", reserved_mem="6MB")
+    n = 100_000
+    an = np.random.default_rng(7).permutation(n).astype(np.float64)
+    a = ct.from_array(an, chunks=(12_500,), spec=spec)
+    got = np.asarray(xp.sort(a).compute())
+    np.testing.assert_array_equal(got, np.arange(n, dtype=np.float64))
+
+
+def test_auto_argsort_accounts_int64_output(tmp_path):
+    """f32 argsort: the int64 output doubles the kernel's output bytes; the
+    heuristic must charge it (review regression) so the chosen path plans."""
+    # slab 0.4MB f32 -> a naive 4x-input estimate (1.6MB) fits 2.3MB and
+    # would pick the single-chunk path, whose kernel the planner prices at
+    # 2*0.4 + 2*0.8 = 2.4MB > 2.3MB (ValueError); charging the int64
+    # output routes to the network, which plans and sorts
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="2300KB", reserved_mem=0)
+    n = 100_000
+    an = np.random.default_rng(8).permutation(n).astype(np.float32)
+    a = ct.from_array(an, chunks=(12_500,), spec=spec)
+    got = np.asarray(xp.argsort(a).compute())
+    np.testing.assert_array_equal(got, np.argsort(an, kind="stable"))
