@@ -360,7 +360,14 @@ class _ReshapeFn:
 
 
 def roll(x, /, shift, *, axis=None):
-    """Roll elements along axes (reads shifted regions via map_direct)."""
+    """Roll elements along axes.
+
+    Pure-op formulation: ``roll(x, s, axis) = concat([x[n-s:], x[:n-s]])``
+    per axis, then a rechunk back to x's grid — slices, concat, and
+    rechunk all trace on the TPU executor (one fused program; rechunk of a
+    resident array is an alias/reshard), where the previous map_direct
+    body read shifted regions from storage and forced the whole op eager.
+    """
     if axis is None:
         flat = flatten(x)
         rolled = roll(flat, shift, axis=0)
@@ -372,56 +379,28 @@ def roll(x, /, shift, *, axis=None):
     if len(shift) != len(axis):
         raise ValueError("shift and axis must have the same length")
     shifts = {ax % x.ndim: int(s) for ax, s in zip(axis, shift)}
-    chunks = x.chunks
-    shape = x.shape
 
-    def _read_rolled(block, zarray, block_id=None):
-        pieces_sel = []
-        for ax in range(x.ndim):
-            start = sum(chunks[ax][: block_id[ax]])
-            stop = start + chunks[ax][block_id[ax]]
-            s = shifts.get(ax, 0) % (shape[ax] or 1)
-            # output [start, stop) comes from input [(start-s) % n, ...)
-            pieces_sel.append((start - s) % shape[ax] if shape[ax] else 0)
-        # read possibly-wrapping region via two slices per axis
-        out = _wrapped_read(zarray, pieces_sel, [
-            chunks[ax][block_id[ax]] for ax in range(x.ndim)
-        ], shape)
-        return numpy_array_to_backend_array(out)
-
-    return map_direct(
-        _read_rolled,
-        x,
-        shape=shape,
-        dtype=x.dtype,
-        chunks=chunks,
-        extra_projected_mem=2 * x.chunkmem,
-    )
-
-
-def _wrapped_read(zarray, starts, lengths, shape):
-    """Read a hyper-rectangle that may wrap around each axis.
-
-    Each axis contributes one or two (in_start, in_stop, out_offset) segments;
-    the cartesian product of segments tiles the output block.
-    """
-    ndim = len(shape)
-    segs = []
-    for ax in range(ndim):
-        start, length, n = starts[ax], lengths[ax], shape[ax]
-        if n == 0 or length == 0:
-            segs.append([(0, 0, 0)])
-        elif start + length <= n:
-            segs.append([(start, start + length, 0)])
-        else:
-            segs.append([(start, n, 0), (0, start + length - n, n - start)])
-    out = np.empty(tuple(lengths), dtype=zarray.dtype)
-    for combo in itertools.product(*segs):
-        in_sel = tuple(slice(s, e) for s, e, _ in combo)
-        out_sel = tuple(slice(off, off + (e - s)) for s, e, off in combo)
-        if any(s.start >= s.stop for s in in_sel):
+    out = x
+    for ax, s in sorted(shifts.items()):
+        n = x.shape[ax]
+        if not n:
             continue
-        out[out_sel] = zarray[in_sel]
+        s %= n
+        if s == 0:
+            continue
+        hi = tuple(
+            slice(n - s, None) if d == ax else slice(None)
+            for d in range(x.ndim)
+        )
+        lo = tuple(
+            slice(0, n - s) if d == ax else slice(None)
+            for d in range(x.ndim)
+        )
+        out = concat([out[hi], out[lo]], axis=ax)
+    if out is not x and out.chunks != x.chunks:
+        # concat shifted the chunk boundaries; restore x's grid so the
+        # roll is chunk-layout-invisible to downstream ops
+        out = out.rechunk(x.chunksize)
     return out
 
 

@@ -154,9 +154,13 @@ def searchsorted(x1, x2, /, *, side="left", sorter=None):
     """Insertion indices of ``x2`` into sorted 1-d ``x1`` (2023.12 standard;
     the reference has no searchsorted).
 
-    ``x1`` rechunks to one chunk (each task needs the whole sorted axis —
-    same bounded-memory contract as :func:`sort`); the search itself is
-    blockwise over ``x2``'s grid, each task binary-searching its own block.
+    When ``x1`` fits one task, it rechunks to one chunk and the search is
+    blockwise over ``x2``'s grid. When it doesn't (the memory heuristic of
+    :func:`sort`), the global index decomposes over x1's chunks — x1 is
+    sorted, so ``index(v) = sum_i searchsorted(x1_chunk_i, v)`` for either
+    ``side`` — and the plan becomes per-(chunk, block) partial counts
+    summed through the reduction tree: every task touches one x1 chunk and
+    one x2 block, so an x1 larger than ``allowed_mem`` searches fine.
     """
     if side not in ("left", "right"):
         raise ValueError(f"side must be 'left' or 'right', got {side!r}")
@@ -178,6 +182,9 @@ def searchsorted(x1, x2, /, *, side="left", sorter=None):
 
     from ..core.ops import general_blockwise
 
+    if _use_network(x1, 0, out_itemsize=8):
+        return _searchsorted_partial_counts(x1, x2, side)
+
     x1 = _single_chunk_along(x1, 0)
     n1, n2 = x1.name, x2.name
 
@@ -197,3 +204,34 @@ def searchsorted(x1, x2, /, *, side="left", sorter=None):
         chunks=x2.chunks if x2.ndim else (),
         op_name="searchsorted",
     )
+
+
+def _searchsorted_partial_counts(x1, x2, side):
+    """Memory-bounded searchsorted: per-(x1-chunk, x2-block) counts, summed
+    over the x1-chunk axis through the reduction tree."""
+    from ..core.ops import general_blockwise
+
+    m = x1.numblocks[0]
+    n1, n2 = x1.name, x2.name
+
+    def _block_function(out_key):
+        i = out_key[1]
+        return ((n1, i), (n2, *out_key[2:]))
+
+    def _partial_block(a1, a2):
+        counts = nxp.searchsorted(a1, a2, side=side).astype(np.int64)
+        return nxp.reshape(counts, (1,) + tuple(getattr(a2, "shape", ())))
+
+    partials = general_blockwise(
+        _partial_block,
+        _block_function,
+        x1,
+        x2,
+        shape=(m,) + tuple(x2.shape),
+        dtype=np.dtype(np.int64),
+        chunks=((1,) * m,) + tuple(x2.chunks if x2.ndim else ()),
+        op_name="searchsorted_partials",
+    )
+    from .statistical_functions import sum as _sum
+
+    return _sum(partials, axis=0)

@@ -403,9 +403,16 @@ class JaxExecutor(DagExecutor):
         if pipeline.function is not apply_blockwise:
             return "eager"  # create-arrays (host metadata) / unknown
         f = pipeline.config.function
-        if getattr(f, "host_data_nbytes", 0) > 2**23:
-            # kernel closes over large host data (from_array): tracing would
-            # bake it into the program as constants — run eagerly instead
+        if getattr(f, "host_data_nbytes", 0) > 2**18:
+            # kernel closes over non-trivial host data (from_array): tracing
+            # would bake it into the program as CONSTANTS — bloating the
+            # program, defeating the structural cache (the fingerprint and
+            # compiled executable become data-dependent), and inviting
+            # XLA's compile-time constant folding to evaluate whole op
+            # chains (a sort network over a 4 MB baked source measured
+            # MINUTES of folding). Run the source op eagerly: it
+            # materializes once as a resident device array and downstream
+            # segments take it as a program INPUT.
             return "eager"
         side_inputs = getattr(f, "side_inputs", None)
         if side_inputs and not (
@@ -1529,6 +1536,18 @@ class JaxExecutor(DagExecutor):
             shape = tuple(s.stop - s.start for s in sel)
             fill = getattr(arr, "fill_value", 0)
             return jax.numpy.full(shape, fill, dtype=arr.dtype)
+        if isinstance(arr, VirtualOffsetsArray):
+            # raw numpy, NOT backend-converted: inside a traced segment the
+            # backend conversion turns the block into a (constant-valued)
+            # tracer, which a host_block_id kernel's int(offset) cannot
+            # consume — the whole segment then trace-fails to eager. The
+            # hoisted-seed path above serves traced_offsets kernels; every
+            # other consumer wants a concrete value (it IS concrete: pure
+            # plan metadata).
+            sel = get_item(
+                blockdims_from_blockshape(arr.shape, proxy.chunks), coords
+            ) if arr.shape else ()
+            return np.asarray(arr[sel])
         # storage / small-virtual fallback (host read + device transfer)
         if self._tracing and isinstance(arr, (ZarrV2Array, LazyZarrArray)):
             raise _TraceAbort("storage read inside traced segment")

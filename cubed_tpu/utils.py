@@ -187,7 +187,24 @@ def join_path(dir_url: str, child_path: str) -> str:
 
 
 def peak_measured_mem() -> int:
-    """Peak RSS of this process in bytes (getrusage ru_maxrss)."""
+    """Peak RSS of this process in bytes.
+
+    On Linux this reads VmHWM from ``/proc/self/status``, NOT
+    ``getrusage(RUSAGE_SELF).ru_maxrss``: ru_maxrss survives ``execve``,
+    so any worker subprocess spawned from a fat parent (a long test run, a
+    big application) inherits the parent's peak as its own floor and the
+    measured-memory guarantee reads gigabytes of phantom usage (measured:
+    a 3.2 GB parent makes a fresh child report ru_maxrss 3.2 GB while its
+    true VmHWM is 167 MB). VmHWM belongs to the mm struct, which exec
+    replaces, so it reflects only this program's own footprint."""
+    if platform.system() == "Linux":
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmHWM:"):
+                        return int(line.split()[1]) * 1024
+        except OSError:
+            pass
     ru_maxrss = getrusage(RUSAGE_SELF).ru_maxrss
     # ru_maxrss is KiB on Linux, bytes on macOS
     if platform.system() == "Darwin":

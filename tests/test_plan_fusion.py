@@ -495,3 +495,38 @@ def test_matmul_precision_bf16(spec):
 def test_matmul_precision_invalid():
     with pytest.raises(ValueError, match="matmul_precision"):
         JaxExecutor(matmul_precision="int8")
+
+
+def test_host_sliced_from_array_splits_cleanly(spec):
+    """A >256KB from_array source runs as an EAGER op (its host data must
+    not bake into a traced program as constants — XLA constant-folds op
+    chains over baked data at compile time, measured at minutes for a
+    sort network over a 4MB source) while downstream ops still trace.
+    Regression x2: previously (a) 1-8MB sources were classified traceable
+    and then trace-FAILED the whole segment to eager (their offsets block
+    was backend-converted into a tracer the host block-id kernel can't
+    consume), (b) the classifier threshold allowed the constant-bake."""
+    n = 262_144  # 2MB f64: above the in-memory-virtual cap
+    an = np.arange(n, dtype=np.float64)
+    a = ct.from_array(an, chunks=(n // 8,), spec=spec)
+    ex = JaxExecutor()
+    v = float(xp.sum(xp.multiply(a, 2.0)).compute(executor=ex))
+    assert v == 2.0 * an.sum()
+    assert ex.stats["segments_traced"] == 1  # downstream traced
+    assert ex.stats["trace_failures"] == 0   # no failed trace attempt
+    assert ex.stats["eager_fallbacks"] == 0
+    assert ex.stats["eager_ops"] >= 2        # create-arrays + the source op
+
+
+def test_small_host_from_array_traces(spec):
+    """A small in-memory source (VirtualInMemoryArray, <=1MB cap) is cheap
+    to bake: the whole plan stays one traced segment."""
+    n = 32_768  # 256KB f64
+    an = np.arange(n, dtype=np.float64)
+    a = ct.from_array(an, chunks=(n // 4,), spec=spec)
+    ex = JaxExecutor()
+    v = float(xp.sum(a).compute(executor=ex))
+    assert v == an.sum()
+    assert ex.stats["segments_traced"] == 1
+    assert ex.stats["trace_failures"] == 0
+    assert ex.stats["eager_fallbacks"] == 0
