@@ -155,3 +155,38 @@ def test_auto_argsort_accounts_int64_output(tmp_path):
     a = ct.from_array(an, chunks=(12_500,), spec=spec)
     got = np.asarray(xp.argsort(a).compute())
     np.testing.assert_array_equal(got, np.argsort(an, kind="stable"))
+
+
+# -- searchsorted partial-counts (memory-bounded x1) ------------------------
+
+
+def test_searchsorted_partial_counts_matches_numpy(spec):
+    """Forced network: per-chunk counts summed over the tree must equal the
+    single-chunk binary search for both sides, with duplicates straddling
+    chunk boundaries."""
+    rng = np.random.default_rng(9)
+    x1n = np.sort(rng.integers(0, 8, 29)).astype(np.float64)
+    x2n = np.array([[0.0, 3.0, 7.0], [8.0, -1.0, 3.5]])
+    x1 = ct.from_array(x1n, chunks=(4,), spec=spec)
+    x2 = ct.from_array(x2n, chunks=(1, 2), spec=spec)
+    for side in ("left", "right"):
+        got = np.asarray(xp.searchsorted(x1, x2, side=side).compute())
+        np.testing.assert_array_equal(got, np.searchsorted(x1n, x2n, side=side))
+        got = np.asarray(
+            xp.searchsorted(x1, x2, side=side).compute(executor=JaxExecutor())
+        )
+        np.testing.assert_array_equal(got, np.searchsorted(x1n, x2n, side=side))
+
+
+def test_searchsorted_x1_larger_than_allowed_mem(tmp_path):
+    """The scale criterion for searchsorted: a sorted x1 bigger than
+    allowed_mem searches via partial counts (the old path rechunked x1 to
+    one chunk and raised at plan time)."""
+    small = ct.Spec(work_dir=str(tmp_path), allowed_mem="2MB", reserved_mem=0)
+    n = 500_000  # 4MB f64 > 2MB allowed
+    x1n = np.arange(n, dtype=np.float64)
+    x2n = np.random.default_rng(10).random(500) * n
+    x1 = ct.from_array(x1n, chunks=(31_250,), spec=small)
+    x2 = ct.from_array(x2n, chunks=(125,), spec=small)
+    got = np.asarray(xp.searchsorted(x1, x2).compute(executor=JaxExecutor()))
+    np.testing.assert_array_equal(got, np.searchsorted(x1n, x2n))
