@@ -15,11 +15,12 @@ fleet executors in SURVEY §2.4 (lithops/modal/beam/dask).
 Run: python examples/distributed_fleet.py
 """
 
+import os
 import sys
 import tempfile
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import cubed_tpu as ct
 import cubed_tpu.array_api as xp
