@@ -384,14 +384,22 @@ def clip(x, /, min=None, max=None):
             # the kernel (min=2.5 silently behaving as min=2; inf/nan have
             # no integer value at all); the raw-ndarray path already raises
             # for mixed kinds, so mirror it
-            if x.dtype.kind in "iu" and isinstance(
-                bound, (float, np.floating)
-            ) and not (math.isfinite(bound) and float(bound) == int(bound)):
-                raise TypeError(
-                    "clip: float bound without an exact integer value on "
-                    f"an integer array would truncate (got {bound!r} for "
-                    f"{x.dtype})"
-                )
+            if x.dtype.kind in "iu":
+                if isinstance(bound, (float, np.floating)) and not (
+                    math.isfinite(bound) and float(bound) == int(bound)
+                ):
+                    raise TypeError(
+                        "clip: float bound without an exact integer value "
+                        f"on an integer array would truncate (got {bound!r} "
+                        f"for {x.dtype})"
+                    )
+                info = np.iinfo(x.dtype)
+                if not (info.min <= int(bound) <= info.max):
+                    raise TypeError(
+                        "clip: bound not representable in the array's "
+                        f"dtype would wrap (got {bound!r} for {x.dtype}, "
+                        f"valid range [{info.min}, {info.max}])"
+                    )
             spec_parts.append(bound)
         else:
             # raw ndarrays/lists would bake into the kernel as per-BLOCK

@@ -529,24 +529,35 @@ def run_worker(
             # (missing module on this host, version skew) fails THIS task
             # with a real traceback instead of killing the worker
             dropped = []
+            missing = False
             with blob_lock:
                 pair = decoded_blobs.get(blob_id)
                 if pair is None:
                     raw = raw_blobs.get(blob_id)
                     if raw is None:
-                        raise RuntimeError(
-                            f"unknown blob {blob_id!r} (evicted or never "
-                            "sent); the coordinator re-ships it on retry"
-                        )
-                    pair = cloudpickle.loads(raw)
-                    decoded_blobs[blob_id] = pair
-                    # raw bytes are dead weight once decoded (late
-                    # duplicate tasks hit decoded_blobs first)
-                    raw_blobs.pop(blob_id, None)
-                    while len(decoded_blobs) > decoded_cap:
-                        dropped.append(decoded_blobs.popitem(last=False)[0])
+                        # eviction raced this task's dispatch. With
+                        # worker_threads > 1 this error frame can reach
+                        # the socket BEFORE the evicting thread's
+                        # blob_dropped for the same blob, so the
+                        # coordinator would retry once without re-shipping
+                        # bytes and burn a retry; send our own
+                        # blob_dropped first (coordinator discard is
+                        # idempotent) so the first retry carries the bytes
+                        missing = True
+                    else:
+                        pair = cloudpickle.loads(raw)
+                        decoded_blobs[blob_id] = pair
+                        # raw bytes are dead weight once decoded (late
+                        # duplicate tasks hit decoded_blobs first)
+                        raw_blobs.pop(blob_id, None)
+                        while len(decoded_blobs) > decoded_cap:
+                            dropped.append(
+                                decoded_blobs.popitem(last=False)[0]
+                            )
                 else:
                     decoded_blobs.move_to_end(blob_id)
+            if missing:
+                dropped.append(blob_id)
             for gone in dropped:
                 try:
                     send_frame(
@@ -556,6 +567,12 @@ def run_worker(
                 except (ConnectionError, OSError):
                     stop.set()
                     return
+            if missing:
+                raise RuntimeError(
+                    f"unknown blob {blob_id!r} (evicted or never sent); "
+                    "blob_dropped sent, the coordinator re-ships it on "
+                    "retry"
+                )
             function, config = pair
             if msg.get("ack"):
                 try:

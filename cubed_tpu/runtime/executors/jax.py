@@ -124,7 +124,10 @@ class JaxExecutor(DagExecutor):
         versus ~1e-13 in f64. Accuracy-sensitive pipelines should stay on
         the default. Conformance runs exclude this mode (it intentionally
         diverges from the f64 oracle past f32 eps;
-        tests/conformance/SKIPS.txt).
+        tests/conformance/SKIPS.txt). Side effect: the first f32 DAG
+        installs a process-global warnings filter ignoring jax's
+        "requested dtype float64 is not available" message (see
+        _install_f32_truncation_filter for why and what it costs).
     """
 
     def __init__(
@@ -287,14 +290,16 @@ class JaxExecutor(DagExecutor):
                 # jax_enable_x64 (thread-local-aware), so f32 and f64
                 # executions of one plan shape never share a compiled
                 # program. jax warns per f64 request it truncates; that's
-                # this mode working as designed, so silence it for the
-                # DAG's scope.
-                import warnings
-
-                w = stack.enter_context(warnings.catch_warnings())  # noqa: F841
-                warnings.filterwarnings(
-                    "ignore", message=".*requested dtype.*is not available.*"
-                )
+                # this mode working as designed, so silence it — with a
+                # once-per-process permanent filter rather than
+                # warnings.catch_warnings, whose save/restore of GLOBAL
+                # filter state races concurrent executor threads (a
+                # restore landing mid-flight would re-enable or swallow
+                # another thread's filters). See the helper's docstring
+                # for the cost: the filter stays installed process-wide,
+                # so other x64-off code in this process loses the same
+                # truncation warning.
+                _install_f32_truncation_filter()
                 stack.enter_context(jax.enable_x64(False))
             if self.matmul_precision is not None:
                 # thread-local contraction-precision scope (MXU pass count)
@@ -1728,6 +1733,39 @@ def _hbm_footprint(compiled) -> int:
         )
     except Exception:
         return 0
+
+_F32_FILTER_ENTRY = None
+
+
+def _install_f32_truncation_filter() -> None:
+    """Silence jax's per-request "requested dtype float64 is not
+    available" warning with a process-global filter.
+
+    Prepending a filter is effectively atomic under the GIL and is never
+    restored by us, so concurrent executor threads can't observe
+    half-saved filter state (unlike ``warnings.catch_warnings``, which
+    save/restores the GLOBAL filter list and races other threads).
+    Presence is re-checked against ``warnings.filters`` on every DAG —
+    not a trust-me flag — because an enclosing ``catch_warnings`` scope
+    (e.g. pytest's warnings plugin around each test) discards the entry
+    on exit.
+
+    Caveat, stated rather than hidden: while installed, the filter also
+    suppresses this warning for any OTHER code in the process that runs
+    with x64 canonicalization off (its own ``jax.enable_x64(False)``
+    scope). That is the documented cost of ``compute_dtype="float32"``:
+    it mutates global warnings state instead of save/restoring it
+    thread-unsafely."""
+    global _F32_FILTER_ENTRY
+    import warnings
+
+    if _F32_FILTER_ENTRY is not None and _F32_FILTER_ENTRY in warnings.filters:
+        return
+    warnings.filterwarnings(
+        "ignore", message=".*requested dtype.*is not available.*"
+    )
+    _F32_FILTER_ENTRY = warnings.filters[0]
+
 
 _PYTREES_REGISTERED = False
 

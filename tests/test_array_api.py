@@ -567,6 +567,21 @@ def test_clip_int_dtype_preserved(spec):
     np.testing.assert_array_equal(got, np.clip(an, 2, 7))
 
 
+def test_clip_rejects_out_of_range_bounds_on_int(spec):
+    an = np.arange(10, dtype=np.int32)
+    a = ct.from_array(an, chunks=(4,), spec=spec)
+    # integer-valued but unrepresentable in int32: would wrap in the kernel
+    for bad in (1e30, 2**40, -(2**40), np.float64(2**31)):
+        with pytest.raises(TypeError, match="not representable"):
+            xp.clip(a, min=bad)
+        with pytest.raises(TypeError, match="not representable"):
+            xp.clip(a, max=bad)
+    # boundary values are fine
+    info = np.iinfo(np.int32)
+    got = xp.clip(a, min=float(info.min), max=float(info.max)).compute()
+    np.testing.assert_array_equal(got, an)
+
+
 def test_clip_rejects_raw_ndarray_bounds(spec):
     a = ct.from_array(np.arange(4.0), chunks=(2,), spec=spec)
     with pytest.raises(TypeError, match="cubed arrays"):
