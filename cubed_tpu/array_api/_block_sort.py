@@ -32,14 +32,10 @@ Construction:
 
 Total work O(n log^2 m) for m chunks; memory per task stays O(chunk).
 
-Argsort cost note: each network round is expressed as TWO blockwise ops
-over the same pair merge (one emitting values, one indices) because the
-op model is single-output (the framework rejects multi-output gufuncs,
-matching the reference). On the primary (fused JAX) executor both kernels
-trace into one XLA program where CSE collapses the duplicated
-concat+lexsort; per-op executors (oracle, distributed, ``fuse_plan=
-False``) pay the merge twice per round — the honest price of keeping the
-op model simple, measured at ~1.6x the values-only sort end to end.
+Each argsort round is ONE multi-output blockwise op emitting (values,
+indices) from a single pair-merge (``general_blockwise`` with a list
+dtype), so every executor — oracle, distributed, JAX — runs the
+concat+lexsort once per round.
 """
 
 from __future__ import annotations
@@ -114,11 +110,11 @@ def _pair_order(vals, idxs, axis: int):
 
 
 def _round_ops(val, idx, *, axis, size, stride, local=False):
-    """One network round: returns (val', idx') — two general_blockwise ops
-    over the same pair-merge, one per component (XLA dedups the shared
-    merge inside a fused segment). ``idx`` is None for a values-only sort
-    (single op, plain sort — NaN-last matches the pair order in value
-    space). ``local`` is the round-0 within-chunk sort (no partner)."""
+    """One network round: returns (val', idx') — ONE general_blockwise op
+    (multi-output when ``idx`` is given) running the pair-merge once.
+    ``idx`` is None for a values-only sort (plain sort — NaN-last matches
+    the pair order in value space). ``local`` is the round-0 within-chunk
+    sort (no partner)."""
     numblocks = val.numblocks
     c = val.chunksize[axis]
     offsets = _offsets_array_for(val)
@@ -186,13 +182,14 @@ def _round_ops(val, idx, *, axis, size, stride, local=False):
     def val_kernel(*chunks):
         return merged_halves(chunks)[0]
 
-    def idx_kernel(*chunks):
-        return merged_halves(chunks)[1]
+    def pair_kernel(*chunks):
+        out_v, out_i, _ = merged_halves(chunks)
+        return out_v, out_i
 
     val_kernel.traced_offsets = True
-    idx_kernel.traced_offsets = True
+    pair_kernel.traced_offsets = True
     val_kernel.__name__ = "bitonic_merge_values"
-    idx_kernel.__name__ = "bitonic_merge_indices"
+    pair_kernel.__name__ = "bitonic_merge_pair"
 
     lane = c
     for d in range(val.ndim):
@@ -219,30 +216,31 @@ def _round_ops(val, idx, *, axis, size, stride, local=False):
     if idx is not None:
         nb_map[idx.name] = per_task
 
-    new_val = general_blockwise(
-        val_kernel,
-        block_function,
-        *uniq,
-        shape=val.shape,
-        dtype=val.dtype,
-        chunks=val.chunks,
-        extra_projected_mem=extra,
-        num_input_blocks=tuple(nb_map[a.name] for a in uniq),
-        op_name="bitonic_round" if not local else "bitonic_local_sort",
-    )
-    new_idx = None
-    if idx is not None:
-        new_idx = general_blockwise(
-            idx_kernel,
+    if idx is None:
+        new_val = general_blockwise(
+            val_kernel,
             block_function,
             *uniq,
             shape=val.shape,
-            dtype=np.dtype(np.int64),
+            dtype=val.dtype,
             chunks=val.chunks,
             extra_projected_mem=extra,
             num_input_blocks=tuple(nb_map[a.name] for a in uniq),
-            op_name="bitonic_round_idx" if not local else "bitonic_local_idx",
+            op_name="bitonic_round" if not local else "bitonic_local_sort",
         )
+        return new_val, None
+    # one multi-output op: the merge runs ONCE and feeds both arrays
+    new_val, new_idx = general_blockwise(
+        pair_kernel,
+        block_function,
+        *uniq,
+        shape=val.shape,
+        dtype=[val.dtype, np.dtype(np.int64)],
+        chunks=val.chunks,
+        extra_projected_mem=extra,
+        num_input_blocks=tuple(nb_map[a.name] for a in uniq),
+        op_name="bitonic_pair" if not local else "bitonic_local_pair",
+    )
     return new_val, new_idx
 
 

@@ -283,12 +283,42 @@ def general_blockwise(
     target_store=None,
     op_name: str = "general_blockwise",
     **kwargs,
-) -> CoreArray:
+):
+    """Apply an explicit block function.
+
+    Multi-output: pass ``dtype`` as a list (and optionally ``shape`` as a
+    list of shapes, ``target_store`` as a list) — ``func`` then returns a
+    tuple of arrays per task and a tuple of CoreArrays is returned, all
+    produced by ONE op (reference analogue:
+    cubed/primitive/blockwise.py:78-82 structured writes; promoted here to
+    real multiple array targets priced once at plan time)."""
     spec = _spec_of(*arrays)
-    name = gensym("array")
-    if target_store is None:
-        target_store = new_temp_path(name, spec)
-    chunks = normalize_chunks(chunks, shape, dtype=dtype)
+    multi = isinstance(dtype, (list, tuple))
+    if multi:
+        n_out = len(dtype)
+        names = [gensym("array") for _ in range(n_out)]
+        if target_store is None:
+            target_store = [new_temp_path(n, spec) for n in names]
+        shapes = (
+            list(shape)
+            if shape and isinstance(shape[0], (list, tuple))
+            else [tuple(shape)] * n_out
+        )
+        if isinstance(target_store, str):
+            raise TypeError(
+                "multi-output general_blockwise requires target_store to "
+                "be a list (one store per output) or None"
+            )
+        chunks = normalize_chunks(chunks, shapes[0], dtype=dtype[0])
+        out_name = names
+        shape_arg = [tuple(s) for s in shapes]
+    else:
+        names = [gensym("array")]
+        if target_store is None:
+            target_store = new_temp_path(names[0], spec)
+        chunks = normalize_chunks(chunks, shape, dtype=dtype)
+        out_name = names[0]
+        shape_arg = tuple(shape)
     op = primitive_general_blockwise(
         func,
         block_function,
@@ -297,17 +327,23 @@ def general_blockwise(
         reserved_mem=spec.reserved_mem,
         target_store=target_store,
         storage_options=spec.storage_options,
-        shape=tuple(shape),
+        shape=shape_arg,
         dtype=dtype,
         chunks=chunks,
         in_names=[a.name for a in arrays],
-        out_name=name,
+        out_name=out_name,
         extra_projected_mem=extra_projected_mem,
         num_input_blocks=num_input_blocks,
         fusable=fusable,
     )
-    plan = Plan._new(name, op_name, op.target_array, op, False, *arrays)
-    return new_array(name, op.target_array, spec, plan)
+    if multi:
+        targets = op.target_arrays
+        plan = Plan._new(names, op_name, targets, op, False, *arrays)
+        return tuple(
+            new_array(n, t, spec, plan) for n, t in zip(names, targets)
+        )
+    plan = Plan._new(names[0], op_name, op.target_array, op, False, *arrays)
+    return new_array(names[0], op.target_array, spec, plan)
 
 
 # ---------------------------------------------------------------------------

@@ -65,13 +65,35 @@ class Plan:
         hidden: bool = False,
         *source_arrays,
     ) -> "Plan":
-        """Create a new plan adding an op (and its output array) to the union
-        of the source arrays' plans."""
+        """Create a new plan adding an op (and its output array — or arrays,
+        when ``name``/``target`` are lists for a multi-output op) to the
+        union of the source arrays' plans."""
         dag = arrays_to_dag(*source_arrays)
 
         frame = inspect.currentframe()
         # skip this frame and internal callers
         stack_summaries = extract_stack_summaries(frame.f_back if frame else None)
+
+        if isinstance(name, (list, tuple)):
+            # multi-output op: one op node feeding N array nodes
+            op_node = gensym(f"op-{op_name}")
+            dag.add_node(
+                op_node,
+                name=op_node,
+                type="op",
+                op_display_name=f"{op_name}\n" + "\n".join(name),
+                op_name=op_name,
+                primitive_op=primitive_op,
+                pipeline=primitive_op.pipeline if primitive_op else None,
+                hidden=hidden,
+                stack_summaries=stack_summaries,
+            )
+            for n, t in zip(name, target):
+                dag.add_node(n, name=n, type="array", target=t, hidden=hidden)
+                dag.add_edge(op_node, n)
+            for x in source_arrays:
+                dag.add_edge(x.name, op_node)
+            return Plan(dag)
 
         if primitive_op is None:
             # op with no computation (e.g. wrapping an existing zarr array)

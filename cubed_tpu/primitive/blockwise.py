@@ -61,6 +61,12 @@ class BlockwiseSpec:
     (streaming reads for tree reductions).
     ``function`` consumes the chunks in the same structure and returns the
     output chunk (an array, or a dict of arrays for structured intermediates).
+
+    Multi-output ops (``writes_rest`` non-empty) return a TUPLE of arrays,
+    one per output, all sharing the block grid of the primary output; each
+    is written to the corresponding target. One kernel evaluation feeds N
+    arrays — e.g. a sort-network round emits (values, indices) from a
+    single merge instead of running the merge once per output.
     """
 
     block_function: Callable[..., Any]
@@ -74,6 +80,14 @@ class BlockwiseSpec:
     #: per chunk. The TPU executor uses this to run the entire (fused) kernel
     #: as ONE XLA program over HBM-resident arrays.
     shape_invariant: bool = False
+    #: additional output proxies for multi-output ops (empty for the
+    #: ordinary single-output case)
+    writes_rest: tuple = ()
+
+    @property
+    def writes(self) -> tuple:
+        """All output proxies, primary first."""
+        return (self.write, *self.writes_rest)
 
 
 def get_chunk(arr, chunkset, block_idx: tuple[int, ...]):
@@ -118,9 +132,24 @@ def apply_blockwise(out_key: tuple, *, config: BlockwiseSpec) -> None:
     else:
         result = config.function(*args)
 
-    target = config.write.open()
+    if config.writes_rest:
+        writes = config.writes
+        if not isinstance(result, (tuple, list)) or len(result) != len(writes):
+            raise ValueError(
+                f"multi-output kernel must return {len(writes)} arrays, "
+                f"got {type(result).__name__}"
+            )
+        for proxy, res in zip(writes, result):
+            _write_chunk(proxy, out_coords, res)
+    else:
+        _write_chunk(config.write, out_coords, result)
+
+
+def _write_chunk(write: CubedArrayProxy, out_coords: tuple, result) -> None:
+    """Write one output chunk through a proxy (plain or structured dtype)."""
+    target = write.open()
     chunkset = (
-        blockdims_from_blockshape(target.shape, config.write.chunks)
+        blockdims_from_blockshape(target.shape, write.chunks)
         if target.shape
         else ()
     )
@@ -290,44 +319,92 @@ def general_blockwise(
     *arrays: Any,
     allowed_mem: int,
     reserved_mem: int,
-    target_store: str,
-    shape: tuple[int, ...],
+    target_store: Any,
+    shape: Any,
     dtype: Any,
     chunks: tuple,  # tuple-of-tuples
     in_names: Optional[List[str]] = None,
-    out_name: Optional[str] = None,
+    out_name: Any = None,
     extra_projected_mem: int = 0,
     num_input_blocks: Optional[tuple[int, ...]] = None,
     fusable: bool = True,
     shape_invariant: bool = False,
     storage_options: Optional[dict] = None,
 ) -> PrimitiveOperation:
-    """Build a PrimitiveOperation for an explicit block function."""
-    out_name = out_name or gensym("array")
+    """Build a PrimitiveOperation for an explicit block function.
+
+    Multi-output: pass ``dtype`` (and ``target_store``/``out_name``, and
+    optionally ``shape``) as LISTS — one entry per output, all outputs on
+    the primary output's block grid. ``function`` then returns a tuple of
+    arrays, one per output, and the returned op carries ``target_arrays``.
+    """
+    multi = isinstance(dtype, (list, tuple))
+    if multi:
+        n_out = len(dtype)
+        # the core layer owns shape replication; the primitive requires
+        # explicit per-output lists so a plain string/tuple can't be
+        # silently iterated into nonsense
+        if not (
+            isinstance(shape, (list, tuple))
+            and shape
+            and isinstance(shape[0], (list, tuple))
+        ):
+            raise TypeError(
+                "multi-output general_blockwise requires shape to be a "
+                "list of per-output shapes"
+            )
+        if not isinstance(target_store, (list, tuple)) or not isinstance(
+            out_name, (list, tuple)
+        ):
+            raise TypeError(
+                "multi-output general_blockwise requires list-valued "
+                "target_store and out_name"
+            )
+        shapes = [tuple(s) for s in shape]
+        stores = list(target_store)
+        out_names = list(out_name)
+        dtypes = list(dtype)
+        if not (len(shapes) == len(stores) == len(out_names) == n_out):
+            raise ValueError("multi-output lists must have equal length")
+        nbs = {chunks_to_numblocks(blockdims_from_blockshape(s, to_chunksize(chunks))) for s in shapes}
+        if len(nbs) != 1:
+            raise ValueError(
+                "multi-output arrays must share one block grid; got "
+                f"numblocks {sorted(nbs)}"
+            )
+    else:
+        shapes = [tuple(shape)]
+        stores = [target_store]
+        out_names = [out_name or gensym("array")]
+        dtypes = [dtype]
     if in_names is None:
         in_names = [f"in_{i}" for i in range(len(arrays))]
 
-    chunksize = to_chunksize(chunks) if shape else ()
-    target_array = lazy_empty(
-        shape, dtype=dtype, chunks=chunksize, store=target_store,
-        storage_options=storage_options,
-    )
+    chunksize = to_chunksize(chunks) if shapes[0] else ()
+    target_arrays = [
+        lazy_empty(
+            s, dtype=dt, chunks=chunksize, store=st,
+            storage_options=storage_options,
+        )
+        for s, dt, st in zip(shapes, dtypes, stores)
+    ]
 
     reads_map = {
         name: CubedArrayProxy(arr, _proxy_chunks(arr))
         for name, arr in zip(in_names, arrays)
     }
-    write = CubedArrayProxy(target_array, chunksize)
+    writes = [CubedArrayProxy(t, chunksize) for t in target_arrays]
 
     # --- plan-time memory bound -------------------------------------------
     # Each input chunk is counted twice (storage-side buffer + backend array)
-    # and the output twice (backend result + write buffer); this deliberately
+    # and each output twice (backend result + write buffer); this deliberately
     # keeps the reference's conservative factor even though raw (uncompressed)
     # storage could drop one copy. Reference: cubed/primitive/blockwise.py:282-300.
     projected_mem = reserved_mem + extra_projected_mem
     for name, arr in zip(in_names, arrays):
         projected_mem += 2 * chunk_memory(arr.dtype, reads_map[name].chunks)
-    projected_mem += 2 * chunk_memory(dtype, chunksize)
+    for dt in dtypes:
+        projected_mem += 2 * chunk_memory(dt, chunksize)
 
     if projected_mem > allowed_mem:
         raise ValueError(
@@ -337,9 +414,9 @@ def general_blockwise(
         )
 
     nb_out = chunks_to_numblocks(chunks)
-    mappable = [(out_name, *idx) for idx in itertools.product(*(range(n) for n in nb_out))]
+    mappable = [(out_names[0], *idx) for idx in itertools.product(*(range(n) for n in nb_out))]
     if not mappable:
-        mappable = [(out_name,)]
+        mappable = [(out_names[0],)]
 
     spec = BlockwiseSpec(
         block_function=block_function,
@@ -347,20 +424,22 @@ def general_blockwise(
         function_nargs=len(arrays),
         num_input_blocks=num_input_blocks or (1,) * len(arrays),
         reads_map=reads_map,
-        write=write,
+        write=writes[0],
         shape_invariant=shape_invariant,
+        writes_rest=tuple(writes[1:]),
     )
     pipeline = CubedPipeline(apply_blockwise, gensym("blockwise"), mappable, spec)
     return PrimitiveOperation(
         pipeline=pipeline,
         source_array_names=list(in_names),
-        target_array=target_array,
+        target_array=target_arrays[0],
         projected_mem=projected_mem,
         allowed_mem=allowed_mem,
         reserved_mem=reserved_mem,
         num_tasks=len(mappable),
         fusable=fusable,
         write_chunks=chunksize,
+        target_arrays=target_arrays if multi else None,
     )
 
 
@@ -396,6 +475,12 @@ def is_fuse_candidate(op: PrimitiveOperation) -> bool:
 
 
 def can_fuse_pipelines(op1: PrimitiveOperation, op2: PrimitiveOperation) -> bool:
+    if op1.target_arrays is not None:
+        # a multi-output predecessor can't fuse away into one consumer: its
+        # other outputs still need writing (consumers CAN be multi-output;
+        # on the TPU executor unfused ops still trace into one segment
+        # program, so nothing is lost on the primary path)
+        return False
     if is_fuse_candidate(op1) and is_fuse_candidate(op2):
         return op1.fusable and op2.fusable and op1.num_tasks == op2.num_tasks
     return False
@@ -532,6 +617,7 @@ def fuse_multiple(
         write=spec.write,
         shape_invariant=spec.shape_invariant
         and all(ps is None or ps.shape_invariant for ps in pred_specs),
+        writes_rest=spec.writes_rest,
     )
     pipeline = CubedPipeline(
         apply_blockwise, gensym("fused"), op.pipeline.mappable, fused_spec
@@ -546,6 +632,7 @@ def fuse_multiple(
         num_tasks=op.num_tasks,
         fusable=True,
         write_chunks=op.write_chunks,
+        target_arrays=op.target_arrays,
     )
 
 

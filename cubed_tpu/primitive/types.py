@@ -32,6 +32,9 @@ class PrimitiveOperation:
     num_tasks: int
     fusable: bool = True
     write_chunks: Optional[tuple] = None
+    #: all output arrays for a multi-output op (primary first); None for
+    #: ordinary single-output ops, where ``target_array`` is the one output
+    target_arrays: Optional[list] = None
 
 
 class CubedArrayProxy:

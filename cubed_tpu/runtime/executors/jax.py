@@ -709,7 +709,7 @@ class JaxExecutor(DagExecutor):
                         spec_.function,
                         spec_.block_function,
                         getattr(spec_, "shape_invariant", False),
-                        spec_.write,
+                        tuple(spec_.writes),
                         tuple(
                             (n, spec_.reads_map[n])
                             for n in sorted(spec_.reads_map)
@@ -793,10 +793,12 @@ class JaxExecutor(DagExecutor):
         out_bytes = 0
         for _, node in ops:
             pipeline = node["primitive_op"].pipeline
-            target = pipeline.config.write.array
-            shape = tuple(getattr(target, "shape", ()) or ())
-            dt = np.dtype(target.dtype)
-            out_bytes += int(np.prod(shape or (1,))) * dt.itemsize
+            cfg = pipeline.config
+            for w in getattr(cfg, "writes", None) or (cfg.write,):
+                target = w.array
+                shape = tuple(getattr(target, "shape", ()) or ())
+                dt = np.dtype(target.dtype)
+                out_bytes += int(np.prod(shape or (1,))) * dt.itemsize
         in_bytes = sum(r.nbytes for r in resident.values())
         if in_bytes + out_bytes > budget:
             return False
@@ -817,8 +819,9 @@ class JaxExecutor(DagExecutor):
         keep = self._segment_keep(ops, dag, requested_stores)
         produced = set()
         for _, node in ops:
-            pipeline = node["primitive_op"].pipeline
-            produced.add(str(pipeline.config.write.array.store))
+            cfg = node["primitive_op"].pipeline.config
+            for w in getattr(cfg, "writes", None) or (cfg.write,):
+                produced.add(str(w.array.store))
         keep_list = [k for k in keep if k in produced or k in in_keys]
 
         # structural fast path: a repeat compute of an identical plan shape
@@ -978,7 +981,11 @@ class JaxExecutor(DagExecutor):
         inputs = self._whole_inputs(spec, resident)
 
         value = None
-        if spec.shape_invariant and not getattr(spec.function, "needs_block_id", False):
+        if (
+            spec.shape_invariant
+            and not spec.writes_rest
+            and not getattr(spec.function, "needs_block_id", False)
+        ):
             mapping = self._probe_one_to_one(spec, op)
             if mapping and inputs is not None:
                 try:
@@ -1017,6 +1024,19 @@ class JaxExecutor(DagExecutor):
         if value is None:
             value = self._exec_chunked(op, spec, resident)
             self.stats["chunked_ops"] += 1
+
+        if spec.writes_rest:
+            # multi-output: value is one device array per output proxy
+            for proxy, v in zip(spec.writes, value):
+                t = proxy.array
+                if tuple(v.shape) != tuple(t.shape):
+                    raise ValueError(
+                        f"multi-output op produced shape {tuple(v.shape)}, "
+                        f"target expects {tuple(t.shape)} (kernel/block-"
+                        "function contract violation)"
+                    )
+                self._admit(resident, str(t.store), v, t, budget)
+            return
 
         if not isinstance(value, dict) and tuple(value.shape) != out_shape:
             # chunked is the last resort: a shape mismatch here is a kernel
@@ -1336,7 +1356,23 @@ class JaxExecutor(DagExecutor):
 
             for ti, t in enumerate(tasks):
                 out_coords = tuple(keys[t][1:])
-                if isinstance(out_stacked, dict):
+                if spec.writes_rest:
+                    if not isinstance(out_stacked, (tuple, list)) or len(
+                        out_stacked
+                    ) != len(spec.writes):
+                        return None
+                    for j, (w, stacked) in enumerate(
+                        zip(spec.writes, out_stacked)
+                    ):
+                        cs_j = blockdims_from_blockshape(
+                            tuple(w.array.shape), w.chunks
+                        )
+                        if tuple(stacked.shape[1:]) != chunk_shape_at(
+                            cs_j, out_coords
+                        ):
+                            return None
+                    chunk_grid[out_coords] = tuple(v[ti] for v in out_stacked)
+                elif isinstance(out_stacked, dict):
                     chunk_grid[out_coords] = {
                         k: v[ti] for k, v in out_stacked.items()
                     }
@@ -1346,6 +1382,13 @@ class JaxExecutor(DagExecutor):
                         return None
                     chunk_grid[out_coords] = out_stacked[ti]
 
+        if spec.writes_rest:
+            return tuple(
+                _assemble(
+                    {c: v[j] for c, v in chunk_grid.items()}, out_nb
+                )
+                for j in range(len(spec.writes))
+            )
         value = _assemble(chunk_grid, out_nb)
         if not isinstance(value, dict) and tuple(value.shape) != out_shape:
             return None
@@ -1409,6 +1452,14 @@ class JaxExecutor(DagExecutor):
                     result = jitted(*args)
             chunk_grid[out_coords] = result
 
+        if spec.writes_rest:
+            # multi-output: per-chunk tuples -> one assembled array per output
+            return tuple(
+                _assemble({c: v[j] for c, v in chunk_grid.items()}, nb)
+                if out_shape
+                else chunk_grid[()][j]
+                for j in range(len(spec.writes))
+            )
         if not out_shape:
             return chunk_grid[()]
         return _assemble(chunk_grid, nb)

@@ -40,12 +40,81 @@ def test_sort_axis_larger_than_allowed_mem(tmp_path, executor):
 
 
 def test_argsort_axis_larger_than_allowed_mem(tmp_path):
+    # 3MB axis slab, 2MB allowed_mem. Chunks sized for the pair round's
+    # projection (7 value + 9 index blocks, both int64 here): 100KB blocks
+    # -> 1.6MB per op
     small = ct.Spec(work_dir=str(tmp_path), allowed_mem="2MB", reserved_mem=0)
-    n = 250_000
+    n = 375_000
     an = np.random.default_rng(1).integers(0, 50, n).astype(np.int64)
-    a = ct.from_array(an, chunks=(15_625,), spec=small)  # 16 chunks, heavy ties
+    a = ct.from_array(an, chunks=(12_500,), spec=small)  # 30 chunks, heavy ties
     got = np.asarray(xp.argsort(a).compute(executor=JaxExecutor()))
     np.testing.assert_array_equal(got, np.argsort(an, kind="stable"))
+
+
+def test_argsort_one_op_per_round(spec):
+    """Each argsort network round is ONE multi-output op (merge runs once),
+    not a values op plus an indices op over the same merge."""
+    an = np.random.default_rng(7).random(64)
+    a = ct.from_array(an, chunks=(8,), spec=spec)  # 8 chunks -> 1+6 rounds
+    arg = xp.argsort(a)
+    dag = arg.plan.dag
+    pair_ops = [
+        n for n, d in dag.nodes(data=True)
+        if d.get("type") == "op" and "pair" in d.get("op_name", "")
+    ]
+    # local pair sort + log2(8)*(log2(8)+1)/2 = 6 merge rounds
+    assert len(pair_ops) == 7
+    # every pair op feeds exactly two array nodes (values + indices)
+    for op_node in pair_ops:
+        outs = list(dag.successors(op_node))
+        assert len(outs) == 2
+        pop = dag.nodes[op_node]["primitive_op"]
+        assert pop.target_arrays is not None and len(pop.target_arrays) == 2
+    np.testing.assert_array_equal(
+        np.asarray(arg.compute()), np.argsort(an, kind="stable")
+    )
+
+
+def test_multioutput_op_on_distributed_executor(spec):
+    """Multi-output ops write all targets on the per-task executor fabric."""
+    from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+
+    an = np.random.default_rng(8).integers(0, 9, 48)
+    a = ct.from_array(an, chunks=(6,), spec=spec)
+    got = xp.argsort(a).compute(executor=DistributedDagExecutor(n_workers=2))
+    np.testing.assert_array_equal(np.asarray(got), np.argsort(an, kind="stable"))
+
+
+def test_multioutput_resume_checks_all_outputs(spec):
+    """Resume skips a multi-output op only when EVERY output is complete."""
+    import shutil
+
+    from cubed_tpu.core.ops import general_blockwise
+    from cubed_tpu.runtime.executors.python import PythonDagExecutor
+
+    an = np.arange(12, dtype=np.float64)
+    a = ct.from_array(an, chunks=(4,), spec=spec)
+
+    def two(chunk):
+        return chunk + 1.0, (chunk * 2.0).astype(np.float64)
+
+    def block_function(out_key):
+        return ((a.name, *out_key[1:]),)
+
+    p, d = general_blockwise(
+        two, block_function, a,
+        shape=a.shape, dtype=[a.dtype, np.dtype(np.float64)],
+        chunks=a.chunks, op_name="two_out",
+    )
+    ex = PythonDagExecutor()
+    np.testing.assert_array_equal(np.asarray(p.compute(executor=ex)), an + 1.0)
+    np.testing.assert_array_equal(np.asarray(d.compute(executor=ex)), an * 2.0)
+    # wipe only the SECONDARY output's store: the op must re-run under
+    # resume=True (primary alone being complete is not enough)
+    shutil.rmtree(str(d.zarray_maybe_lazy.store))
+    np.testing.assert_array_equal(
+        np.asarray(d.compute(executor=ex, resume=True)), an * 2.0
+    )
 
 
 def test_multichunk_sort_matches_numpy(spec):
