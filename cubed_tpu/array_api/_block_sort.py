@@ -36,6 +36,24 @@ Each argsort round is ONE multi-output blockwise op emitting (values,
 indices) from a single pair-merge (``general_blockwise`` with a list
 dtype), so every executor — oracle, distributed, JAX — runs the
 concat+lexsort once per round.
+
+Cost profile (stated, not hidden): the network runs ``1 +
+log2(m)*(log2(m)+1)/2`` rounds for ``m`` chunk columns, and EVERY round
+touches all ``n`` elements. On the fused JAX executor the intermediate
+arrays stay HBM-resident, so the multiplier is compute-only; on
+storage-backed paths (oracle, distributed, ``fuse_plan=False``, or a
+spilling plan) each round is a full read+write pass — **O(n·log²m) chunk
+IO versus a sample-sort's O(n)**. Obliviousness is what buys the static
+plan (see above), and ``m`` is the only free variable — so ``auto``
+routing RESIZES the axis chunks to the largest pair-merge that fits
+``allowed_mem`` before building the network (:func:`_coarsen_for_network`):
+rounds drop quadratically in the log, e.g. m 64→4 is 22 rounds → 4, and
+chunks LARGER than the feasible merge shrink to it (otherwise the pair op
+would fail the plan-time bound outright). A
+splitter-based sample-sort for the storage-backed path alone would trade
+the remaining log²m for data-dependent bucket sizes (an eager mid-plan
+compute); measured IO on the coarsened network hasn't justified that
+yet — revisit if a spill-heavy workload shows up.
 """
 
 from __future__ import annotations
@@ -45,7 +63,11 @@ import math
 import numpy as np
 
 from ..backend_array_api import nxp
-from ..core.ops import _offsets_array_for, general_blockwise
+from ..core.ops import (
+    _offsets_array_for,
+    block_index_from_offset,
+    general_blockwise,
+)
 
 __all__ = ["block_sort", "block_argsort"]
 
@@ -55,6 +77,57 @@ def _axis_fill(dtype: np.dtype):
     if dtype.kind == "f":
         return np.nan
     return np.iinfo(dtype).max
+
+
+def _max_network_chunk(x, axis: int, with_idx: bool) -> int:
+    """Largest equal axis-chunk size whose pair-merge op fits allowed_mem.
+
+    Mirrors the pair round's plan-time projection (see ``_round_ops``):
+    values-only — 2 input + 2 output + 3 temp value blocks (7·bv);
+    argsort — 7 value + 9 int64 index blocks. A small slack covers the
+    offsets array and rounding."""
+    lane = 1
+    for d in range(x.ndim):
+        if d != axis:
+            lane *= x.chunksize[d]
+    per_elem = 7 * np.dtype(x.dtype).itemsize + (9 * 8 if with_idx else 0)
+    budget = x.spec.allowed_mem - x.spec.reserved_mem - 65536
+    return max(1, budget // (lane * per_elem))
+
+
+def _coarsen_for_network(x, axis: int, with_idx: bool):
+    """Resize the sort axis chunks to the largest merge that fits before
+    building the network.
+
+    Coarsening: rounds scale as log2(m)*(log2(m)+1)/2, and on
+    storage-backed executors every round is a full O(n) pass, so shrinking
+    ``m`` saves quadratically in the log (the module docstring's IO
+    multiplier). Skipped when the current chunks are already within 2x of
+    the best or the padded chunk count wouldn't drop.
+
+    Shrinking: a chunk LARGER than the feasible merge would fail the pair
+    op's plan-time bound outright, so it rechunks DOWN (mandatory, not a
+    heuristic) — to ``ceil(c/k)`` with ``k = ceil(c/c_max)`` rather than
+    ``c_max`` itself, so every target chunk is covered by ONE source chunk
+    and the rechunk's own copy tasks stay within the bound (a misaligned
+    target makes each write straddle two source reads)."""
+    c = x.chunksize[axis]
+    c_max = _max_network_chunk(x, axis, with_idx)
+    if c <= c_max < 2 * c:
+        return x
+    if c_max > c:
+        n = x.shape[axis]
+        m2_now = 1 << max(0, math.ceil(math.log2(max(1, -(-n // c)))))
+        m2_new = 1 << max(0, math.ceil(math.log2(max(1, -(-n // c_max)))))
+        if m2_new >= m2_now:
+            return x
+        c_new = c_max
+    else:
+        c_new = -(-c // -(-c // c_max))  # aligned split of the source chunk
+    target = tuple(
+        c_new if d == axis else x.chunksize[d] for d in range(x.ndim)
+    )
+    return x.rechunk(target)
 
 
 def _pad_and_equalize(x, axis: int):
@@ -86,14 +159,6 @@ def _pad_and_equalize(x, axis: int):
         )
         x = x.rechunk(target)
     return x, c, m2, n
-
-
-def _block_index_expr(off, axis: int, numblocks):
-    """The sort-axis block index from a (traced or concrete) linear offset."""
-    stride = 1
-    for nb in numblocks[axis + 1:]:
-        stride *= nb
-    return (off.ravel()[0] // stride) % numblocks[axis]
 
 
 def _pair_order(vals, idxs, axis: int):
@@ -161,7 +226,7 @@ def _round_ops(val, idx, *, axis, size, stride, local=False):
             order = _pair_order(mv, mi, axis)
             merged = nxp.take_along_axis(mv, order, axis=axis)
             ii = nxp.take_along_axis(mi, order, axis=axis)
-        bi = _block_index_expr(off, axis, numblocks)
+        bi = block_index_from_offset(off, axis, numblocks)
         ascending = (bi & size) == 0
         low_pos = (bi & stride) == 0
         take_low = ascending == low_pos
@@ -256,7 +321,7 @@ def _iota_along(x, axis: int):
         return ((x_name, *coords), (o_name, *coords))
 
     def _iota_block(chunk, offset):
-        bi = _block_index_expr(offset, axis, numblocks)
+        bi = block_index_from_offset(offset, axis, numblocks)
         local = nxp.arange(chunk.shape[axis], dtype=np.int64) + bi * c
         shape = tuple(
             chunk.shape[axis] if d == axis else 1 for d in range(chunk.ndim)
@@ -303,15 +368,19 @@ def _slice_back(arr, axis: int, n: int):
     return arr[sel]
 
 
-def block_sort(x, axis: int):
+def block_sort(x, axis: int, coarsen: bool = True):
     """Ascending multi-chunk sort along ``axis`` (memory-bounded)."""
+    if coarsen:
+        x = _coarsen_for_network(x, axis, with_idx=False)
     padded, c, m2, n = _pad_and_equalize(x, axis)
     val, _ = _network(padded, None, axis)
     return _slice_back(val, axis, n)
 
 
-def block_argsort(x, axis: int):
+def block_argsort(x, axis: int, coarsen: bool = True):
     """Ascending stable multi-chunk argsort along ``axis`` (int64)."""
+    if coarsen:
+        x = _coarsen_for_network(x, axis, with_idx=True)
     padded, c, m2, n = _pad_and_equalize(x, axis)
     idx0 = _iota_along(padded, axis)
     _, idx = _network(padded, idx0, axis)

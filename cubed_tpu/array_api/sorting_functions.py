@@ -44,15 +44,21 @@ def _use_network(x, axis: int, out_itemsize: int | None = None) -> bool:
     argsort) — so ``auto`` never routes to a plan the planner then
     rejects.
 
+    When the network IS chosen under ``auto``, the builder first coarsens
+    the axis chunks to the largest pair-merge that fits ``allowed_mem``
+    (``_block_sort._coarsen_for_network``): the network runs
+    O(log2(m)^2) full passes over the data — O(n·log²m) chunk IO on
+    storage-backed executors — so fewer, larger chunks are strictly
+    better until the merge hits the memory bound.
+
     ``CUBED_TPU_SORT_NETWORK`` overrides: ``force`` always routes
-    multi-chunk axes through the network (tests pin its coverage with
-    small arrays), ``off`` restores the pre-network single-chunk-only
-    behavior, default ``auto`` applies the memory heuristic."""
+    multi-chunk axes through the network without coarsening (tests pin
+    its coverage with small arrays), ``off`` restores the pre-network
+    single-chunk-only behavior, default ``auto`` applies the memory
+    heuristic."""
     if x.numblocks[axis] <= 1 or x.shape[axis] <= 1:
         return False
-    import os
-
-    mode = os.environ.get("CUBED_TPU_SORT_NETWORK", "auto")
+    mode = _network_mode()
     if mode == "force":
         return True
     if mode == "off":
@@ -68,6 +74,12 @@ def _use_network(x, axis: int, out_itemsize: int | None = None) -> bool:
         2 * in_bytes + 2 * out_bytes,  # the sort/argsort kernel itself
     )
     return projected > x.spec.allowed_mem
+
+
+def _network_mode() -> str:
+    import os
+
+    return os.environ.get("CUBED_TPU_SORT_NETWORK", "auto")
 
 
 def _single_chunk_along(x, axis: int):
@@ -87,7 +99,7 @@ def sort(x, /, *, axis=-1, descending=False, stable=True):
     if _use_network(x, axis):
         from ._block_sort import block_sort
 
-        out = block_sort(x, axis)
+        out = block_sort(x, axis, coarsen=_network_mode() == "auto")
         if descending:
             from .manipulation_functions import flip
 
@@ -116,14 +128,15 @@ def argsort(x, /, *, axis=-1, descending=False, stable=True):
         from ._block_sort import block_argsort
         from ..core.ops import elemwise
 
+        coarsen = _network_mode() == "auto"
         if not descending:
-            return block_argsort(x, axis)
+            return block_argsort(x, axis, coarsen=coarsen)
         # stable-descending identity (see the numpy branch below), applied
         # globally: argsort_desc(x) = flip(m-1 - argsort_asc(flip(x)))
         from .manipulation_functions import flip
 
         m = x.shape[axis]
-        idx_r = block_argsort(flip(x, axis=axis), axis)
+        idx_r = block_argsort(flip(x, axis=axis), axis, coarsen=coarsen)
         mapped = elemwise(
             lambda i: (m - 1 - i).astype(np.int64), idx_r,
             dtype=np.dtype(np.int64),

@@ -2,8 +2,11 @@
 
 ``mean``/``var``/``std`` use dict-of-arrays (pytree) intermediates instead of
 the reference's Zarr structured dtypes — jax has no structured arrays, and
-pytrees jit cleanly. The write path stores them as structured Zarr arrays, so
-the storage format matches the reference's design.
+pytrees jit cleanly. The tree machinery stores each field as a PLAIN array
+written by multi-output ops (core/ops.py reduction + partial_reduce_multi),
+so intermediates shard under a device mesh like any other array; the
+structured np.dtype passed as ``intermediate_dtype`` only declares the field
+names/dtypes.
 Reference parity: cubed/array_api/statistical_functions.py (156 LoC).
 """
 
@@ -116,9 +119,9 @@ def _prod_with_dtype(a, axis=None, keepdims=False, dtype=None):
 
 # -- mean / var / std (pytree intermediates) --------------------------------
 
-#: structured storage dtype for the {n, total} intermediate; the design note in
-#: the reference explains why a single structured array is used rather than
-#: multiple outputs (cubed/array_api/statistical_functions.py:33-36)
+#: field declaration for the {n, total} intermediate (each field rides as a
+#: plain array through the multi-output tree; the reference instead stores a
+#: single structured array, cubed/array_api/statistical_functions.py:33-36)
 def _mean_intermediate_dtype(x_dtype):
     return np.dtype([("n", np.int64), ("total", np.float64)])
 

@@ -507,6 +507,18 @@ def _offsets_array_for(x: CoreArray):
     return new_array(name, offsets, x.spec, plan)
 
 
+def block_index_from_offset(off, axis: int, numblocks: tuple):
+    """The ``axis`` block index from a (traced or concrete) linear offset.
+
+    The row-major decode of a VirtualOffsetsArray chunk value; stays a pure
+    device expression so offset-seeded kernels jit/vmap (used by the sort
+    network's merge routing and arg_reduction's index seeding)."""
+    stride = 1
+    for nb in numblocks[axis + 1:]:
+        stride *= nb
+    return (off.ravel()[0] // stride) % numblocks[axis]
+
+
 def _map_blocks_no_args(func, chunks, dtype, spec, **kwargs):
     spec = spec_from_config(spec)
     shape = tuple(sum(c) for c in chunks)
@@ -901,34 +913,65 @@ def reduction(
 
     kw = dict(extra_func_kwargs or {})
 
-    # initial per-block reduction (reduced axes -> size 1)
-    adjust = {i: 1 for i in range(x.ndim) if i in axis}
-    inds = tuple(range(x.ndim))
-    result = blockwise(
-        partial(_initial_reduce, func=func, axis=axis, kw=kw),
-        inds,
-        x,
-        inds,
-        dtype=intermediate_dtype,
-        adjust_chunks=adjust,
-    )
-
-    # combine rounds
     split = split_every or 4
-    while any(result.numblocks[ax] > 1 for ax in axis):
-        result = partial_reduce(
-            result,
-            _StreamingCombine(combine_func, axis, kw),
-            split_every={ax: split for ax in axis},
+    fields = _fields_of(intermediate_dtype)
+    if fields is not None:
+        # pytree intermediates ride as one PLAIN array per field produced by
+        # multi-output ops — no structured-dtype storage anywhere in the
+        # tree, so intermediates shard under a mesh like any other array
+        # (structured arrays can't ride make_array_from_callback). The
+        # reference instead stores a single structured array
+        # (cubed/array_api/statistical_functions.py:33-36).
+        parts = _multi_field_map(
+            x,
+            partial(_initial_reduce, func=func, axis=axis, kw=kw),
+            fields,
+            chunks=tuple(
+                (1,) * x.numblocks[i] if i in axis else c
+                for i, c in enumerate(x.chunks)
+            ),
+            op_name="initial_reduce",
+        )
+        while any(parts[0].numblocks[ax] > 1 for ax in axis):
+            parts = partial_reduce_multi(
+                parts,
+                _StreamingCombineMulti(combine_func, axis, kw, list(fields)),
+                split_every={ax: split for ax in axis},
+                fields=fields,
+            )
+        if aggregate_func is None:
+            raise ValueError(
+                "structured intermediate_dtype requires aggregate_func"
+            )
+        result = _aggregate_fields(parts, aggregate_func, dtype, list(fields))
+    else:
+        # initial per-block reduction (reduced axes -> size 1)
+        adjust = {i: 1 for i in range(x.ndim) if i in axis}
+        inds = tuple(range(x.ndim))
+        result = blockwise(
+            partial(_initial_reduce, func=func, axis=axis, kw=kw),
+            inds,
+            x,
+            inds,
             dtype=intermediate_dtype,
+            adjust_chunks=adjust,
         )
 
-    # aggregate
-    if aggregate_func is not None:
-        result = map_blocks(
-            partial(_apply_aggregate, aggregate_func=aggregate_func),
-            result, dtype=dtype,
-        )
+        # combine rounds
+        while any(result.numblocks[ax] > 1 for ax in axis):
+            result = partial_reduce(
+                result,
+                _StreamingCombine(combine_func, axis, kw),
+                split_every={ax: split for ax in axis},
+                dtype=intermediate_dtype,
+            )
+
+        # aggregate
+        if aggregate_func is not None:
+            result = map_blocks(
+                partial(_apply_aggregate, aggregate_func=aggregate_func),
+                result, dtype=dtype,
+            )
 
     if not keepdims:
         from ..array_api.manipulation_functions import _squeeze_axes
@@ -1047,6 +1090,156 @@ def partial_reduce(
     )
 
 
+def _fields_of(intermediate_dtype) -> Optional[dict]:
+    """{field name -> plain dtype} for a structured dtype, else None."""
+    if intermediate_dtype is None:
+        return None
+    dt = np.dtype(intermediate_dtype)
+    if dt.fields is None:
+        return None
+    return {name: dt.fields[name][0] for name in dt.names}
+
+
+def _multi_field_map(
+    x: CoreArray,
+    kernel: Callable,
+    fields: dict,
+    chunks,
+    op_name: str,
+) -> tuple:
+    """One multi-output op mapping ``kernel`` (returning {field: chunk})
+    1:1 over x's blocks; each field becomes a PLAIN array output."""
+    names = list(fields)
+    x_name = x.name
+    shape = tuple(sum(c) for c in chunks)
+
+    def block_function(out_key):
+        return ((x_name, *out_key[1:]),)
+
+    def field_kernel(chunk):
+        d = kernel(chunk)
+        return tuple(d[k] for k in names)
+
+    field_kernel.__name__ = getattr(kernel, "__name__", op_name)
+
+    return general_blockwise(
+        field_kernel,
+        block_function,
+        x,
+        shape=[shape] * len(names),
+        dtype=[fields[k] for k in names],
+        chunks=chunks,
+        op_name=op_name,
+    )
+
+
+def partial_reduce_multi(
+    parts: Sequence[CoreArray],
+    combiner: Callable,
+    split_every: dict,
+    fields: dict,
+) -> tuple:
+    """One tree level over pytree intermediates held as N field arrays:
+    one multi-output op streams N zipped block groups -> N outputs.
+
+    The multi-field analogue of :func:`partial_reduce` (same grouping, same
+    bounded-memory streaming contract)."""
+    x0 = parts[0]
+    chunks = tuple(
+        (1,) * math.ceil(len(c) / split_every[i]) if i in split_every else c
+        for i, c in enumerate(x0.chunks)
+    )
+    shape = tuple(sum(c) for c in chunks)
+    in_numblocks = x0.numblocks
+    part_names = [p.name for p in parts]
+
+    def block_function(out_key):
+        out_coords = out_key[1:]
+        ranges = []
+        for i, bi in enumerate(out_coords):
+            if i in split_every:
+                k = split_every[i]
+                start = bi * k
+                stop = min(start + k, in_numblocks[i])
+                ranges.append(range(start, stop))
+            else:
+                ranges.append(range(bi, bi + 1))
+        idxs = list(itertools.product(*ranges))
+        return tuple(
+            iter([(pn, *idx) for idx in idxs]) for pn in part_names
+        )
+
+    # accumulator + concat buffer per field, streamed one group block at a
+    # time (same model as partial_reduce)
+    extra_projected_mem = 2 * sum(p.chunkmem for p in parts)
+    return general_blockwise(
+        combiner,
+        block_function,
+        *parts,
+        shape=[shape] * len(parts),
+        dtype=[fields[k] for k in fields],
+        chunks=chunks,
+        extra_projected_mem=extra_projected_mem,
+        num_input_blocks=(max(split_every.values()),) * len(parts),
+        fusable=False,
+        op_name="partial_reduce",
+    )
+
+
+class _StreamingCombineMulti:
+    """Multi-field analogue of :class:`_StreamingCombine`: streams N zipped
+    block iterators, reassembling the {field: chunk} pytree per step for the
+    dict-based combine, and returns a tuple in field order.
+
+    ``combine_region`` lets the TPU executor combine whole contiguous
+    regions (one per field) in a single jitted call."""
+
+    __name__ = "partial_reduce"
+
+    def __init__(self, combine_func, axis: tuple, kw: dict, names: list):
+        self.combine_func = combine_func
+        self.axis = axis
+        self.kw = kw
+        self.names = names
+
+    def __call__(self, *iters):
+        acc = None
+        axis = self.axis
+        for vals in zip(*iters):
+            d = dict(zip(self.names, vals))
+            if acc is None:
+                acc = d
+            else:
+                merged = _concat_pytree(
+                    acc, d, axis[0] if len(axis) == 1 else axis
+                )
+                acc = self.combine_func(
+                    merged, axis=axis, keepdims=True, **self.kw
+                )
+        return tuple(acc[k] for k in self.names)
+
+    def combine_region(self, *regions):
+        d = dict(zip(self.names, regions))
+        out = self.combine_func(d, axis=self.axis, keepdims=True, **self.kw)
+        return tuple(out[k] for k in self.names)
+
+
+def _aggregate_fields(
+    parts: Sequence[CoreArray], aggregate_func: Callable, dtype, names: list
+) -> CoreArray:
+    """Final aggregate over N field arrays -> one plain array (1:1 blocks)."""
+    inds = tuple(range(parts[0].ndim))
+
+    def agg_kernel(*chunks):
+        return aggregate_func(dict(zip(names, chunks)))
+
+    agg_kernel.__name__ = getattr(aggregate_func, "__name__", "aggregate")
+    args = []
+    for p in parts:
+        args.extend([p, inds])
+    return blockwise(agg_kernel, inds, *args, dtype=dtype)
+
+
 def _merged_chunklist(chunks_1d: tuple[int, ...], k: int) -> tuple[int, ...]:
     out = []
     for i in range(0, len(chunks_1d), k):
@@ -1057,72 +1250,105 @@ def _merged_chunklist(chunks_1d: tuple[int, ...], k: int) -> tuple[int, ...]:
 def arg_reduction(
     x: CoreArray, func: Callable, cmp_func: Callable, axis=None, dtype=np.int64
 ) -> CoreArray:
-    """argmin/argmax via a structured {i, v} tree reduction with absolute
-    indices seeded from block_id. Reference cubed/core/ops.py:1093-1153."""
+    """argmin/argmax via an {i, v} tree reduction with absolute indices.
+
+    The intermediates ride as TWO plain arrays (int64 indices + values)
+    produced by multi-output ops, and the per-block seeding reads the block
+    index from the traced linear offset — the whole tree jits/vmaps (the
+    reference seeds from a host block_id over a structured array,
+    cubed/core/ops.py:1093-1153)."""
     if axis is None:
         raise ValueError("arg_reduction requires an axis (flatten first)")
     axis = int(axis) % x.ndim
 
-    offsets_per_block = [c for c in x.chunks[axis]]
-    starts = np.cumsum([0] + offsets_per_block[:-1])
+    starts = np.cumsum([0] + list(x.chunks[axis][:-1]), dtype=np.int64)
     numblocks = x.numblocks
+    offsets = _offsets_array_for(x)
+    x_name, o_name = x.name, offsets.name
+    out_chunks = tuple(
+        (1,) * numblocks[i] if i == axis else x.chunks[i]
+        for i in range(x.ndim)
+    )
+    shape = tuple(sum(c) for c in out_chunks)
 
-    def initial(chunk, block_id=None):
+    def block_function(out_key):
+        coords = out_key[1:]
+        return ((x_name, *coords), (o_name, *coords))
+
+    def arg_initial(chunk, offset):
+        # axis block index from the (possibly traced) linear offset;
+        # `starts` is a tiny per-grid constant, gathered on device
+        bi = block_index_from_offset(offset, axis, numblocks)
+        start = nxp.take(nxp.asarray(starts), bi)
         i = func(chunk, axis=axis, keepdims=True)  # local argmin/argmax
         v = cmp_func(chunk, axis=axis, keepdims=True)
-        abs_i = i + int(starts[block_id[axis]])
-        return {"i": nxp.asarray(abs_i, dtype=np.int64), "v": v}
+        return nxp.asarray(i, dtype=np.int64) + start, v
 
-    class _ArgCombine:
-        __name__ = "arg_combine"
+    arg_initial.traced_offsets = True
+    arg_initial.__name__ = "arg_initial"
 
-        def __init__(self, ax):
-            self.ax = ax
-
-        def combine_region(self, region):
-            ax = self.ax
-            local = func(region["v"], axis=ax, keepdims=True)
-            return {
-                "i": nxp.take_along_axis(region["i"], local, axis=ax),
-                "v": cmp_func(region["v"], axis=ax, keepdims=True),
-            }
-
-        def __call__(self, chunks_iter):
-            acc = None
-            ax = self.ax
-            for chunk in chunks_iter:
-                if acc is None:
-                    acc = chunk
-                else:
-                    merged = {
-                        "i": nxp.concatenate([acc["i"], chunk["i"]], axis=ax),
-                        "v": nxp.concatenate([acc["v"], chunk["v"]], axis=ax),
-                    }
-                    acc = self.combine_region(merged)
-            return acc
-
-    intermediate_dtype = np.dtype([("i", np.int64), ("v", x.dtype)])
-
-    result = map_blocks(
-        initial,
+    fields = {"i": np.dtype(np.int64), "v": np.dtype(x.dtype)}
+    parts = general_blockwise(
+        arg_initial,
+        block_function,
         x,
-        dtype=intermediate_dtype,
-        chunks=tuple(
-            (1,) * numblocks[i] if i == axis else x.chunks[i] for i in range(x.ndim)
-        ),
+        offsets,
+        shape=[shape, shape],
+        dtype=[fields["i"], fields["v"]],
+        chunks=out_chunks,
+        op_name="arg_initial",
     )
     split = 4
-    while result.numblocks[axis] > 1:
-        result = partial_reduce(
-            result,
-            _ArgCombine(axis),
+    while parts[0].numblocks[axis] > 1:
+        parts = partial_reduce_multi(
+            parts,
+            _ArgCombineMulti(axis, func, cmp_func),
             split_every={axis: split},
-            dtype=intermediate_dtype,
+            fields=fields,
         )
-    result = map_blocks(lambda c: nxp.asarray(c["i"], dtype=dtype), result, dtype=dtype)
+    result = parts[0]
+    if result.dtype != np.dtype(dtype):
+        result = map_blocks(
+            lambda c: nxp.asarray(c, dtype=dtype), result, dtype=dtype
+        )
     from ..array_api.manipulation_functions import _squeeze_axes
 
     return _squeeze_axes(result, (axis,))
+
+
+class _ArgCombineMulti:
+    """Streamed {i, v} combine over two zipped field iterators."""
+
+    __name__ = "arg_combine"
+
+    def __init__(self, ax: int, func: Callable, cmp_func: Callable):
+        self.ax = ax
+        self.func = func
+        self.cmp_func = cmp_func
+
+    def _merge(self, i, v):
+        ax = self.ax
+        local = self.func(v, axis=ax, keepdims=True)
+        return (
+            nxp.take_along_axis(i, local, axis=ax),
+            self.cmp_func(v, axis=ax, keepdims=True),
+        )
+
+    def combine_region(self, i_region, v_region):
+        return self._merge(i_region, v_region)
+
+    def __call__(self, i_iter, v_iter):
+        acc = None
+        ax = self.ax
+        for i, v in zip(i_iter, v_iter):
+            if acc is None:
+                acc = (i, v)
+            else:
+                acc = self._merge(
+                    nxp.concatenate([acc[0], i], axis=ax),
+                    nxp.concatenate([acc[1], v], axis=ax),
+                )
+        return acc
 
 
 # ---------------------------------------------------------------------------

@@ -1441,6 +1441,22 @@ class JaxExecutor(DagExecutor):
                         result = jitted_region(region)
                 else:
                     structure = (iter(keys),)
+            elif (
+                jitted_region is not None
+                and len(structure) > 1
+                and all(isinstance(e, Iterator) for e in structure)
+            ):
+                # multi-field combine (pytree intermediates as N arrays):
+                # one contiguous region per field, combined in one call
+                keyss = [list(e) for e in structure]
+                regions = [
+                    self._resolve_region(keys, spec, resident)
+                    for keys in keyss
+                ]
+                if all(r is not None for r in regions):
+                    result = jitted_region(*regions)
+                else:
+                    structure = tuple(iter(keys) for keys in keyss)
             if result is None:
                 args = [
                     self._resolve(entry, spec, resident, traced_offsets)

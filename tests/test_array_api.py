@@ -180,6 +180,47 @@ def test_argmax_argmin(spec):
     assert int(xp.argmax(a).compute()) == an.argmax()
 
 
+def test_mean_var_intermediates_are_multioutput_plain_arrays(spec):
+    """mean/var pytree intermediates ride as N plain arrays from multi-output
+    ops — no structured-dtype array anywhere in the plan (mesh-shardable)."""
+    an = np.random.default_rng(1).random((16, 12))
+    a = ct.from_array(an, chunks=(4, 3), spec=spec)
+    for expr in (xp.mean(a, axis=0), xp.var(a)):
+        dag = expr.plan.dag
+        for n, d in dag.nodes(data=True):
+            if d.get("type") == "array" and d.get("target") is not None:
+                dt = np.dtype(d["target"].dtype)
+                assert dt.fields is None, f"structured array node {n}: {dt}"
+        multi_ops = [
+            n for n, d in dag.nodes(data=True)
+            if d.get("type") == "op"
+            and d.get("primitive_op") is not None
+            and d["primitive_op"].target_arrays is not None
+        ]
+        assert multi_ops, "expected multi-output ops in the reduction tree"
+    assert_eq(xp.mean(a, axis=0).compute(), an.mean(axis=0))
+
+
+def test_arg_reduction_traces(spec):
+    """arg_reduction's initial op reads the block index from the traced
+    offset (no host_block_id), so the whole tree joins fused segments."""
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    an = np.random.default_rng(3).random((9, 14))
+    a = ct.from_array(an, chunks=(3, 5), spec=spec)
+    expr = xp.argmax(a, axis=1)
+    dag = expr.plan.dag
+    for n, d in dag.nodes(data=True):
+        if d.get("type") == "op" and d.get("primitive_op") is not None:
+            f = d["primitive_op"].pipeline.config.function if hasattr(
+                d["primitive_op"].pipeline.config, "function"
+            ) else None
+            assert not getattr(f, "host_block_id", False), n
+    ex = JaxExecutor()
+    assert_eq(expr.compute(executor=ex), an.argmax(axis=1))
+    assert ex.stats.get("segments_traced", 0) >= 1
+
+
 def test_all_any(spec):
     an = np.array([[True, False], [True, True]])
     a = ct.from_array(an, chunks=(1, 2), spec=spec)

@@ -117,6 +117,54 @@ def test_multioutput_resume_checks_all_outputs(spec):
     )
 
 
+def test_auto_network_coarsens_large_m(tmp_path):
+    """auto routing rechunks the sort axis to the largest fitting merge
+    before building the network: rounds scale as log2(m)*(log2(m)+1)/2 and
+    every round is a full pass (O(n log^2 m) IO on non-fused executors), so
+    64 tiny chunks must NOT produce a 22-round network when allowed_mem
+    admits far larger merges."""
+    # 512KB axis in 64 x 8KB chunks; 2MB allowed_mem fits a c=~37k merge
+    # (7 blocks x 8B), so the axis coarsens to few chunks
+    small = ct.Spec(work_dir=str(tmp_path), allowed_mem="2MB", reserved_mem=0)
+    n = 65_536
+    an = np.random.default_rng(11).random(n)
+    a = ct.from_array(an, chunks=(1_024,), spec=small)
+    # single-chunk slab (4x 512KB = 2MB + int64 out) exceeds allowed: network
+    srt = xp.sort(a)
+    rounds = [
+        d["op_name"]
+        for _, d in srt.plan.dag.nodes(data=True)
+        if d.get("type") == "op" and "bitonic" in d.get("op_name", "")
+    ]
+    # uncoarsened m2=64 would give 1+21 bitonic ops; coarsened m2=2 gives 2
+    assert len(rounds) <= 4, rounds
+    np.testing.assert_array_equal(np.asarray(srt.compute()), np.sort(an))
+    # argsort coarsens too (int64 outputs priced into the merge bound)
+    arg = xp.argsort(a)
+    arounds = [
+        d["op_name"]
+        for _, d in arg.plan.dag.nodes(data=True)
+        if d.get("type") == "op" and "bitonic" in d.get("op_name", "")
+    ]
+    assert len(arounds) <= 7, arounds
+    np.testing.assert_array_equal(
+        np.asarray(arg.compute()), np.argsort(an, kind="stable")
+    )
+
+
+def test_auto_network_shrinks_oversized_chunks(tmp_path):
+    """Chunks larger than the feasible pair-merge rechunk DOWN to it —
+    auto routing must not build a network the planner then rejects."""
+    small = ct.Spec(work_dir=str(tmp_path), allowed_mem="2MB", reserved_mem=0)
+    n = 200_000
+    an = np.random.default_rng(13).random(n)
+    a = ct.from_array(an, chunks=(50_000,), spec=small)  # merge 2x50k f64 > 2MB
+    np.testing.assert_array_equal(np.asarray(xp.sort(a).compute()), np.sort(an))
+    np.testing.assert_array_equal(
+        np.asarray(xp.argsort(a).compute()), np.argsort(an, kind="stable")
+    )
+
+
 def test_multichunk_sort_matches_numpy(spec):
     rng = np.random.default_rng(2)
     an = rng.random((13, 17))
