@@ -916,6 +916,10 @@ def reduction(
     split = split_every or 4
     fields = _fields_of(intermediate_dtype)
     if fields is not None:
+        if aggregate_func is None:
+            raise ValueError(
+                "structured intermediate_dtype requires aggregate_func"
+            )
         # pytree intermediates ride as one PLAIN array per field produced by
         # multi-output ops — no structured-dtype storage anywhere in the
         # tree, so intermediates shard under a mesh like any other array
@@ -938,10 +942,6 @@ def reduction(
                 _StreamingCombineMulti(combine_func, axis, kw, list(fields)),
                 split_every={ax: split for ax in axis},
                 fields=fields,
-            )
-        if aggregate_func is None:
-            raise ValueError(
-                "structured intermediate_dtype requires aggregate_func"
             )
         result = _aggregate_fields(parts, aggregate_func, dtype, list(fields))
     else:
@@ -1298,11 +1298,21 @@ def arg_reduction(
         chunks=out_chunks,
         op_name="arg_initial",
     )
+    def arg_combine(d, axis=None, keepdims=True):
+        ax = axis[0] if isinstance(axis, tuple) else axis
+        local = func(d["v"], axis=ax, keepdims=True)
+        return {
+            "i": nxp.take_along_axis(d["i"], local, axis=ax),
+            "v": cmp_func(d["v"], axis=ax, keepdims=True),
+        }
+
+    arg_combine.__name__ = "arg_combine"
+
     split = 4
     while parts[0].numblocks[axis] > 1:
         parts = partial_reduce_multi(
             parts,
-            _ArgCombineMulti(axis, func, cmp_func),
+            _StreamingCombineMulti(arg_combine, (axis,), {}, list(fields)),
             split_every={axis: split},
             fields=fields,
         )
@@ -1316,39 +1326,6 @@ def arg_reduction(
     return _squeeze_axes(result, (axis,))
 
 
-class _ArgCombineMulti:
-    """Streamed {i, v} combine over two zipped field iterators."""
-
-    __name__ = "arg_combine"
-
-    def __init__(self, ax: int, func: Callable, cmp_func: Callable):
-        self.ax = ax
-        self.func = func
-        self.cmp_func = cmp_func
-
-    def _merge(self, i, v):
-        ax = self.ax
-        local = self.func(v, axis=ax, keepdims=True)
-        return (
-            nxp.take_along_axis(i, local, axis=ax),
-            self.cmp_func(v, axis=ax, keepdims=True),
-        )
-
-    def combine_region(self, i_region, v_region):
-        return self._merge(i_region, v_region)
-
-    def __call__(self, i_iter, v_iter):
-        acc = None
-        ax = self.ax
-        for i, v in zip(i_iter, v_iter):
-            if acc is None:
-                acc = (i, v)
-            else:
-                acc = self._merge(
-                    nxp.concatenate([acc[0], i], axis=ax),
-                    nxp.concatenate([acc[1], v], axis=ax),
-                )
-        return acc
 
 
 # ---------------------------------------------------------------------------

@@ -1429,32 +1429,22 @@ class JaxExecutor(DagExecutor):
             result = None
             if (
                 jitted_region is not None
-                and len(structure) == 1
-                and isinstance(structure[0], Iterator)
-            ):
-                keys = list(structure[0])
-                region = self._resolve_region(keys, spec, resident)
-                if region is not None:
-                    if pallas_region is not None:
-                        result = pallas_region(region)
-                    if result is None:
-                        result = jitted_region(region)
-                else:
-                    structure = (iter(keys),)
-            elif (
-                jitted_region is not None
-                and len(structure) > 1
+                and structure
                 and all(isinstance(e, Iterator) for e in structure)
             ):
-                # multi-field combine (pytree intermediates as N arrays):
-                # one contiguous region per field, combined in one call
+                # one contiguous region per argument (N=1 for plain
+                # reductions; one per field for pytree intermediates held
+                # as N arrays), combined in a single jitted call
                 keyss = [list(e) for e in structure]
                 regions = [
                     self._resolve_region(keys, spec, resident)
                     for keys in keyss
                 ]
                 if all(r is not None for r in regions):
-                    result = jitted_region(*regions)
+                    if pallas_region is not None and len(regions) == 1:
+                        result = pallas_region(regions[0])
+                    if result is None:
+                        result = jitted_region(*regions)
                 else:
                     structure = tuple(iter(keys) for keys in keyss)
             if result is None:
