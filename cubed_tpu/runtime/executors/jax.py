@@ -219,6 +219,33 @@ class JaxExecutor(DagExecutor):
             self._placement = factorized_mesh(self.mesh)
         return self._placement
 
+    def _keep_sharding_constraint(self, value, target):
+        """Pin a traced segment output to the executor's mesh sharding.
+
+        Batched kernels gather/stack/reassemble chunks inside the trace,
+        after which XLA may propagate a REPLICATED layout to the output.
+        Replication is only a memory detail single-process, but under
+        multi-controller SPMD the per-host flush derives chunk ownership
+        from the output's sharding — a replicated output degrades to
+        "host 0 writes everything". Constraining the kept outputs keeps
+        ownership (and the write path of docs/multihost.md) split across
+        hosts."""
+        jax = _jax()
+        if self.mesh is None or isinstance(value, dict) or target is None:
+            return value
+        shape = tuple(getattr(target, "shape", ()) or ())
+        if not shape or tuple(value.shape) != shape:
+            return value
+        cs = (
+            blockdims_from_blockshape(shape, target.chunks)
+            if getattr(target, "chunks", None)
+            else None
+        )
+        sharding = self._sharding_for(shape, cs)
+        if sharding is None:
+            return value
+        return jax.lax.with_sharding_constraint(value, sharding)
+
     def _sharding_for(self, shape: tuple[int, ...], chunkset=None):
         """The chunk-grid-aligned sharding policy (parallel/mesh.py).
 
@@ -865,7 +892,10 @@ class JaxExecutor(DagExecutor):
             finally:
                 self._tracing = False
                 self._prepared_bases = {}
-            return [local[k].value for k in keep_list]
+            return [
+                self._keep_sharding_constraint(local[k].value, keep.get(k))
+                for k in keep_list
+            ]
 
         lowered = jax.jit(seg_fn).lower(in_vals, base_vals)
         try:

@@ -1,0 +1,81 @@
+"""Worker body for the 2-process jax.distributed smoke (test_multihost.py).
+
+Each process initializes multi-controller SPMD over localhost, runs ONE
+framework plan under the mesh-sharded JaxExecutor, and records — by
+instrumenting the Zarr store — exactly which elements of the source it
+read and which elements of the output it wrote. The launching test asserts
+the two processes' masks are disjoint and union to the full array: every
+byte read/written exactly once, by the host whose chips own it
+(docs/multihost.md seams, exercised over a REAL process boundary).
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    coordinator = sys.argv[2]
+    work = sys.argv[3]
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # repo root on sys.path (the test launches this file directly)
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    )
+
+    import jax
+
+    jax.distributed.initialize(coordinator, num_processes=2, process_id=pid)
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8
+
+    import numpy as np
+
+    import cubed_tpu as ct
+    import cubed_tpu.array_api as xp
+    from cubed_tpu.parallel.mesh import make_mesh
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+    from cubed_tpu.storage.store import ZarrV2Array
+
+    shape = (16, 24)
+    src = f"{work}/src.zarr"
+    out = f"{work}/out.zarr"
+
+    read_mask = np.zeros(shape, dtype=np.int32)
+    write_mask = np.zeros(shape, dtype=np.int32)
+
+    orig_get = ZarrV2Array.__getitem__
+    orig_set = ZarrV2Array.__setitem__
+
+    def counting_get(self, sel):
+        if str(self.store) == src and self.shape == shape:
+            read_mask[sel] += 1
+        return orig_get(self, sel)
+
+    def counting_set(self, sel, value):
+        if str(self.store) == out and self.shape == shape:
+            write_mask[sel] += 1
+        return orig_set(self, sel, value)
+
+    ZarrV2Array.__getitem__ = counting_get
+    ZarrV2Array.__setitem__ = counting_set
+
+    mesh = make_mesh(
+        shape=(8,), axis_names=("data",), devices=jax.devices()
+    )
+    spec = ct.Spec(work_dir=f"{work}/p{pid}", allowed_mem="1GB")
+    a = ct.from_zarr(src, spec=spec)
+    ex = JaxExecutor(mesh=mesh)
+    ct.to_zarr(xp.add(xp.multiply(a, 2.0), 1.0), out, executor=ex)
+
+    np.save(f"{work}/read_mask_{pid}.npy", read_mask)
+    np.save(f"{work}/write_mask_{pid}.npy", write_mask)
+    print(f"worker {pid}: read {int(read_mask.sum())} write "
+          f"{int(write_mask.sum())} elements", flush=True)
+
+
+if __name__ == "__main__":
+    main()
