@@ -1,0 +1,141 @@
+"""Stage breakdown of the addsum_scaled CPU-fallback gap (VERDICT r4 #9).
+
+Measures, on the CPU backend with a scrubbed environment (run it via
+``python benchmarks/profile_addsum_scaled.py``; it re-executes itself in a
+tunnel-free subprocess):
+
+  1. framework warm compute of the bench config (16000x16000 f64,
+     2000-chunks, JaxExecutor fallback path),
+  2. a raw-JAX jit of the same math (generation + add + sum),
+  3. the XLA threefry-f64 generation alone,
+  4. numpy's Philox generation alone and the add+sum alone,
+  5. the numpy-backend end-to-end equivalent (the recorded baseline's
+     semantics).
+
+Prints one JSON line per stage; the analysis lives in BENCH_PROFILE.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BODY = r"""
+import json, sys, tempfile, time
+sys.path.insert(0, %(repo)r)
+import numpy as np
+
+SHAPE, CHUNK = (16000, 16000), 2000
+WORK = 2 * SHAPE[0] * SHAPE[1] * 8
+
+
+def emit(stage, secs, note=""):
+    print(json.dumps({
+        "stage": stage, "seconds": round(secs, 3),
+        "gbps": round(WORK / secs / 1e9, 3), "note": note,
+    }), flush=True)
+
+
+# ---- numpy side -----------------------------------------------------------
+t0 = time.perf_counter()
+rng = np.random.default_rng(0)
+an = rng.random(SHAPE)
+bn = rng.random(SHAPE)
+t1 = time.perf_counter()
+emit("numpy_philox_generate_2x2GB", t1 - t0)
+t0 = time.perf_counter()
+val = float(np.sum(np.add(an, bn)))
+t1 = time.perf_counter()
+emit("numpy_add_sum", t1 - t0)
+del an, bn
+
+# ---- jax side -------------------------------------------------------------
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_threefry_partitionable", True)
+
+
+def timed(fn, *args):
+    fn(*args)  # warm (compile)
+    t0 = time.perf_counter()
+    r = fn(*args)
+    jax.block_until_ready(r)
+    return time.perf_counter() - t0
+
+
+def _u(seed, salt):
+    key = jax.random.fold_in(jax.random.key(0), seed * 7919 + salt)
+    return jax.random.uniform(key, SHAPE, dtype=jnp.float64)
+
+
+gen2 = jax.jit(lambda s: (_u(s, 1), _u(s, 2)))
+emit("xla_threefry_f64_generate_2x2GB", timed(gen2, 3))
+
+addsum_only = jax.jit(lambda a, b: jnp.sum(a + b))
+a0, b0 = gen2(5)
+emit("xla_add_sum", timed(addsum_only, a0, b0))
+del a0, b0
+
+raw = jax.jit(lambda s: jnp.sum(_u(s, 1) + _u(s, 2)))
+emit("raw_jax_full", timed(raw, 7))
+
+# ---- framework ------------------------------------------------------------
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+import cubed_tpu.random
+from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="4GB")
+
+
+def build():
+    a = cubed_tpu.random.random(SHAPE, chunks=CHUNK, spec=spec)
+    b = cubed_tpu.random.random(SHAPE, chunks=CHUNK, spec=spec)
+    return xp.sum(xp.add(a, b))
+
+ex = JaxExecutor()
+float(build().compute(executor=ex))  # warm: compile + trace caches
+t0 = time.perf_counter()
+float(build().compute(executor=ex))
+t1 = time.perf_counter()
+emit("framework_warm_compute", t1 - t0)
+
+import cProfile, pstats, io
+pr = cProfile.Profile()
+pr.enable()
+float(build().compute(executor=ex))
+pr.disable()
+s = io.StringIO()
+pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(14)
+print(s.getvalue()[:3000], file=sys.stderr)
+"""
+
+
+def main() -> None:
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_"))
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    t0 = time.time()
+    out = subprocess.run(
+        [sys.executable, "-c", BODY % {"repo": REPO}],
+        env=env, text=True, capture_output=True, timeout=900,
+    )
+    sys.stdout.write(out.stdout)
+    sys.stderr.write(out.stderr[-3500:])
+    print(json.dumps({"stage": "total_wall", "seconds": round(time.time() - t0, 1)}))
+    if out.returncode != 0:
+        sys.exit(out.returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -311,9 +311,14 @@ class _CoordPool:
         return self.coordinator.submit(stats_wrapper, function, task_input, **kwargs)
 
 
+@pytest.mark.slow
 def test_distributed_hung_threads_avoided(tmp_path):
     """Started-task timeouts leave ghost threads; routing counts them so
-    retries land on workers with free capacity and the map completes."""
+    retries land on workers with free capacity and the map completes.
+
+    Slow-marked (~26 s of real timeout waits on one core); the default
+    suite keeps test_distributed_task_timeout_reroutes as the
+    timeout-path coverage."""
     from cubed_tpu.runtime.executors.python_async import map_unordered
 
     path = tmp_path / "counts"
@@ -343,9 +348,13 @@ def test_distributed_hung_threads_avoided(tmp_path):
         ex.close()
 
 
+@pytest.mark.slow
 def test_distributed_hung_worker_evicted(tmp_path):
     """A worker whose started tasks keep timing out is dropped as hung; with
-    no survivors the plan fails loudly instead of spinning."""
+    no survivors the plan fails loudly instead of spinning.
+
+    Slow-marked (~21 s of real timeout waits on one core); default-suite
+    timeout coverage lives in test_distributed_task_timeout_reroutes."""
     from cubed_tpu.runtime.distributed import (
         NoWorkersError,
         TaskTimeoutError,

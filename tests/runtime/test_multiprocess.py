@@ -56,7 +56,11 @@ def test_multiprocess_retries_success(tmp_path):
     check_invocation_counts(str(path), timing_map, n_tasks=2, retries=2)
 
 
+@pytest.mark.slow
 def test_multiprocess_retries_exhausted(tmp_path):
+    # slow-marked: a second full pool spawn (~6 s on one core) for the
+    # negative case; the fresh-process retry path itself stays default via
+    # test_multiprocess_retries_success
     path = tmp_path / "counts"
     path.mkdir()
     timing_map = {0: [-1, -1, -1]}  # more failures than allowed attempts
