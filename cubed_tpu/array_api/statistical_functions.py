@@ -79,9 +79,10 @@ def _sum_with_dtype(a, axis=None, keepdims=False, dtype=None):
     return nxp.sum(a, axis=axis, keepdims=keepdims, dtype=dtype)
 
 
-# semantic tag consumed by the TPU executor: sum-combines over TPU-native
-# dtypes may be routed through the Pallas streaming-reduction kernels
-# (cubed_tpu/kernels/reductions.py) instead of the generic XLA combine
+# semantic tag on the combine (e.g. "sum"): kept as the seam for kernel
+# substitution experiments — the round-3 Pallas streaming-reduction kernels
+# consumed it before being retired on measured evidence (see
+# benchmarks/BENCH_PROFILE.md "Pallas verdict")
 _sum_with_dtype.reduce_kind = "sum"
 
 

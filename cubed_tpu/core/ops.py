@@ -1007,9 +1007,9 @@ class _StreamingCombine:
         self.combine_func = combine_func
         self.axis = axis
         self.kw = kw
-        # propagate the combine's semantic tag (e.g. "sum") so the TPU
-        # executor can substitute a Pallas streaming kernel for the region
-        # combine when the dtype permits
+        # propagate the combine's semantic tag (e.g. "sum") — the seam a
+        # substituted region kernel keys on (see the note in
+        # array_api/statistical_functions.py)
         self.reduce_kind = getattr(combine_func, "reduce_kind", None) or (
             "sum" if combine_func is nxp.sum else None
         )

@@ -135,7 +135,6 @@ class JaxExecutor(DagExecutor):
         mesh=None,
         device_mem: Optional[int] = None,
         fuse_plan: bool = True,
-        use_pallas: Optional[bool] = None,
         compute_dtype: Optional[str] = None,
         matmul_precision: Optional[str] = None,
         **kwargs,
@@ -168,13 +167,20 @@ class JaxExecutor(DagExecutor):
         self.matmul_precision = matmul_precision
         #: trace consecutive traceable ops into ONE jitted XLA program
         self.fuse_plan = fuse_plan
-        #: route eligible reduction combines through the Pallas streaming
-        #: kernels (kernels/reductions.py). Default OFF: measured on v5e the
-        #: kernels reach only ~0.4-0.95x XLA's fused reductions (XLA emits
-        #: parallel partial sums; a single revisited accumulator block
-        #: serializes the Pallas grid) — see benchmarks/PALLAS_MICRO.json.
-        #: Pass True to opt in (tests use it to pin the wiring).
-        self.use_pallas = use_pallas
+        if "use_pallas" in kwargs:
+            # removed in round 5 (see BENCH_PROFILE.md "Pallas verdict");
+            # a silent no-op would misread as the kernels running
+            import warnings
+
+            warnings.warn(
+                "use_pallas was removed: the Pallas streaming-reduction "
+                "kernels were retired on measured evidence "
+                "(benchmarks/BENCH_PROFILE.md); reductions use XLA's "
+                "fused combines",
+                FutureWarning,
+                stacklevel=2,
+            )
+            kwargs.pop("use_pallas")
         self.kwargs = kwargs
         self._tracing = False
         self._prepared_bases: Dict[int, Any] = {}
@@ -185,10 +191,10 @@ class JaxExecutor(DagExecutor):
         #: ``segment_mem_aborts``, ``segment_hbm_footprint``,
         #: ``whole_array_hits``, ``whole_concat_hits``, ``batched_ops``,
         #: ``chunked_ops``, ``rechunk_alias`` (zero-copy), ``rechunk_virtual``
-        #: (materialized), ``pallas_region_hits``, ``eager_ops``, and the
+        #: (materialized), ``eager_ops``, and the
         #: failure counters ``eager_fallbacks`` / ``trace_failures`` /
         #: ``whole_array_errors`` / ``batched_errors`` / ``whole_select_errors``
-        #: / ``pallas_errors`` / ``jit_kernel_errors``
+        #: / ``jit_kernel_errors``
         #: (``eager_fallbacks`` must stay 0 on fused-path plans — tests pin it)
         self.stats: Counter = Counter()
 
@@ -762,7 +768,6 @@ class JaxExecutor(DagExecutor):
                 # flat device order) determines shardings; the contraction
                 # precision changes MXU pass counts inside the same HLO shape
                 str(self.matmul_precision),
-                bool(self.use_pallas),
                 tuple(self.mesh.devices.shape) if self.mesh is not None else None,
                 tuple(self.mesh.axis_names) if self.mesh is not None else None,
             )
@@ -1444,9 +1449,6 @@ class JaxExecutor(DagExecutor):
         jitted_region = (
             _JitCache(region_fn, self.stats) if region_fn is not None else None
         )
-        pallas_region = (
-            self._pallas_region_fn(spec.function) if region_fn is not None else None
-        )
 
         traced_offsets = self._tracing and getattr(
             spec.function, "traced_offsets", False
@@ -1471,10 +1473,7 @@ class JaxExecutor(DagExecutor):
                     for keys in keyss
                 ]
                 if all(r is not None for r in regions):
-                    if pallas_region is not None and len(regions) == 1:
-                        result = pallas_region(regions[0])
-                    if result is None:
-                        result = jitted_region(*regions)
+                    result = jitted_region(*regions)
                 else:
                     structure = tuple(iter(keys) for keys in keyss)
             if result is None:
@@ -1537,48 +1536,6 @@ class JaxExecutor(DagExecutor):
         if isinstance(value, dict):
             return {k: v[sel] for k, v in value.items()}
         return value[sel]
-
-    def _pallas_region_fn(self, fn) -> Optional[Any]:
-        """A Pallas substitute for the region combine, or None.
-
-        Eligible when the combine is semantically a sum (``reduce_kind``
-        tagged by the array_api layer / core reduction), the accumulation
-        dtype is f32 (the kernels accumulate in f32; other dtypes keep the
-        XLA combine), and ``use_pallas=True`` was requested (the reference's
-        combine shape is cubed/core/ops.py:978-1005; here the streamed group
-        is a single HBM->VMEM pass, kernels/reductions.py).
-        """
-        if not self.use_pallas:
-            return None
-        if getattr(fn, "reduce_kind", None) != "sum":
-            return None
-        kw = getattr(fn, "kw", None) or {}
-        extra = {k: v for k, v in kw.items() if k != "dtype"}
-        if extra:
-            return None
-        kw_dtype = kw.get("dtype")
-        if kw_dtype is not None and np.dtype(kw_dtype) != np.float32:
-            return None
-        axis = getattr(fn, "axis", None)
-        if not axis:
-            return None
-        from ...kernels.reductions import region_sum
-
-        def run(region):
-            if isinstance(region, dict) or region.dtype != np.float32:
-                return None
-            try:
-                out = region_sum(region, axis=axis, keepdims=True)
-            except Exception:
-                # recovered by the jitted XLA combine — a pallas_errors event,
-                # not an eager fallback (the fast path still runs)
-                logger.exception("pallas region combine failed; using XLA")
-                self.stats["pallas_errors"] += 1
-                return None
-            self.stats["pallas_region_hits"] += 1
-            return out
-
-        return run
 
     def _resolve(self, entry, spec: BlockwiseSpec, resident, traced_offsets=False):
         """Resolve a key structure to device chunks (sliced from residents)."""
