@@ -1,9 +1,9 @@
-"""One-shot TPU measurement session for the round-4 verification program.
+"""One-shot TPU measurement session for the round-5 verification program.
 
 The tunnel dies unpredictably (BENCH_PROFILE.md), so everything the
 VERDICT asks to measure on device is packed into one prioritized,
 resumable run. Each phase is a subprocess with its own timeout; every
-result is appended to ``benchmarks/DEVICE_R4.jsonl`` the moment it
+result is appended to ``benchmarks/DEVICE_R5.jsonl`` the moment it
 exists, so a mid-run wedge keeps all completed phases.
 
 Phases (priority order):
@@ -31,7 +31,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "benchmarks", "DEVICE_R4.jsonl")
+OUT = os.path.join(REPO, "benchmarks", "DEVICE_R5.jsonl")
 
 SMOKE = (
     "import jax, jax.numpy as jnp;"

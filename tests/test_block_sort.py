@@ -165,6 +165,72 @@ def test_auto_network_shrinks_oversized_chunks(tmp_path):
     )
 
 
+def test_multioutput_plan_hits_struct_cache(spec):
+    """Repeat computes of a structurally identical multi-output plan skip
+    tracing entirely — the fingerprint covers ALL writes, so a key bug
+    would show up here as a recompile instead of a struct hit."""
+    from cubed_tpu.runtime.executors import jax as jxm
+
+    jxm._STRUCT_CACHE.clear()  # a struct hit would skip tracing legitimately
+    an = np.random.default_rng(21).random(4096)
+
+    def build():
+        a = ct.from_array(an, chunks=(512,), spec=spec)
+        return xp.argsort(a)
+
+    ex1 = JaxExecutor()
+    r1 = np.asarray(build().compute(executor=ex1))
+    assert ex1.stats["segments_traced"] == 1
+    ex2 = JaxExecutor()
+    r2 = np.asarray(build().compute(executor=ex2))
+    assert ex2.stats.get("segment_struct_hits", 0) == 1
+    assert ex2.stats.get("segments_compiled", 0) == 0
+    np.testing.assert_array_equal(r1, np.argsort(an, kind="stable"))
+    np.testing.assert_array_equal(r2, r1)
+
+
+def test_predecessor_fuses_into_multioutput_consumer(spec):
+    """A single-output elemwise producer fuses INTO a multi-output
+    consumer (writes_rest carried through fuse_multiple); the multi-output
+    op itself never fuses away as a predecessor."""
+    from cubed_tpu.core.ops import elemwise, general_blockwise
+    from cubed_tpu.core.optimization import multiple_inputs_optimize_dag
+
+    an = np.arange(12, dtype=np.float64)
+    a = ct.from_array(an, chunks=(4,), spec=spec)
+    doubled = elemwise(
+        lambda x: x * 2.0, a, dtype=np.dtype(np.float64)
+    )
+
+    def two(chunk):
+        return chunk + 1.0, chunk - 1.0
+
+    def block_function(out_key):
+        return ((doubled.name, *out_key[1:]),)
+
+    p, q = general_blockwise(
+        two, block_function, doubled,
+        shape=a.shape, dtype=[a.dtype, a.dtype], chunks=a.chunks,
+        op_name="two_out",
+    )
+    dag = multiple_inputs_optimize_dag(p.plan.dag.copy())
+    multi_ops = [
+        d["primitive_op"]
+        for _, d in dag.nodes(data=True)
+        if d.get("type") == "op"
+        and d.get("primitive_op") is not None
+        and d["primitive_op"].target_arrays is not None
+    ]
+    assert len(multi_ops) == 1
+    # the elemwise producer fused in: the multi-output op reads `a` directly
+    reads = {
+        proxy.array for proxy in multi_ops[0].pipeline.config.reads_map.values()
+    }
+    assert a.zarray_maybe_lazy in reads
+    np.testing.assert_array_equal(np.asarray(p.compute()), an * 2.0 + 1.0)
+    np.testing.assert_array_equal(np.asarray(q.compute()), an * 2.0 - 1.0)
+
+
 def test_multichunk_sort_matches_numpy(spec):
     rng = np.random.default_rng(2)
     an = rng.random((13, 17))
