@@ -121,7 +121,7 @@ from .elementwise_functions import (  # noqa: F401
     trunc,
 )
 
-from .indexing_functions import take  # noqa: F401
+from .indexing_functions import take, take_along_axis  # noqa: F401
 
 from .linear_algebra_functions import (  # noqa: F401
     matmul,

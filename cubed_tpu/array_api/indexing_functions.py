@@ -1,9 +1,14 @@
 """Array-API indexing functions. Reference parity:
-cubed/array_api/indexing_functions.py (4 LoC)."""
+cubed/array_api/indexing_functions.py (4 LoC; ``take_along_axis`` is a
+2024.12 extension the reference lacks — it pairs with argsort, which the
+reference also lacks)."""
 
 from __future__ import annotations
 
 import numpy as np
+
+from ..backend_array_api import nxp
+from .dtypes import _integer_dtypes
 
 
 def take(x, indices, /, *, axis=None):
@@ -13,3 +18,120 @@ def take(x, indices, /, *, axis=None):
         axis = 0
     axis = axis % x.ndim
     return x[(slice(None),) * axis + (indices,)]
+
+
+def take_along_axis(x, indices, /, *, axis=-1):
+    """2024.12 ``take_along_axis``: gather values along ``axis`` at
+    per-position ``indices`` (the natural consumer of ``argsort``).
+
+    Memory-bounded and oblivious, in the same style as ``searchsorted``:
+    the output's chunk grid is ``indices``'s; each output block streams
+    x's chunks along ``axis`` one at a time, gathering the in-chunk
+    positions and masking by chunk ownership — so an ``axis`` larger than
+    ``allowed_mem`` gathers fine (one x chunk resident per step), and the
+    per-round kernel is identical across blocks (static plan, jittable).
+    Out-of-range indices are unspecified per the standard (values clamp to
+    the nearest chunk edge; no error is raised — a plan-time check cannot
+    see data)."""
+    if x.ndim == 0:
+        raise ValueError("take_along_axis requires at least 1 dimension")
+    if indices.dtype not in _integer_dtypes:
+        raise TypeError("indices must have an integer dtype")
+    if indices.ndim != x.ndim:
+        raise ValueError(
+            f"indices must have the same rank as x ({indices.ndim} != {x.ndim})"
+        )
+    axis = axis % x.ndim
+    # per spec, indices must be broadcast-compatible with x except along
+    # ``axis`` — size-1 dims on either side stretch to the other's extent
+    try:
+        out_nonaxis = [
+            np.broadcast_shapes((indices.shape[d],), (x.shape[d],))[0]
+            if d != axis
+            else None
+            for d in range(x.ndim)
+        ]
+    except ValueError:
+        raise ValueError(
+            "indices shape must be broadcast-compatible with x except "
+            f"along axis; got {indices.shape} vs {x.shape} (axis={axis})"
+        ) from None
+    from .manipulation_functions import broadcast_to
+
+    x_target = tuple(
+        x.shape[axis] if d == axis else out_nonaxis[d] for d in range(x.ndim)
+    )
+    idx_target = tuple(
+        indices.shape[axis] if d == axis else out_nonaxis[d]
+        for d in range(x.ndim)
+    )
+    if tuple(x.shape) != x_target:
+        x = broadcast_to(x, x_target)
+    if tuple(indices.shape) != idx_target:
+        indices = broadcast_to(indices, idx_target)
+
+    from ..core.ops import general_blockwise
+
+    # align non-axis chunk grids: the gather pairs each output block with
+    # the x blocks sharing its non-axis coordinates
+    target = tuple(
+        indices.chunks[d] if d == axis else x.chunks[d]
+        for d in range(x.ndim)
+    )
+    if indices.chunks != target:
+        indices = indices.rechunk(target)
+
+    n = x.shape[axis]
+    sizes = [int(c) for c in x.chunks[axis]]
+    starts = np.cumsum([0] + sizes[:-1]).tolist()
+    m = len(sizes)
+    idx_name, x_name = indices.name, x.name
+
+    def block_function(out_key):
+        coords = out_key[1:]
+        x_keys = [
+            (x_name, *(j if d == axis else c for d, c in enumerate(coords)))
+            for j in range(m)
+        ]
+        return ((idx_name, *coords), iter(x_keys))
+
+    def gather_kernel(idx_chunk, x_iter):
+        # all index arithmetic in int64: small index dtypes (e.g. uint8)
+        # would overflow on idx+n or idx-lo for perfectly valid indices
+        idxn = nxp.astype(idx_chunk, np.dtype(np.int64))
+        idxn = nxp.where(idxn < 0, idxn + n, idxn)
+        acc = None
+        for j, xb in enumerate(x_iter):
+            lo, size = starts[j], sizes[j]
+            loc = nxp.clip(idxn - lo, 0, size - 1)
+            gathered = nxp.take_along_axis(xb, loc, axis=axis)
+            if acc is None:
+                acc = gathered
+            else:
+                hit = nxp.logical_and(idxn >= lo, idxn < lo + size)
+                acc = nxp.where(hit, gathered, acc)
+        return acc
+
+    gather_kernel.__name__ = "take_along_axis"
+
+    out_chunk = tuple(
+        indices.chunksize[d] if d == axis else x.chunksize[d]
+        for d in range(x.ndim)
+    )
+    # streamed temporaries: loc (int64) + gathered + hit + the where copy
+    extra = (
+        2 * int(np.prod(out_chunk)) * x.dtype.itemsize
+        + 2 * int(np.prod(out_chunk)) * 8
+    )
+    return general_blockwise(
+        gather_kernel,
+        block_function,
+        indices,
+        x,
+        shape=indices.shape,
+        dtype=x.dtype,
+        chunks=indices.chunks,
+        extra_projected_mem=extra,
+        num_input_blocks=(1, m),
+        op_name="take_along_axis",
+    )

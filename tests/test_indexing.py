@@ -171,3 +171,84 @@ def test_index_then_reduce(spec, executor):
     a = ct.from_array(EN, chunks=(2, 4), spec=spec)
     got = float(xp.sum(a[1:]).compute(executor=executor))
     assert np.isclose(got, EN[1:].sum())
+
+
+# -- take_along_axis (2024.12 extension; pairs with argsort) -----------------
+
+
+def test_take_along_axis_matches_numpy(spec):
+    an = np.random.default_rng(0).random((12, 16))
+    a = ct.from_array(an, chunks=(4, 5), spec=spec)
+    for axis in (0, 1, -1):
+        order = np.argsort(an, axis=axis)
+        idx = ct.from_array(order, chunks=(4, 5), spec=spec)
+        got = np.asarray(xp.take_along_axis(a, idx, axis=axis).compute())
+        np.testing.assert_array_equal(
+            got, np.take_along_axis(an, order, axis=axis)
+        )
+
+
+def test_take_along_axis_argsort_roundtrip(spec):
+    # the headline consumer: gathering by argsort yields the sorted array
+    an = np.random.default_rng(1).integers(0, 50, 60).astype(np.int64)
+    a = ct.from_array(an, chunks=(8,), spec=spec)
+    srt = xp.take_along_axis(a, xp.argsort(a))
+    np.testing.assert_array_equal(np.asarray(srt.compute()), np.sort(an))
+
+
+def test_take_along_axis_negative_and_short_indices(spec):
+    an = np.random.default_rng(2).random((6, 9))
+    a = ct.from_array(an, chunks=(3, 4), spec=spec)
+    # k != n along axis, negative indices, int32 dtype
+    order = np.asarray([[-1, 0, 3], [2, -9, 1], [0, 1, 2],
+                        [5, 4, 3], [1, 1, 1], [-2, -3, -4]], dtype=np.int32)
+    idx = ct.from_array(order, chunks=(3, 2), spec=spec)
+    got = np.asarray(xp.take_along_axis(a, idx, axis=1).compute())
+    np.testing.assert_array_equal(
+        got, np.take_along_axis(an, order.astype(np.int64), axis=1)
+    )
+
+
+def test_take_along_axis_axis_larger_than_allowed_mem(tmp_path):
+    # the axis streams one x chunk at a time: 3 MB axis, 1 MB allowed
+    small = ct.Spec(work_dir=str(tmp_path), allowed_mem="1MB", reserved_mem=0)
+    n = 375_000
+    an = np.random.default_rng(3).random(n)
+    a = ct.from_array(an, chunks=(12_500,), spec=small)
+    order = np.argsort(an)
+    idx = ct.from_array(order, chunks=(12_500,), spec=small)
+    got = np.asarray(xp.take_along_axis(a, idx).compute())
+    np.testing.assert_array_equal(got, np.sort(an))
+
+
+def test_take_along_axis_broadcasts_and_small_dtypes(spec):
+    # size-1 non-axis dims broadcast per spec (both directions), and
+    # uint8 indices must not overflow the in-kernel arithmetic
+    an = np.random.default_rng(4).random((6, 9))
+    a = ct.from_array(an, chunks=(3, 4), spec=spec)
+    order = np.asarray([[0, 8, 3, 5, 1]], dtype=np.int64)  # (1, 5)
+    idx = ct.from_array(order, chunks=(1, 3), spec=spec)
+    got = np.asarray(xp.take_along_axis(a, idx, axis=1).compute())
+    np.testing.assert_array_equal(
+        got, np.take_along_axis(an, np.broadcast_to(order, (6, 5)), axis=1)
+    )
+    bn = np.random.default_rng(5).random(300)
+    b = ct.from_array(bn, chunks=(100,), spec=spec)
+    small = np.arange(0, 200, dtype=np.uint8)
+    sidx = ct.from_array(small, chunks=(64,), spec=spec)
+    got2 = np.asarray(xp.take_along_axis(b, sidx).compute())
+    np.testing.assert_array_equal(got2, bn[small.astype(np.int64)])
+
+
+def test_take_along_axis_rejections(spec):
+    a = ct.from_array(np.arange(8.0), chunks=(4,), spec=spec)
+    f = ct.from_array(np.zeros(8), chunks=(4,), spec=spec)
+    with pytest.raises(TypeError, match="integer dtype"):
+        xp.take_along_axis(a, f)
+    i2 = ct.from_array(np.zeros((2, 2), dtype=np.int64), chunks=(2, 2), spec=spec)
+    with pytest.raises(ValueError, match="same rank"):
+        xp.take_along_axis(a, i2)
+    b = ct.from_array(np.zeros((3, 4)), chunks=(2, 2), spec=spec)
+    i3 = ct.from_array(np.zeros((2, 4), dtype=np.int64), chunks=(2, 2), spec=spec)
+    with pytest.raises(ValueError, match="broadcast-compatible"):
+        xp.take_along_axis(b, i3, axis=1)
