@@ -111,6 +111,8 @@ from .elementwise_functions import (  # noqa: F401
     round,
     sign,
     signbit,
+    nextafter,
+    reciprocal,
     sin,
     sinh,
     sqrt,
@@ -145,9 +147,11 @@ from .manipulation_functions import (  # noqa: F401
     roll,
     squeeze,
     stack,
+    tile,
+    unstack,
 )
 
-from .searching_functions import argmax, argmin, where  # noqa: F401
+from .searching_functions import argmax, argmin, count_nonzero, where  # noqa: F401
 from .sorting_functions import argsort, searchsorted, sort  # noqa: F401
 
 from .statistical_functions import (  # noqa: F401
@@ -162,4 +166,4 @@ from .statistical_functions import (  # noqa: F401
     var,
 )
 
-from .utility_functions import all, any  # noqa: F401
+from .utility_functions import all, any, diff  # noqa: F401

@@ -360,6 +360,16 @@ def signbit(x, /):
                   result_dtype=_bool)
 
 
+def nextafter(x1, x2, /):
+    """2024.12 ``nextafter`` (the reference stops at 2022.12)."""
+    return _binary(nxp.nextafter, x1, x2, _real_floating_dtypes, "nextafter")
+
+
+def reciprocal(x, /):
+    """2024.12 ``reciprocal`` (the reference stops at 2022.12)."""
+    return _unary(nxp.reciprocal, x, _floating_dtypes, "reciprocal")
+
+
 def clip(x, /, min=None, max=None):
     """2023.12 ``clip``: bounds are scalars or arrays, None = unbounded.
 

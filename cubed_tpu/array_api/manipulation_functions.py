@@ -487,3 +487,41 @@ def stack(arrays, /, *, axis=0):
         chunks=chunks,
         op_name="stack",
     )
+
+
+def unstack(x, /, *, axis=0):
+    """2023.12 ``unstack``: split x into a tuple of arrays along ``axis``
+    (the reference stops at 2022.12). Each element is an integer-index
+    view — on the TPU executor a whole-select over the resident array."""
+    if x.ndim == 0:
+        raise ValueError("unstack requires at least one dimension")
+    axis = axis % x.ndim
+    sel_prefix = (slice(None),) * axis
+    return tuple(x[sel_prefix + (i,)] for i in range(x.shape[axis]))
+
+
+def tile(x, repetitions, /):
+    """2023.12 ``tile``: repeat x ``repetitions[d]`` times along each dim
+    (the reference stops at 2022.12). Built on concat — each tiled dim is
+    a concatenation of R references to the SAME lazy array, so the data
+    is not duplicated in the plan (one op reads the same blocks R times)."""
+    reps = tuple(int(r) for r in repetitions)
+    if any(r < 0 for r in reps):
+        raise ValueError("repetitions must be non-negative")
+    out = x
+    if len(reps) > x.ndim:
+        out = expand_dims(out, axis=tuple(range(len(reps) - x.ndim)))
+    elif len(reps) < x.ndim:
+        reps = (1,) * (x.ndim - len(reps)) + reps
+    for d, r in enumerate(reps):
+        if r == 1:
+            continue
+        if r == 0:
+            sel = tuple(
+                slice(0, 0) if dd == d else slice(None)
+                for dd in range(out.ndim)
+            )
+            out = out[sel]
+        else:
+            out = concat([out] * r, axis=d)
+    return out

@@ -44,3 +44,19 @@ def _arg_reduce(x, arg_func, val_func, axis, keepdims):
 def where(condition, x1, x2, /):
     dtype = result_type(x1, x2)
     return elemwise(nxp.where, condition, x1, x2, dtype=dtype)
+
+
+def count_nonzero(x, /, *, axis=None, keepdims=False, split_every=None):
+    """2023.12 ``count_nonzero`` (the reference stops at 2022.12): the
+    number of non-zero elements, as a sum over the (x != 0) mask through
+    the reduction tree."""
+    from .data_type_functions import astype
+    from .dtypes import int64
+    from .statistical_functions import sum as _sum
+
+    mask = elemwise(lambda a: nxp.not_equal(a, nxp.asarray(0, dtype=a.dtype)),
+                    x, dtype=np.dtype(np.bool_))
+    return _sum(
+        astype(mask, int64), axis=axis, keepdims=keepdims,
+        split_every=split_every,
+    )

@@ -37,3 +37,41 @@ def _all_fn(a, axis=None, keepdims=True, **kw):
 
 def _any_fn(a, axis=None, keepdims=True, **kw):
     return nxp.any(a, axis=axis, keepdims=keepdims)
+
+
+def diff(x, /, *, axis=-1, n=1, prepend=None, append=None):
+    """2024.12 ``diff`` (the reference stops at 2022.12): n-th discrete
+    difference along ``axis``, with optional prepend/append arrays.
+
+    Each round is ``x[1:] - x[:-1]`` along the axis — two shifted slices
+    subtracted blockwise; the offset slice grids unify automatically, and
+    on the TPU executor the whole thing fuses into the surrounding
+    segment."""
+    if x.ndim == 0:
+        raise ValueError("diff requires at least one dimension")
+    if n < 0:
+        raise ValueError("n must be non-negative")
+    axis = axis % x.ndim
+    parts = []
+    if prepend is not None:
+        parts.append(prepend)
+    parts.append(x)
+    if append is not None:
+        parts.append(append)
+    if len(parts) > 1:
+        from .manipulation_functions import concat
+
+        x = concat(parts, axis=axis)
+    for _ in range(n):
+        lo = tuple(
+            slice(1, None) if d == axis else slice(None)
+            for d in range(x.ndim)
+        )
+        hi = tuple(
+            slice(None, -1) if d == axis else slice(None)
+            for d in range(x.ndim)
+        )
+        from .elementwise_functions import subtract
+
+        x = subtract(x[lo], x[hi])
+    return x

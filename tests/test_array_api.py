@@ -632,3 +632,80 @@ def test_clip_rejects_raw_ndarray_bounds(spec):
 def test_clip_both_none_is_same_plan(spec):
     a = ct.from_array(np.arange(4.0), chunks=(2,), spec=spec)
     assert xp.clip(a) is a  # no kernel scheduled
+
+
+# -- 2023.12/2024.12 additions: unstack, tile, count_nonzero, diff,
+#    nextafter, reciprocal (the reference stops at 2022.12) ------------------
+
+
+def test_unstack(spec):
+    an = np.random.default_rng(0).random((3, 4, 5))
+    a = ct.from_array(an, chunks=(2, 2, 3), spec=spec)
+    for axis in (0, 1, -1):
+        parts = xp.unstack(a, axis=axis)
+        expect = tuple(np.moveaxis(an, axis, 0))
+        assert len(parts) == an.shape[axis]
+        for p, e in zip(parts, expect):
+            np.testing.assert_array_equal(np.asarray(p.compute()), e)
+
+
+def test_tile(spec):
+    an = np.arange(12.0).reshape(3, 4)
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    for reps in ((2, 3), (2,), (1, 2, 2), (1, 1), (0, 2)):
+        got = np.asarray(xp.tile(a, reps).compute())
+        np.testing.assert_array_equal(got, np.tile(an, reps))
+
+
+def test_count_nonzero(spec):
+    an = np.random.default_rng(1).integers(-1, 2, (6, 8))
+    a = ct.from_array(an, chunks=(3, 4), spec=spec)
+    assert int(xp.count_nonzero(a).compute()) == np.count_nonzero(an)
+    np.testing.assert_array_equal(
+        np.asarray(xp.count_nonzero(a, axis=0).compute()),
+        np.count_nonzero(an, axis=0),
+    )
+    got = xp.count_nonzero(a, axis=1, keepdims=True)
+    assert got.dtype == np.dtype(np.int64)
+    np.testing.assert_array_equal(
+        np.asarray(got.compute()), np.count_nonzero(an, axis=1, keepdims=True)
+    )
+
+
+def test_diff(spec):
+    an = np.random.default_rng(2).random((5, 12))
+    a = ct.from_array(an, chunks=(2, 5), spec=spec)
+    for kwargs in (
+        {},
+        {"axis": 0},
+        {"n": 2},
+        {"n": 0},
+        {"n": 3, "axis": 1},
+    ):
+        got = np.asarray(xp.diff(a, **kwargs).compute())
+        np.testing.assert_allclose(got, np.diff(an, **kwargs), rtol=1e-12)
+    pre = ct.from_array(np.zeros((5, 1)), chunks=(2, 1), spec=spec)
+    app = ct.from_array(np.ones((5, 2)), chunks=(2, 2), spec=spec)
+    got = np.asarray(xp.diff(a, prepend=pre, append=app).compute())
+    np.testing.assert_allclose(
+        got, np.diff(an, prepend=np.zeros((5, 1)), append=np.ones((5, 2))),
+        rtol=1e-12,
+    )
+
+
+def test_nextafter_reciprocal(spec):
+    an = np.asarray([1.0, -2.5, 0.125, 3e300])
+    bn = np.asarray([2.0, -3.0, 0.0, -1.0])
+    a = ct.from_array(an, chunks=(2,), spec=spec)
+    b = ct.from_array(bn, chunks=(2,), spec=spec)
+    np.testing.assert_array_equal(
+        np.asarray(xp.nextafter(a, b).compute()), np.nextafter(an, bn)
+    )
+    np.testing.assert_allclose(
+        np.asarray(xp.reciprocal(a).compute()), np.reciprocal(an), rtol=1e-15
+    )
+    i = ct.from_array(np.arange(4), chunks=(2,), spec=spec)
+    with pytest.raises(TypeError):
+        xp.reciprocal(i)
+    with pytest.raises(TypeError):
+        xp.nextafter(i, i)

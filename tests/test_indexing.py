@@ -232,6 +232,16 @@ def test_take_along_axis_broadcasts_and_small_dtypes(spec):
     np.testing.assert_array_equal(
         got, np.take_along_axis(an, np.broadcast_to(order, (6, 5)), axis=1)
     )
+    # x-side broadcast: size-1 non-axis dim in x stretches to indices'
+    xn = np.random.default_rng(6).random((1, 9))
+    x1 = ct.from_array(xn, chunks=(1, 4), spec=spec)
+    order2 = np.argsort(np.broadcast_to(xn, (6, 9)), axis=1)
+    idx2 = ct.from_array(order2, chunks=(3, 4), spec=spec)
+    got2 = np.asarray(xp.take_along_axis(x1, idx2, axis=1).compute())
+    np.testing.assert_array_equal(
+        got2,
+        np.take_along_axis(np.broadcast_to(xn, (6, 9)), order2, axis=1),
+    )
     bn = np.random.default_rng(5).random(300)
     b = ct.from_array(bn, chunks=(100,), spec=spec)
     small = np.arange(0, 200, dtype=np.uint8)
