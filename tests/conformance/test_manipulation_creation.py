@@ -312,3 +312,45 @@ def test_isdtype_categories():
     assert xp.isdtype(np.dtype(np.float64), "numeric")
     assert not xp.isdtype(np.dtype(np.float64), "integral")
     assert xp.isdtype(np.dtype(np.int32), (np.dtype(np.int32),))
+
+
+@given(data=st.data())
+def test_unstack(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,), min_dims=1))
+    axis = data.draw(st.integers(0, an.ndim - 1))
+    parts = xp.unstack(wrap(an, spec), axis=axis)
+    expect = tuple(np.moveaxis(an, axis, 0))
+    assert len(parts) == an.shape[axis]
+    which = data.draw(st.integers(0, len(parts) - 1)) if parts else 0
+    if parts:
+        assert_matches(run(parts[which]), expect[which])
+
+
+@given(data=st.data())
+def test_tile(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,)))
+    nreps = data.draw(st.integers(1, an.ndim + 1))
+    reps = tuple(
+        data.draw(st.integers(0, 2), label=f"rep{i}") for i in range(nreps)
+    )
+    got = run(xp.tile(wrap(an, spec), reps))
+    assert_matches(got, np.tile(an, reps))
+
+
+@given(data=st.data())
+def test_take_along_axis(data, spec):
+    an = data.draw(arrays(dtypes=REAL_FLOAT_DTYPES, min_dims=1))
+    axis = data.draw(st.integers(0, an.ndim - 1))
+    n = an.shape[axis]
+    if n == 0:
+        return
+    k = data.draw(st.integers(1, n + 2))
+    idx = data.draw(
+        hnp.arrays(
+            np.int64,
+            tuple(k if d == axis else an.shape[d] for d in range(an.ndim)),
+            elements=st.integers(-n, n - 1),
+        )
+    )
+    got = run(xp.take_along_axis(wrap(an, spec), wrap(idx, spec), axis=axis))
+    assert_matches(got, np.take_along_axis(an, idx, axis=axis))

@@ -15,6 +15,7 @@ import cubed_tpu.array_api as xp
 
 from .harness import (
     INT_DTYPES,
+    NUMERIC_DTYPES,
     REAL_FLOAT_DTYPES,
     arrays,
     assert_matches,
@@ -169,3 +170,24 @@ def test_where(data, spec):
     bn = data.draw(arrays(dtypes=(np.float64,), shape=shape))
     got = run(xp.where(wrap(cn, spec), wrap(an, spec), wrap(bn, spec)))
     assert_matches(got, np.where(cn, an, bn))
+
+
+@given(data=st.data())
+def test_count_nonzero(data, spec):
+    an = data.draw(arrays(dtypes=NUMERIC_DTYPES))
+    axis = data.draw(st.one_of(st.none(), st.integers(0, an.ndim - 1)))
+    keepdims = data.draw(st.booleans())
+    got = run(xp.count_nonzero(wrap(an, spec), axis=axis, keepdims=keepdims))
+    expect = np.count_nonzero(an, axis=axis, keepdims=keepdims)
+    np.testing.assert_array_equal(np.asarray(got), expect)
+
+
+@given(data=st.data())
+def test_diff(data, spec):
+    an = data.draw(arrays(dtypes=(np.float64,), min_dims=1))
+    axis = data.draw(st.integers(0, an.ndim - 1))
+    if an.shape[axis] == 0:
+        return
+    n = data.draw(st.integers(0, min(3, an.shape[axis])))
+    got = run(xp.diff(wrap(an, spec), axis=axis, n=n))
+    assert_matches(got, np.diff(an, axis=axis, n=n))
