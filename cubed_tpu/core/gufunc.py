@@ -1,7 +1,10 @@
 """apply_gufunc: apply a generalized ufunc ("(i,j),(j)->(i)" signatures) over
 loop dimensions by lowering to blockwise. Core dimensions must be single-chunk
-(no allow_rechunk), single output only. Reference parity:
-cubed/core/gufunc.py:7-148."""
+(no allow_rechunk). Multiple outputs are supported when every output shares
+the same core dimensions ("(i)->(),()" etc.) — ONE multi-output op evaluates
+the gufunc once per task and writes every output (the reference rejects all
+multi-output signatures, cubed/core/gufunc.py:7-148; differing per-output
+core dims would need per-output block-coordinate maps and stay rejected)."""
 
 from __future__ import annotations
 
@@ -49,8 +52,12 @@ def apply_gufunc(
 ):
     """Apply a generalized ufunc over the loop dimensions of chunked arrays."""
     input_dims, output_dims = _parse_gufunc_signature(signature)
-    if len(output_dims) > 1:
-        raise NotImplementedError("apply_gufunc supports a single output only")
+    n_out = len(output_dims)
+    if n_out > 1 and len(set(output_dims)) != 1:
+        raise NotImplementedError(
+            "apply_gufunc supports multiple outputs only when they share "
+            f"the same core dimensions; got {output_dims}"
+        )
     output_dim = output_dims[0]
 
     if axes is not None or axis is not None:
@@ -63,7 +70,20 @@ def apply_gufunc(
 
     if output_dtypes is None:
         raise ValueError("output_dtypes must be specified")
-    otype = output_dtypes[0] if isinstance(output_dtypes, (list, tuple)) else output_dtypes
+    if n_out > 1:
+        if not isinstance(output_dtypes, (list, tuple)) or len(
+            output_dtypes
+        ) != n_out:
+            raise ValueError(
+                f"output_dtypes must list {n_out} dtypes for {n_out} outputs"
+            )
+        otype = list(output_dtypes)
+    else:
+        otype = (
+            output_dtypes[0]
+            if isinstance(output_dtypes, (list, tuple))
+            else output_dtypes
+        )
 
     if vectorize:
         func = np.vectorize(func, signature=signature)

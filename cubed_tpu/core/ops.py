@@ -237,9 +237,14 @@ def blockwise(
     chunks_out = tuple(chunks_out)
     shape = tuple(sum(c) for c in chunks_out)
 
-    name = gensym("array")
-    if target_store is None:
-        target_store = new_temp_path(name, spec)
+    # multi-output (list-valued dtype): one op writes N arrays on the same
+    # block grid — func returns a tuple per task (used by apply_gufunc's
+    # multiple outputs); shapes/chunks are shared, dtypes per output
+    multi, names, target_store = _alloc_output_names_stores(
+        dtype, target_store, spec
+    )
+    out_name_arg = names if multi else names[0]
+    shape_arg = [shape] * len(dtype) if multi else shape
     in_names = [a.name for a in arrays]
 
     prim_args = []
@@ -254,20 +259,19 @@ def blockwise(
         reserved_mem=spec.reserved_mem,
         target_store=target_store,
         storage_options=storage_options or spec.storage_options,
-        shape=shape,
+        shape=shape_arg,
         dtype=dtype,
         chunks=chunks_out,
         new_axes=new_axes,
         in_names=in_names,
-        out_name=name,
+        out_name=out_name_arg,
         extra_projected_mem=extra_projected_mem,
         extra_func_kwargs=extra_func_kwargs,
         fusable=fusable,
         **kwargs,
     )
-    plan = Plan._new(name, func.__name__ if hasattr(func, "__name__") else "blockwise",
-                     op.target_array, op, False, *arrays)
-    return new_array(name, op.target_array, spec, plan)
+    op_label = func.__name__ if hasattr(func, "__name__") else "blockwise"
+    return _wrap_op_outputs(op, op_label, spec, arrays, names)
 
 
 def general_blockwise(
@@ -293,29 +297,19 @@ def general_blockwise(
     cubed/primitive/blockwise.py:78-82 structured writes; promoted here to
     real multiple array targets priced once at plan time)."""
     spec = _spec_of(*arrays)
-    multi = isinstance(dtype, (list, tuple))
+    multi, names, target_store = _alloc_output_names_stores(
+        dtype, target_store, spec
+    )
     if multi:
-        n_out = len(dtype)
-        names = [gensym("array") for _ in range(n_out)]
-        if target_store is None:
-            target_store = [new_temp_path(n, spec) for n in names]
         shapes = (
             list(shape)
             if shape and isinstance(shape[0], (list, tuple))
-            else [tuple(shape)] * n_out
+            else [tuple(shape)] * len(dtype)
         )
-        if isinstance(target_store, str):
-            raise TypeError(
-                "multi-output general_blockwise requires target_store to "
-                "be a list (one store per output) or None"
-            )
         chunks = normalize_chunks(chunks, shapes[0], dtype=dtype[0])
         out_name = names
         shape_arg = [tuple(s) for s in shapes]
     else:
-        names = [gensym("array")]
-        if target_store is None:
-            target_store = new_temp_path(names[0], spec)
         chunks = normalize_chunks(chunks, shape, dtype=dtype)
         out_name = names[0]
         shape_arg = tuple(shape)
@@ -336,13 +330,42 @@ def general_blockwise(
         num_input_blocks=num_input_blocks,
         fusable=fusable,
     )
+    return _wrap_op_outputs(op, op_name, spec, arrays, names)
+
+
+def _alloc_output_names_stores(dtype, target_store, spec):
+    """(multi?, output names, target store(s)) for an op's output(s).
+
+    Multi-output (list-valued ``dtype``) requires a list target_store (one
+    per output) or None (temp paths); a plain string would be silently
+    iterated into per-character paths."""
+    multi = isinstance(dtype, (list, tuple))
     if multi:
+        names = [gensym("array") for _ in dtype]
+        if target_store is None:
+            target_store = [new_temp_path(n, spec) for n in names]
+        elif isinstance(target_store, str):
+            raise TypeError(
+                "multi-output ops require target_store to be a list (one "
+                "store per output) or None"
+            )
+    else:
+        names = [gensym("array")]
+        if target_store is None:
+            target_store = new_temp_path(names[0], spec)
+    return multi, names, target_store
+
+
+def _wrap_op_outputs(op, op_label: str, spec, arrays, names):
+    """Plan node(s) + CoreArray(s) for a finished primitive op: a tuple for
+    multi-output ops, a single array otherwise."""
+    if op.target_arrays is not None:
         targets = op.target_arrays
-        plan = Plan._new(names, op_name, targets, op, False, *arrays)
+        plan = Plan._new(names, op_label, targets, op, False, *arrays)
         return tuple(
             new_array(n, t, spec, plan) for n, t in zip(names, targets)
         )
-    plan = Plan._new(names[0], op_name, op.target_array, op, False, *arrays)
+    plan = Plan._new(names[0], op_label, op.target_array, op, False, *arrays)
     return new_array(names[0], op.target_array, spec, plan)
 
 

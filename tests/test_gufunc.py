@@ -66,3 +66,52 @@ def test_bad_signature(spec):
     a = ct.from_array(np.zeros(3), chunks=3, spec=spec)
     with pytest.raises(ValueError, match="valid gufunc signature"):
         ct.apply_gufunc(lambda x: x, "bad sig", a, output_dtypes=np.float64)
+
+
+def test_apply_gufunc_multiple_outputs(spec):
+    """Same-core-dim multi-output signatures run as ONE multi-output op
+    (the reference rejects all multi-output gufuncs)."""
+    an = np.random.default_rng(0).random((8, 6))
+
+    def mean_and_ptp(a):
+        return a.mean(axis=-1), a.max(axis=-1) - a.min(axis=-1)
+
+    a = ct.from_array(an, chunks=(2, 6), spec=spec)
+    m, p = ct.apply_gufunc(
+        mean_and_ptp, "(i)->(),()", a,
+        output_dtypes=[np.float64, np.float64],
+    )
+    np.testing.assert_allclose(np.asarray(m.compute()), an.mean(axis=1))
+    np.testing.assert_allclose(
+        np.asarray(p.compute()), an.max(axis=1) - an.min(axis=1)
+    )
+    # one op feeds both outputs
+    dag = m.plan.dag
+    multi = [
+        d["primitive_op"]
+        for _, d in dag.nodes(data=True)
+        if d.get("type") == "op"
+        and d.get("primitive_op") is not None
+        and d["primitive_op"].target_arrays is not None
+    ]
+    assert len(multi) == 1 and len(multi[0].target_arrays) == 2
+
+
+def test_apply_gufunc_multiple_outputs_vectorized_divmod(spec):
+    an = (np.random.default_rng(1).random(24) * 100).reshape(4, 6)
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    q, r = ct.apply_gufunc(
+        lambda v: (v // 7.0, v % 7.0), "()->(),()", a,
+        output_dtypes=[np.float64, np.float64], vectorize=True,
+    )
+    np.testing.assert_allclose(np.asarray(q.compute()), an // 7.0)
+    np.testing.assert_allclose(np.asarray(r.compute()), an % 7.0)
+
+
+def test_apply_gufunc_differing_output_core_dims_rejected(spec):
+    a = ct.from_array(np.zeros((4, 4)), chunks=(2, 4), spec=spec)
+    with pytest.raises(NotImplementedError, match="same core dimensions"):
+        ct.apply_gufunc(
+            lambda v: (v, v.sum()), "(i)->(i),()", a,
+            output_dtypes=[np.float64, np.float64],
+        )
