@@ -14,6 +14,7 @@ import numpy as np
 import pytest
 from hypothesis import given, settings
 from hypothesis import strategies as st
+from hypothesis.extra import numpy as hnp
 
 import cubed_tpu as ct
 import cubed_tpu.array_api as xp
@@ -33,9 +34,17 @@ def _force_sort_network(monkeypatch):
 def _unary_step(draw, a):
     op = draw(st.sampled_from(["negative", "abs", "multiply2", "add1", "transpose",
                                "flip", "slice", "rechunk", "reshape_flat",
-                               "cumsum"]))
+                               "cumsum", "diff", "tile"]))
     if op == "cumsum":
         return xp.cumulative_sum(a, axis=draw(st.integers(0, a.ndim - 1)))
+    if op == "diff":
+        ax = draw(st.integers(0, a.ndim - 1))
+        if a.shape[ax] < 2:
+            return a
+        return xp.diff(a, axis=ax)
+    if op == "tile":
+        reps = tuple(draw(st.integers(1, 2)) for _ in range(a.ndim))
+        return xp.tile(a, reps)
     if op == "negative":
         return xp.negative(a)
     if op == "abs":
@@ -120,7 +129,7 @@ def test_random_linalg_and_stats_match_oracle(data, spec):
 
     kind = data.draw(st.sampled_from(
         ["matmul", "tensordot", "var", "std", "nanmean", "index", "sort",
-         "argsort"]
+         "argsort", "take_along_axis", "count_nonzero", "gufunc_multi"]
     ))
     if kind == "matmul":
         expr = xp.matmul(a, b)
@@ -142,6 +151,32 @@ def test_random_linalg_and_stats_match_oracle(data, spec):
             a, axis=data.draw(st.integers(0, 1)),
             descending=data.draw(st.booleans()),
         )
+    elif kind == "take_along_axis":
+        ax = data.draw(st.integers(0, 1))
+        nax = a.shape[ax]
+        idx_np = data.draw(
+            hnp.arrays(
+                np.int64,
+                tuple(nax if d == ax else a.shape[d] for d in range(2)),
+                elements=st.integers(-nax, nax - 1),
+            )
+        )
+        idx = ct.from_array(
+            idx_np, chunks=(max(1, m // 2), max(1, k // 2)), spec=spec
+        )
+        expr = xp.take_along_axis(a, idx, axis=ax)
+    elif kind == "count_nonzero":
+        expr = xp.count_nonzero(
+            xp.greater(a, 0.5),
+            axis=data.draw(st.one_of(st.none(), st.integers(0, 1))),
+        )
+    elif kind == "gufunc_multi":
+        ac = a.rechunk((max(1, m // 2), k))  # core dim single-chunk
+        mo = ct.apply_gufunc(
+            lambda v: (v.mean(axis=-1), v.max(axis=-1)),
+            "(i)->(),()", ac, output_dtypes=[np.float64, np.float64],
+        )
+        expr = mo[data.draw(st.integers(0, 1))]
     else:
         expr = xp.sort(a, axis=data.draw(st.integers(0, 1)))
 
