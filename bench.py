@@ -384,11 +384,14 @@ def emit(metric: str, res, baseline, work: int, unit: str = "GB/s/chip") -> None
 
 #: (workload — doubles as the baselines key, metric name, work units, unit,
 #: cpu-phase timeout cap)
+#: Device-phase order is wedge-aware: both observed tunnel wedges (r3 tile
+#: sweep, r5 device session — benchmarks/BENCH_PROFILE.md) followed multi-GB
+#: HBM allocations, so the small-footprint configs that have never produced
+#: a device number (matmul: ~130 MB/operand) run FIRST and the ~4 GB
+#: addsum_scaled runs second-to-last; a mid-run wedge then costs the configs
+#: with the least new information. vorticity stays LAST (the driver parses
+#: the last line).
 CONFIGS = [
-    ("addsum", "blockwise_addsum_5000x5000_f64", ADDSUM_WORK_BYTES,
-     "GB/s/chip", 120),
-    ("addsum_scaled", "blockwise_addsum_16000x16000_f64_scaled",
-     ADDSUM_SCALED_WORK_BYTES, "GB/s/chip", 150),
     ("matmul", "matmul_4000x4000_blockwise_contraction", MATMUL_FLOPS,
      "GFLOP/s/chip", 100),
     ("matmul_bf16", "matmul_4000x4000_bf16_mxu", MATMUL_FLOPS,
@@ -397,9 +400,13 @@ CONFIGS = [
      "GB/s/chip", 100),
     ("reduce", "axis_reductions_8000x8000_f64", REDUCE_WORK_BYTES,
      "GB/s/chip", 100),
+    ("addsum", "blockwise_addsum_5000x5000_f64", ADDSUM_WORK_BYTES,
+     "GB/s/chip", 120),
     # physical bytes under f32 ingestion are half the declared-f64 bytes
     ("vorticity_f32", "pangeo_vorticity_500x450x400_f32_ingest",
      WORK_BYTES // 2, "GB/s/chip", 200),
+    ("addsum_scaled", "blockwise_addsum_16000x16000_f64_scaled",
+     ADDSUM_SCALED_WORK_BYTES, "GB/s/chip", 150),
     # vorticity LAST (the driver parses the last line)
     ("vorticity", "pangeo_vorticity_500x450x400_f64_throughput", WORK_BYTES,
      "GB/s/chip", 300),

@@ -141,6 +141,23 @@ def time_fn(fn, *, reps=REPS, seed0=100) -> float:
 
 
 def main() -> None:
+    # --configs a,b,c selects a subset in the given order (the wedge-prone
+    # tunnel means callers want the highest-information configs first);
+    # validate BEFORE the expensive jit builds so a typo costs nothing
+    all_configs = (
+        "addsum", "matmul", "matmul_bf16", "elemwise", "reduce",
+        "vorticity", "vorticity_f32",
+    )
+    selected = all_configs
+    if "--configs" in sys.argv:
+        idx = sys.argv.index("--configs")
+        if idx + 1 >= len(sys.argv):
+            sys.exit("--configs requires a comma-separated value")
+        selected = tuple(sys.argv[idx + 1].split(","))
+        unknown = [c for c in selected if c not in all_configs]
+        if unknown:
+            sys.exit(f"unknown configs {unknown}; choose from {all_configs}")
+
     fns = build_fns()
     import jax
 
@@ -150,10 +167,7 @@ def main() -> None:
         "config": "latency_floor", "platform": platform,
         "elapsed_s": round(floor, 4),
     }), flush=True)
-    for config in (
-        "addsum", "matmul", "matmul_bf16", "elemwise", "reduce",
-        "vorticity", "vorticity_f32",
-    ):
+    for config in selected:
         elapsed = time_fn(fns[config])
         work, unit = _work(config)
         print(json.dumps({
@@ -176,7 +190,9 @@ if __name__ == "__main__":
         env = _scrubbed_cpu_env(1)
         env["_RAW_BOUND_CHILD"] = "1"
         out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--cpu"], env=env
+            # forward the full argv (e.g. --configs) to the scrubbed child
+            [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
+            env=env,
         )
         sys.exit(out.returncode)
     main()

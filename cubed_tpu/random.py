@@ -9,15 +9,168 @@ computes which block), expressed with the native counter-based PRNG.
 The seed rides the offsets *data* (VirtualOffsetsArray base) so the kernel's
 HLO is identical for every plan — one persistent-cache compile serves all
 random arrays of a given chunk shape.
+
+Backend-appropriate generation (``CUBED_TPU_RNG`` = ``auto`` | ``threefry``
+| ``philox``, default ``auto``): threefry is the TPU fast path (counter-
+based, fuses into the surrounding XLA program — the committed 20.7 GB/s
+vorticity device profile is four such generations), but XLA-CPU executes
+the same threefry ~20x slower than numpy's Philox (measured:
+benchmarks/BENCH_PROFILE.md r4/r5 sections — it dominates every below-
+baseline CPU-fallback metric). ``auto`` therefore routes by the actual
+execution platform at kernel-trace time: TPU/GPU generate with fused
+threefry; single-device CPU generates with the numpy Philox stream via
+``jax.pure_callback`` — block-sized host generation feeding the fused XLA
+consumer, giving the CPU path the numpy backend's generation rate AND
+making its streams exactly match the numpy-backend oracle (``Philox(seed=
+root + block_offset)``, the reference's own contract). Blocks larger than
+``_PHILOX_MAX_BLOCK_BYTES`` stay fused threefry even on CPU: the
+callback's copy/materialization cost scales with block bytes and crosses
+over around there (see the constant's measured table). Under a device mesh
+the executor forces threefry (callbacks don't partition across a
+multi-controller SPMD program); a heterogeneous CPU+TPU fleet must pin one
+stream via ``CUBED_TPU_RNG`` if cross-platform per-block reproducibility
+matters.
 """
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
+import os
 import random as pyrandom
 
 import numpy as np
 
 from .backend_array_api import BACKEND, nxp
+
+#: executor-scoped resolution override (e.g. "threefry" under a mesh);
+#: a ContextVar so concurrently executing executors in other threads keep
+#: their own scope
+_MODE_OVERRIDE: contextvars.ContextVar = contextvars.ContextVar(
+    "cubed_tpu_rng_mode", default=None
+)
+
+
+#: auto-mode block-size crossover, measured on the bench configs (same
+#: machine state, best-of-2, framework warm): philox-callback wins 1.1-2.5x
+#: for <=8 MB blocks (reduce 1.98x, vorticity_f32 2.49x, elemwise 1.39x,
+#: matmul 1.37x, addsum 1.14x, vorticity 1.08x) but LOSES 1.8x on the 32
+#: MB-block addsum_scaled config: the callback's copy/materialization cost
+#: scales with block bytes while fused threefry never materializes the
+#: generation at all. Crossover set between the measured points.
+_PHILOX_MAX_BLOCK_BYTES = 16 * 2**20
+
+
+def generation_mode(block_nbytes=None) -> str:
+    """Resolve the RNG implementation for kernels traced/executed NOW.
+
+    Order: executor scope (the mesh-correctness constraint, always
+    threefry) > ``CUBED_TPU_RNG`` env pin > platform auto (cpu -> philox
+    for blocks up to ``_PHILOX_MAX_BLOCK_BYTES``, else threefry).
+    Resolved at kernel-trace time, so one plan computed on different
+    executors uses each executor's appropriate path.
+
+    ``block_nbytes=None`` asks for the POLICY rather than a per-block
+    decision — the JaxExecutor's structural segment cache folds that
+    policy string into its key (block shapes are already in the key, so
+    policy + shape fully determines every kernel's branch).
+
+    The executor scope outranks an env ``philox`` pin: the scope is only
+    ever set to threefry as the mesh-correctness constraint (callbacks
+    don't partition across an SPMD program), and a preference must not
+    override a correctness requirement — a mesh execution under
+    ``CUBED_TPU_RNG=philox`` generates with threefry.
+    """
+    mode = os.environ.get("CUBED_TPU_RNG", "auto").lower()
+    if mode not in ("auto", "threefry", "philox"):
+        raise ValueError(
+            f"CUBED_TPU_RNG must be 'auto', 'threefry' or 'philox'; "
+            f"got {os.environ['CUBED_TPU_RNG']!r}"
+        )
+    override = _MODE_OVERRIDE.get()
+    if override is not None:
+        return override
+    if mode in ("threefry", "philox"):
+        return mode
+    if BACKEND != "jax":
+        return "philox"
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return "threefry"
+    if block_nbytes is None:
+        # policy string for cache keys: the threshold is part of the
+        # policy (tests patch it; two thresholds trace different programs
+        # for the same plan shape)
+        return f"auto-cpu:{_PHILOX_MAX_BLOCK_BYTES}"
+    return (
+        "philox" if block_nbytes <= _PHILOX_MAX_BLOCK_BYTES else "threefry"
+    )
+
+
+def _maybe_philox(shape, seeded_offset, np_dtype, draw):
+    """Route one block's generation: the philox-callback array if the
+    resolved mode for this block size is philox, else None (caller
+    generates with fused threefry). ``draw(rng, shape)`` produces the
+    block from a numpy Generator."""
+    import jax
+
+    dt = np.dtype(jax.dtypes.canonicalize_dtype(np_dtype))
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize if shape else dt.itemsize
+    if generation_mode(nbytes) != "philox":
+        return None
+    return _philox_block(shape, seeded_offset, lambda rng: draw(rng, shape), dt)
+
+
+@contextlib.contextmanager
+def _mode_scope(mode: str):
+    """Pin :func:`generation_mode`'s executor-scope resolution (this thread
+    / async context only) for the duration — the JaxExecutor wraps mesh
+    executions with ``_mode_scope("threefry")``."""
+    token = _MODE_OVERRIDE.set(mode)
+    try:
+        yield
+    finally:
+        _MODE_OVERRIDE.reset(token)
+
+
+def _philox_block(shape, seeded_offset, draw, out_dtype):
+    """One block generated host-side with the numpy Philox stream, fed to
+    the traced program as a ``pure_callback`` — the offsets stay DATA, so
+    the HLO is still plan-invariant.
+
+    Batching: under the executor's batched (vmapped) dispatch path the
+    callback must NOT lower through ``vmap_method="sequential"`` — that
+    becomes an XLA loop whose per-iteration result updates copy the full
+    stacked buffer (measured: 62 s vs 15 s on the 4 GB addsum_scaled
+    config). ``"expand_dims"`` instead delivers the whole batch of offsets
+    to ONE host call, which loops the per-block Philox draws in numpy and
+    returns the stacked batch — per-block stream semantics preserved, one
+    host round-trip per op."""
+    import jax
+
+    base_ndim = len(shape)
+
+    def host(off):
+        off = np.asarray(off)
+        batch_shape = off.shape[: max(off.ndim - base_ndim, 0)]
+        offs = off.ravel()
+
+        def gen(o):
+            rng = np.random.Generator(np.random.Philox(seed=int(o)))
+            return np.asarray(draw(rng)).astype(out_dtype, copy=False)
+
+        if offs.size == 1 and not batch_shape:
+            return gen(offs[0])
+        out = np.stack([gen(o) for o in offs])
+        return out.reshape(*batch_shape, *shape)
+
+    return jax.pure_callback(
+        host,
+        jax.ShapeDtypeStruct(shape, out_dtype),
+        seeded_offset,
+        vmap_method="expand_dims",
+    )
 
 def _ensure_partitionable_threefry():
     """Counter-parallel threefry lowering: generates each element
@@ -69,6 +222,12 @@ def _random_block(chunk, seeded_offset):
     if BACKEND == "jax":
         import jax
 
+        routed = _maybe_philox(
+            chunk.shape, seeded_offset, np.float64,
+            lambda rng, shape: rng.random(shape, dtype=np.float64),
+        )
+        if routed is not None:
+            return routed
         _ensure_partitionable_threefry()
         off = seeded_offset.ravel()[0]
         key = jax.random.fold_in(jax.random.key(0), off)
@@ -168,6 +327,12 @@ def _normal_block(chunk, seeded_offset):
     if BACKEND == "jax":
         import jax
 
+        routed = _maybe_philox(
+            chunk.shape, seeded_offset, np.float64,
+            lambda rng, shape: rng.normal(size=shape),
+        )
+        if routed is not None:
+            return routed
         _ensure_partitionable_threefry()
         off = seeded_offset.ravel()[0]
         key = jax.random.fold_in(jax.random.key(0), off)
@@ -182,6 +347,12 @@ def _randint_block(chunk, seeded_offset, *, params):
     if BACKEND == "jax":
         import jax
 
+        routed = _maybe_philox(
+            chunk.shape, seeded_offset, np.int64,
+            lambda rng, shape: rng.integers(0, span, size=shape, dtype=np.int64),
+        )
+        if routed is not None:
+            return routed
         _ensure_partitionable_threefry()
         off = seeded_offset.ravel()[0]
         key = jax.random.fold_in(jax.random.key(0), off)

@@ -339,6 +339,13 @@ class JaxExecutor(DagExecutor):
                 stack.enter_context(
                     jax.default_matmul_precision(self.matmul_precision)
                 )
+            if self.mesh is not None:
+                # RNG kernels must stay fused threefry under a mesh: the
+                # CPU Philox pure_callback path (random.generation_mode)
+                # doesn't partition across an SPMD program
+                from ...random import _mode_scope
+
+                stack.enter_context(_mode_scope("threefry"))
             return self._execute_dag_inner(
                 dag, callbacks, array_names, resume, spec, **kwargs
             )
@@ -648,6 +655,7 @@ class JaxExecutor(DagExecutor):
             return None
         jax = _jax()
 
+        from ...random import generation_mode as _generation_mode
         from ...core.plan import Plan
         from ...spec import Spec
         from ...utils import StackSummary
@@ -770,6 +778,11 @@ class JaxExecutor(DagExecutor):
                 str(self.matmul_precision),
                 tuple(self.mesh.devices.shape) if self.mesh is not None else None,
                 tuple(self.mesh.axis_names) if self.mesh is not None else None,
+                # RNG kernels branch on the resolved generation mode at
+                # trace time (random.generation_mode), so threefry- and
+                # philox-traced programs of one plan shape must not share
+                # a cache entry
+                _generation_mode(),
             )
         )
         buf = io.BytesIO()
