@@ -126,14 +126,19 @@ def main() -> int:
             "elapsed_s": round(res["elapsed"], 4),
         })
 
-    if raw_gaps:
+    # one subprocess PER config: the 03:19Z session lost all 7 bounds when
+    # a single shared 600 s budget hit one slow f64-emulation compile.
+    # Fast-compiling configs go first so a wedge costs the least info.
+    RAW_ORDER = ["matmul_bf16", "elemwise", "reduce", "addsum",
+                 "vorticity_f32", "matmul", "vorticity"]
+    for cfg in sorted(raw_gaps, key=RAW_ORDER.index):
         if not probe(75):
             return 1
         try:
             out = subprocess.run(
                 [sys.executable, os.path.join(HERE, "raw_jax_bound.py"),
-                 "--configs", ",".join(raw_gaps)],
-                capture_output=True, text=True, timeout=600,
+                 "--configs", cfg],
+                capture_output=True, text=True, timeout=300,
                 env=dict(os.environ), cwd=REPO,
             )
             lines = [json.loads(ln) for ln in out.stdout.strip().splitlines()
@@ -141,7 +146,7 @@ def main() -> int:
             record("raw", {"bounds": lines, "rc": out.returncode,
                            "stderr": out.stderr[-300:] if out.returncode else ""})
         except subprocess.TimeoutExpired:
-            record("raw", {"error": "timeout"})
+            record("raw", {"error": "timeout", "config": cfg})
 
     for flag in threefry_gaps:
         if not probe(60):
