@@ -92,9 +92,16 @@ def main() -> int:
     threefry_gaps = [
         f for f in (True, False) if f"threefry_{f}" not in raw_done
     ]
-    print(f"gaps={gaps} raw_gaps={raw_gaps} threefry={threefry_gaps}",
-          flush=True)
-    if not (gaps or raw_gaps or threefry_gaps):
+    try:
+        _rows = [json.loads(ln) for ln in open(OUT)]
+    except OSError:
+        _rows = []
+    mxu_sat_pending = not any(
+        r.get("phase") == "mxu_sat" and r.get("summary") for r in _rows
+    )
+    print(f"gaps={gaps} raw_gaps={raw_gaps} threefry={threefry_gaps} "
+          f"mxu_sat_pending={mxu_sat_pending}", flush=True)
+    if not (gaps or raw_gaps or threefry_gaps or mxu_sat_pending):
         return 0
 
     baselines = bench.get_baselines()
@@ -165,6 +172,33 @@ def main() -> int:
                                     "error": out.stderr[-400:]})
         except subprocess.TimeoutExpired:
             record("threefry", {"partitionable": flag, "error": "timeout"})
+
+    # MXU saturation probe (16384^2 bf16, 8.8 TFLOP — the size where the
+    # MXU rather than the dispatch floor is the bottleneck), once
+    rows = []
+    try:
+        rows = [json.loads(ln) for ln in open(OUT)]
+    except OSError:
+        pass
+    if not any(r.get("phase") == "mxu_sat" and "summary" in r for r in rows):
+        if not probe(75):
+            return 1
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.join(HERE, "mxu_saturation.py")],
+                capture_output=True, text=True, timeout=480,
+                env=dict(os.environ), cwd=REPO,
+            )
+            lines = [json.loads(ln) for ln in out.stdout.strip().splitlines()
+                     if ln.startswith("{")]
+            summary = next(
+                (l for l in lines if l.get("leg") == "summary"), None)
+            record("mxu_sat", {"legs": lines, "summary": summary,
+                               "rc": out.returncode,
+                               "stderr": out.stderr[-300:] if out.returncode
+                               else ""})
+        except subprocess.TimeoutExpired:
+            record("mxu_sat", {"error": "timeout"})
 
     # MXU fraction-of-peak summary over EVERYTHING recorded so far
     try:

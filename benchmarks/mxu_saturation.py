@@ -1,0 +1,108 @@
+"""MXU saturation probe: how fast can the framework drive the systolic array?
+
+The canonical matmul config (4000x4000, (1000,1000) chunks) exists to price
+orchestration and is dispatch-latency-bound on device (~70 ms floor for
+~0.087 s total — BENCH_PROFILE.md §round 5). This script measures the
+framework at a size where the MXU, not the tunnel, is the bottleneck:
+
+    sum(a @ b), n=16384, chunks (8192, 8192), f32 storage,
+    bf16 matmul precision (the ``matmul_precision="bfloat16"`` opt-in)
+
+= 8.8 TFLOP across a 2x2x2 blockwise contraction of 8192^3 tile matmuls —
+large enough that even at full v5e bf16 peak (~197 TFLOP/s) device compute
+exceeds the dispatch floor. A raw-JAX jit of the same math (same RNG, same
+precision) runs second for the framework/raw ratio.
+
+Output: one JSON line per leg (framework, raw) + a summary line with
+fraction-of-peak. Run with the inherited device env.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import time
+
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N = 16384
+CHUNK = 8192
+FLOPS = 2 * N * N * N  # 8.796 TFLOP
+V5E_BF16_PEAK_GFLOPS = 197_000.0
+REPS = 3
+
+
+def framework_leg() -> dict:
+    import cubed_tpu as ct
+    import cubed_tpu.array_api as xp
+    import cubed_tpu.random
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="8GB")
+    executor = JaxExecutor(compute_dtype="float32",
+                           matmul_precision="bfloat16")
+
+    def build():
+        a = cubed_tpu.random.random((N, N), chunks=CHUNK, spec=spec)
+        b = cubed_tpu.random.random((N, N), chunks=CHUNK, spec=spec)
+        return xp.sum(xp.matmul(a, b))
+
+    build().compute(executor=executor)  # compile + caches
+    best = float("inf")
+    for _ in range(REPS):
+        s = build()
+        t0 = time.perf_counter()
+        v = float(s.compute(executor=executor))
+        best = min(best, time.perf_counter() - t0)
+    assert 0.85 < v / (0.25 * N**3) < 1.15, v  # E[sum(A@B)] = n^3/4
+    return {"leg": "framework", "elapsed_s": round(best, 4),
+            "gflops": round(FLOPS / best / 1e9, 1)}
+
+
+def raw_leg() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_threefry_partitionable", True)
+
+    @jax.jit
+    def step(seed):
+        ka = jax.random.fold_in(jax.random.key(0), seed * 7919 + 1)
+        kb = jax.random.fold_in(jax.random.key(0), seed * 7919 + 2)
+        a = jax.random.uniform(ka, (N, N), dtype=jnp.float32)
+        b = jax.random.uniform(kb, (N, N), dtype=jnp.float32)
+        with jax.default_matmul_precision("bfloat16"):
+            return jnp.sum(a @ b)
+
+    float(step(0))  # compile
+    best = float("inf")
+    for i in range(REPS):
+        t0 = time.perf_counter()
+        float(step(100 + i))  # distinct seed defeats the tunnel result cache
+        best = min(best, time.perf_counter() - t0)
+    return {"leg": "raw_jax", "elapsed_s": round(best, 4),
+            "gflops": round(FLOPS / best / 1e9, 1)}
+
+
+def main() -> int:
+    fw = framework_leg()
+    print(json.dumps(fw), flush=True)
+    raw = raw_leg()
+    print(json.dumps(raw), flush=True)
+    print(json.dumps({
+        "leg": "summary",
+        "framework_gflops": fw["gflops"],
+        "raw_jax_gflops": raw["gflops"],
+        "fw_over_raw": round(fw["gflops"] / raw["gflops"], 3),
+        "framework_fraction_of_bf16_peak": round(
+            fw["gflops"] / V5E_BF16_PEAK_GFLOPS, 4),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
