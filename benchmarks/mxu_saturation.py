@@ -29,10 +29,12 @@ import os
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from device_session import V5E_BF16_PEAK_GFLOPS  # noqa: E402  (shared constant)
+
 N = 16384
 CHUNK = 8192
 FLOPS = 2 * N * N * N  # 8.796 TFLOP
-V5E_BF16_PEAK_GFLOPS = 197_000.0
 REPS = 3
 
 

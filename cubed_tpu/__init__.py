@@ -22,6 +22,8 @@ from .core.ops import (  # noqa: F401
     from_array,
     from_zarr,
     map_blocks,
+    map_direct,
+    merge_chunks,
     rechunk,
     store,
     to_zarr,
@@ -49,7 +51,11 @@ __all__ = [
     "store",
     "to_zarr",
     "apply_gufunc",
+    "map_direct",
+    "merge_chunks",
+    "nanmax",
     "nanmean",
+    "nanmin",
     "nansum",
     "array_api",
     "random",

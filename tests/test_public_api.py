@@ -1,0 +1,80 @@
+"""Pin the public API surface to a superset of the reference's.
+
+The reference's exports are hardcoded here (from cubed/__init__.py:20-36
+and cubed/array_api/__init__.py's ``__all__`` accumulation) so a refactor
+that silently drops a public name fails fast, without the tests depending
+on the reference checkout being present.
+"""
+
+import cubed_tpu
+import cubed_tpu.array_api as xp
+
+#: cubed/__init__.py __all__ (minus __version__, asserted separately)
+REFERENCE_TOP_LEVEL = {
+    "Array", "Callback", "Spec", "TaskEndEvent", "apply_gufunc", "compute",
+    "from_array", "from_zarr", "map_blocks", "measure_reserved_mem",
+    "nanmean", "nansum", "store", "to_zarr", "visualize",
+}
+
+#: extensions this package commits to beyond the reference
+EXTENSION_TOP_LEVEL = {
+    "array_api", "random", "rechunk", "merge_chunks", "map_direct",
+    "nanmax", "nanmin",
+}
+
+#: the reference array_api namespace (125 names, 2022.12 surface)
+REFERENCE_ARRAY_API = {
+    "Array", "__array_api_version__", "abs", "acos", "acosh", "add", "all",
+    "any", "arange", "argmax", "argmin", "asarray", "asin", "asinh",
+    "astype", "atan", "atan2", "atanh", "bitwise_and", "bitwise_invert",
+    "bitwise_left_shift", "bitwise_or", "bitwise_right_shift",
+    "bitwise_xor", "bool", "broadcast_arrays", "broadcast_to", "can_cast",
+    "ceil", "complex128", "complex64", "concat", "conj", "cos", "cosh",
+    "divide", "e", "empty", "empty_like", "equal", "exp", "expand_dims",
+    "expm1", "eye", "finfo", "float32", "float64", "floor", "floor_divide",
+    "full", "full_like", "greater", "greater_equal", "iinfo", "imag",
+    "inf", "int16", "int32", "int64", "int8", "isdtype", "isfinite",
+    "isinf", "isnan", "less", "less_equal", "linspace", "log", "log10",
+    "log1p", "log2", "logaddexp", "logical_and", "logical_not",
+    "logical_or", "logical_xor", "matmul", "matrix_transpose", "max",
+    "mean", "meshgrid", "min", "moveaxis", "multiply", "nan", "negative",
+    "newaxis", "not_equal", "ones", "ones_like", "outer", "permute_dims",
+    "pi", "positive", "pow", "prod", "real", "remainder", "reshape",
+    "result_type", "round", "sign", "sin", "sinh", "sqrt", "square",
+    "squeeze", "stack", "subtract", "sum", "take", "tan", "tanh",
+    "tensordot", "tril", "triu", "trunc", "uint16", "uint32", "uint64",
+    "uint8", "vecdot", "where", "zeros", "zeros_like",
+}
+
+#: post-2022.12 standard additions this package carries
+EXTENSION_ARRAY_API = {
+    "clip", "copysign", "hypot", "maximum", "minimum", "signbit",
+    "nextafter", "reciprocal", "var", "std", "cumulative_sum",
+    "cumulative_prod", "flip", "roll", "repeat", "tile", "unstack",
+    "count_nonzero", "diff", "sort", "argsort", "searchsorted",
+    "take_along_axis",
+}
+
+
+def test_top_level_superset_of_reference():
+    assert REFERENCE_TOP_LEVEL <= set(cubed_tpu.__all__)
+    assert hasattr(cubed_tpu, "__version__")
+
+
+def test_top_level_extensions_present():
+    assert EXTENSION_TOP_LEVEL <= set(cubed_tpu.__all__)
+
+
+def test_all_names_resolve():
+    for name in cubed_tpu.__all__:
+        assert getattr(cubed_tpu, name) is not None, name
+
+
+def test_array_api_superset_of_reference():
+    missing = {n for n in REFERENCE_ARRAY_API if not hasattr(xp, n)}
+    assert not missing, sorted(missing)
+
+
+def test_array_api_extensions_present():
+    missing = {n for n in EXTENSION_ARRAY_API if not hasattr(xp, n)}
+    assert not missing, sorted(missing)
