@@ -117,8 +117,13 @@ def apply_gufunc(
                         "single chunk"
                     )
 
-    # index symbols: loop dims (broadcast-aligned, negative positions) then core
-    core_syms = {d: f"c_{d}" for d in dim_sizes}
+    # index symbols: loop dims (broadcast-aligned, negative positions) then
+    # core; output-only core dims (e.g. the "k" in "(i,j)->(i,k)") get
+    # symbols too — their sizes come from output_sizes via new_axes below
+    core_syms = {
+        d: f"c_{d}"
+        for d in {*dim_sizes, *(d for dims in output_dims for d in dims)}
+    }
 
     blockwise_args = []
     for a, dims, lnd in zip(args, input_dims, loop_ndims):

@@ -306,7 +306,20 @@ def general_blockwise(
             if shape and isinstance(shape[0], (list, tuple))
             else [tuple(shape)] * len(dtype)
         )
-        chunks = normalize_chunks(chunks, shapes[0], dtype=dtype[0])
+        if isinstance(chunks, list):
+            # per-output chunk sizes (same numblocks enforced by the
+            # primitive), each normalized against its own shape/dtype
+            if len(chunks) != len(dtype):
+                raise ValueError(
+                    "per-output chunks list must have one entry per "
+                    f"output; got {len(chunks)} for {len(dtype)} outputs"
+                )
+            chunks = [
+                normalize_chunks(c, s, dtype=dt)
+                for c, s, dt in zip(chunks, shapes, dtype)
+            ]
+        else:
+            chunks = normalize_chunks(chunks, shapes[0], dtype=dtype[0])
         out_name = names
         shape_arg = [tuple(s) for s in shapes]
     else:

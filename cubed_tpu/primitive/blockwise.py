@@ -335,8 +335,12 @@ def general_blockwise(
 
     Multi-output: pass ``dtype`` (and ``target_store``/``out_name``, and
     optionally ``shape``) as LISTS — one entry per output, all outputs on
-    the primary output's block grid. ``function`` then returns a tuple of
-    arrays, one per output, and the returned op carries ``target_arrays``.
+    ONE shared block grid. ``function`` then returns a tuple of arrays,
+    one per output, and the returned op carries ``target_arrays``.
+    Outputs may have distinct chunk SIZES (pass ``chunks`` as a list of
+    per-output normalized chunks) as long as every output's numblocks
+    agree — e.g. TSQR's per-row-block (Q, R) pair, where Q blocks are
+    ``(c, n)`` and R blocks ``(n, n)`` on the same grid.
     """
     multi = isinstance(dtype, (list, tuple))
     if multi:
@@ -366,34 +370,53 @@ def general_blockwise(
         dtypes = list(dtype)
         if not (len(shapes) == len(stores) == len(out_names) == n_out):
             raise ValueError("multi-output lists must have equal length")
-        nbs = {chunks_to_numblocks(blockdims_from_blockshape(s, to_chunksize(chunks))) for s in shapes}
+        if isinstance(chunks, list):  # per-output chunks
+            if len(chunks) != n_out:
+                raise ValueError(
+                    "per-output chunks list must have one entry per output"
+                )
+            chunks_list = [tuple(c) for c in chunks]
+        else:
+            chunks_list = [chunks] * n_out
+        chunksizes = [
+            to_chunksize(c) if s else ()
+            for c, s in zip(chunks_list, shapes)
+        ]
+        nbs = {
+            chunks_to_numblocks(blockdims_from_blockshape(s, cs))
+            for s, cs in zip(shapes, chunksizes)
+        }
         if len(nbs) != 1:
             raise ValueError(
                 "multi-output arrays must share one block grid; got "
                 f"numblocks {sorted(nbs)}"
             )
+        chunks = chunks_list[0]  # output 0 defines the mappable grid
     else:
         shapes = [tuple(shape)]
         stores = [target_store]
         out_names = [out_name or gensym("array")]
         dtypes = [dtype]
+        chunksizes = [to_chunksize(chunks) if shapes[0] else ()]
     if in_names is None:
         in_names = [f"in_{i}" for i in range(len(arrays))]
 
-    chunksize = to_chunksize(chunks) if shapes[0] else ()
+    chunksize = chunksizes[0]
     target_arrays = [
         lazy_empty(
-            s, dtype=dt, chunks=chunksize, store=st,
+            s, dtype=dt, chunks=cs, store=st,
             storage_options=storage_options,
         )
-        for s, dt, st in zip(shapes, dtypes, stores)
+        for s, dt, cs, st in zip(shapes, dtypes, chunksizes, stores)
     ]
 
     reads_map = {
         name: CubedArrayProxy(arr, _proxy_chunks(arr))
         for name, arr in zip(in_names, arrays)
     }
-    writes = [CubedArrayProxy(t, chunksize) for t in target_arrays]
+    writes = [
+        CubedArrayProxy(t, cs) for t, cs in zip(target_arrays, chunksizes)
+    ]
 
     # --- plan-time memory bound -------------------------------------------
     # Each input chunk is counted twice (storage-side buffer + backend array)
@@ -403,8 +426,8 @@ def general_blockwise(
     projected_mem = reserved_mem + extra_projected_mem
     for name, arr in zip(in_names, arrays):
         projected_mem += 2 * chunk_memory(arr.dtype, reads_map[name].chunks)
-    for dt in dtypes:
-        projected_mem += 2 * chunk_memory(dt, chunksize)
+    for dt, cs in zip(dtypes, chunksizes):
+        projected_mem += 2 * chunk_memory(dt, cs)
 
     if projected_mem > allowed_mem:
         raise ValueError(

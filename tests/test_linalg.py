@@ -1,0 +1,313 @@
+"""linalg extension namespace (beyond the reference, which has no linalg).
+
+TSQR correctness (including per-output-chunks multi-output ops), gufunc
+square-matrix ops against numpy.linalg, and composite norms/etc."""
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.array_api import linalg
+
+
+def asnp(x):
+    return np.asarray(x.compute())
+
+
+# ---------------------------------------------------------------------------
+# TSQR
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "shape,chunks",
+    [
+        ((40, 6), (10, 6)),    # even row blocks
+        ((37, 5), (10, 5)),    # ragged last block
+        ((24, 6), (24, 6)),    # single block (b == 1 shortcut)
+        ((30, 8), (10, 4)),    # chunked columns get gathered
+        ((9, 4), (2, 4)),      # row blocks smaller than n -> auto-rechunk
+    ],
+)
+def test_qr_tall(spec, shape, chunks):
+    an = np.random.default_rng(0).standard_normal(shape)
+    a = ct.from_array(an, chunks=chunks, spec=spec)
+    q, r = linalg.qr(a)
+    qn, rn = asnp(q), asnp(r)
+    n = shape[1]
+    assert qn.shape == shape and rn.shape == (n, n)
+    np.testing.assert_allclose(qn @ rn, an, atol=1e-10)
+    np.testing.assert_allclose(qn.T @ qn, np.eye(n), atol=1e-10)
+    np.testing.assert_allclose(np.triu(rn), rn, atol=1e-12)
+
+
+def test_qr_wide(spec):
+    an = np.random.default_rng(1).standard_normal((4, 9))
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    q, r = linalg.qr(a)
+    qn, rn = asnp(q), asnp(r)
+    assert qn.shape == (4, 4) and rn.shape == (4, 9)
+    np.testing.assert_allclose(qn @ rn, an, atol=1e-10)
+    np.testing.assert_allclose(qn.T @ qn, np.eye(4), atol=1e-10)
+
+
+def test_qr_batched(spec):
+    an = np.random.default_rng(2).standard_normal((3, 10, 4))
+    a = ct.from_array(an, chunks=(1, 5, 4), spec=spec)
+    q, r = linalg.qr(a)
+    qn, rn = asnp(q), asnp(r)
+    np.testing.assert_allclose(qn @ rn, an, atol=1e-10)
+
+
+def test_qr_larger_than_axis_memory(spec):
+    # 4000x16 f64 rows = 512 KB total but row-axis merged would exceed the
+    # per-task bound at tiny allowed_mem? keep it simple: many row blocks
+    an = np.random.default_rng(3).standard_normal((4000, 16))
+    a = ct.from_array(an, chunks=(250, 16), spec=spec)
+    q, r = linalg.qr(a)
+    qn, rn = asnp(q), asnp(r)
+    np.testing.assert_allclose(qn @ rn, an, atol=1e-9)
+    np.testing.assert_allclose(qn.T @ qn, np.eye(16), atol=1e-9)
+
+
+def test_svd_tall_and_wide(spec):
+    rng = np.random.default_rng(4)
+    for shape, chunks in [((40, 6), (10, 6)), ((5, 12), (5, 4))]:
+        an = rng.standard_normal(shape)
+        a = ct.from_array(an, chunks=chunks, spec=spec)
+        u, s, vh = linalg.svd(a, full_matrices=False)
+        un, sn, vhn = asnp(u), asnp(s), asnp(vh)
+        k = min(shape)
+        assert un.shape == (shape[0], k)
+        assert sn.shape == (k,)
+        assert vhn.shape == (k, shape[1])
+        np.testing.assert_allclose((un * sn) @ vhn, an, atol=1e-10)
+        np.testing.assert_allclose(
+            sn, np.linalg.svd(an, compute_uv=False), atol=1e-10
+        )
+
+
+def test_svd_full_matrices_not_implemented(spec):
+    a = ct.from_array(np.ones((4, 3)), chunks=(4, 3), spec=spec)
+    with pytest.raises(NotImplementedError):
+        linalg.svd(a)
+
+
+def test_svdvals(spec):
+    an = np.random.default_rng(5).standard_normal((30, 5))
+    a = ct.from_array(an, chunks=(10, 5), spec=spec)
+    np.testing.assert_allclose(
+        asnp(linalg.svdvals(a)), np.linalg.svd(an, compute_uv=False),
+        atol=1e-10,
+    )
+
+
+# ---------------------------------------------------------------------------
+# square per-matrix ops
+# ---------------------------------------------------------------------------
+
+
+def _spd(rng, *batch_n):
+    *batch, n = batch_n
+    m = rng.standard_normal((*batch, n, n))
+    return m @ np.swapaxes(m, -1, -2) + n * np.eye(n)
+
+
+def test_cholesky(spec):
+    an = _spd(np.random.default_rng(6), 6)
+    a = ct.from_array(an, chunks=(3, 3), spec=spec)
+    np.testing.assert_allclose(
+        asnp(linalg.cholesky(a)), np.linalg.cholesky(an), atol=1e-10
+    )
+    up = asnp(linalg.cholesky(a, upper=True))
+    np.testing.assert_allclose(up, np.linalg.cholesky(an).T, atol=1e-10)
+
+
+def test_det_slogdet_inv_solve_batched(spec):
+    rng = np.random.default_rng(7)
+    an = _spd(rng, 2, 4)  # batch of 2 SPD 4x4
+    a = ct.from_array(an, chunks=(1, 2, 2), spec=spec)
+    np.testing.assert_allclose(asnp(linalg.det(a)), np.linalg.det(an),
+                               rtol=1e-10)
+    sign, logabs = linalg.slogdet(a)
+    es, el = np.linalg.slogdet(an)
+    np.testing.assert_allclose(asnp(sign), es, atol=1e-12)
+    np.testing.assert_allclose(asnp(logabs), el, rtol=1e-10)
+    np.testing.assert_allclose(asnp(linalg.inv(a)), np.linalg.inv(an),
+                               atol=1e-10)
+    bn = rng.standard_normal((2, 4, 3))
+    b = ct.from_array(bn, chunks=(1, 4, 3), spec=spec)
+    np.testing.assert_allclose(asnp(linalg.solve(a, b)),
+                               np.linalg.solve(an, bn), atol=1e-9)
+
+
+def test_solve_vector(spec):
+    rng = np.random.default_rng(8)
+    an = _spd(rng, 5)
+    bn = rng.standard_normal(5)
+    a = ct.from_array(an, chunks=(5, 5), spec=spec)
+    b = ct.from_array(bn, chunks=(5,), spec=spec)
+    np.testing.assert_allclose(asnp(linalg.solve(a, b)),
+                               np.linalg.solve(an, bn), atol=1e-10)
+
+
+def test_eigh(spec):
+    an = _spd(np.random.default_rng(9), 5)
+    a = ct.from_array(an, chunks=(5, 5), spec=spec)
+    vals, vecs = linalg.eigh(a)
+    vn, wn = asnp(vals), asnp(vecs)
+    np.testing.assert_allclose(vn, np.linalg.eigvalsh(an), rtol=1e-10)
+    # eigenvector equation (signs may differ from numpy's)
+    np.testing.assert_allclose(an @ wn, wn * vn, atol=1e-9)
+    np.testing.assert_allclose(asnp(linalg.eigvalsh(a)), vn, rtol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# composites
+# ---------------------------------------------------------------------------
+
+
+def test_diagonal_trace(spec):
+    an = np.arange(30, dtype=np.float64).reshape(5, 6)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    for off in (0, 1, -2):
+        np.testing.assert_allclose(
+            asnp(linalg.diagonal(a, offset=off)), np.diagonal(an, offset=off)
+        )
+        np.testing.assert_allclose(
+            float(linalg.trace(a, offset=off).compute()),
+            np.trace(an, offset=off),
+        )
+
+
+def test_cross(spec):
+    rng = np.random.default_rng(10)
+    an, bn = rng.standard_normal((4, 3)), rng.standard_normal((4, 3))
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    b = ct.from_array(bn, chunks=(2, 3), spec=spec)
+    np.testing.assert_allclose(asnp(linalg.cross(a, b)), np.cross(an, bn),
+                               atol=1e-12)
+
+
+def test_matrix_power(spec):
+    an = np.random.default_rng(11).standard_normal((4, 4))
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    for p in (0, 1, 2, 3, 5):
+        np.testing.assert_allclose(
+            asnp(linalg.matrix_power(a, p)),
+            np.linalg.matrix_power(an, p), atol=1e-8,
+        )
+    np.testing.assert_allclose(
+        asnp(linalg.matrix_power(a, -2)),
+        np.linalg.matrix_power(an, -2), atol=1e-8,
+    )
+
+
+def test_matrix_norm(spec):
+    an = np.random.default_rng(12).standard_normal((6, 4))
+    a = ct.from_array(an, chunks=(3, 2), spec=spec)
+    for ordv in ("fro", 1, -1, np.inf, -np.inf, 2, -2, "nuc"):
+        np.testing.assert_allclose(
+            float(linalg.matrix_norm(a, ord=ordv).compute()),
+            np.linalg.norm(an, ord="nuc" if ordv == "nuc" else ordv),
+            rtol=1e-10,
+        )
+
+
+def test_vector_norm(spec):
+    an = np.random.default_rng(13).standard_normal((8, 5))
+    a = ct.from_array(an, chunks=(4, 5), spec=spec)
+    np.testing.assert_allclose(
+        float(linalg.vector_norm(a).compute()), np.linalg.norm(an.ravel()),
+        rtol=1e-12,
+    )
+    np.testing.assert_allclose(
+        asnp(linalg.vector_norm(a, axis=1, ord=np.inf)),
+        np.linalg.norm(an, ord=np.inf, axis=1), rtol=1e-12,
+    )
+    np.testing.assert_allclose(
+        asnp(linalg.vector_norm(a, axis=0, ord=3)),
+        np.linalg.norm(an, ord=3, axis=0), rtol=1e-10,
+    )
+
+
+def test_matrix_rank_pinv(spec):
+    rng = np.random.default_rng(14)
+    # rank-2 matrix
+    an = np.outer(rng.standard_normal(8), rng.standard_normal(5))
+    an += np.outer(rng.standard_normal(8), rng.standard_normal(5))
+    a = ct.from_array(an, chunks=(4, 5), spec=spec)
+    assert int(linalg.matrix_rank(a).compute()) == 2
+    np.testing.assert_allclose(asnp(linalg.pinv(a)), np.linalg.pinv(an),
+                               atol=1e-8)
+
+
+def test_qr_on_jax_executor(spec):
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    an = np.random.default_rng(15).standard_normal((40, 6))
+    a = ct.from_array(an, chunks=(10, 6), spec=spec)
+    q, r = linalg.qr(a)
+    qn = np.asarray(q.compute(executor=JaxExecutor()))
+    rn = np.asarray(r.compute(executor=JaxExecutor()))
+    np.testing.assert_allclose(qn @ rn, an, atol=1e-8)
+    np.testing.assert_allclose(qn.T @ qn, np.eye(6), atol=1e-8)
+
+
+def test_diagonal_with_nonfinite_and_bool(spec):
+    # off-diagonal inf/nan must not poison the diagonal (where, not mask-mul)
+    an = np.array([[1.0, np.inf], [np.nan, 4.0]])
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    np.testing.assert_allclose(asnp(linalg.diagonal(a)), [1.0, 4.0])
+    assert np.isclose(float(linalg.trace(a).compute()), 5.0)
+
+    bn = np.array([[True, False], [True, True]])
+    b = ct.from_array(bn, chunks=(2, 2), spec=spec)
+    out = asnp(linalg.diagonal(b))
+    assert out.dtype == np.bool_
+    np.testing.assert_array_equal(out, np.diagonal(bn))
+
+
+def test_svdvals_plan_never_forms_q(spec):
+    an = np.random.default_rng(16).standard_normal((40, 6))
+    a = ct.from_array(an, chunks=(10, 6), spec=spec)
+    s = linalg.svdvals(a)
+    ops = [
+        d.get("op_name", "")
+        for _, d in s.plan.dag.nodes(data=True)
+    ]
+    assert any("tsqr_panel_r" in o for o in ops)
+    assert not any(o == "tsqr_panel" or o == "tsqr_apply_q" for o in ops)
+    np.testing.assert_allclose(
+        asnp(s), np.linalg.svd(an, compute_uv=False), atol=1e-10
+    )
+
+
+def test_batched_eigh_and_svd(spec):
+    rng = np.random.default_rng(17)
+    an = _spd(rng, 3, 4)
+    a = ct.from_array(an, chunks=(2, 4, 4), spec=spec)
+    vals, vecs = linalg.eigh(a)
+    vn, wn = asnp(vals), asnp(vecs)
+    np.testing.assert_allclose(vn, np.linalg.eigvalsh(an), rtol=1e-10)
+    np.testing.assert_allclose(an @ wn, wn * vn[..., None, :], atol=1e-9)
+
+    bn = rng.standard_normal((3, 6, 4))
+    b = ct.from_array(bn, chunks=(1, 6, 4), spec=spec)
+    u, s, vh = linalg.svd(b, full_matrices=False)
+    un, sn, vhn = asnp(u), asnp(s), asnp(vh)
+    np.testing.assert_allclose((un * sn[..., None, :]) @ vhn, bn, atol=1e-10)
+
+
+def test_per_output_chunks_length_mismatch(spec):
+    from cubed_tpu.core.ops import general_blockwise
+
+    a = ct.from_array(np.ones((4, 4)), chunks=(2, 4), spec=spec)
+    with pytest.raises(ValueError, match="one entry per output"):
+        general_blockwise(
+            lambda c: (c, c), lambda k: ((a.name, *k[1:]),), a,
+            shape=[(4, 4), (4, 4)],
+            dtype=[a.dtype, a.dtype],
+            chunks=[((2, 2), (4,)), ((2, 2), (4,)), ((2, 2), (4,))],
+        )
