@@ -168,4 +168,5 @@ from .statistical_functions import (  # noqa: F401
 
 from .utility_functions import all, any, diff  # noqa: F401
 
+from . import fft  # noqa: F401  (extension namespace, beyond reference)
 from . import linalg  # noqa: F401  (extension namespace, beyond reference)

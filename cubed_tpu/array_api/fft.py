@@ -1,0 +1,265 @@
+"""Array-API ``fft`` extension namespace — beyond the reference (which has
+no fft extension; its array-api surface stops at the core functions).
+
+Chunked-transform semantics match dask's: the transform axis is rechunked
+to a single chunk (the plan-time memory bound prices that chunk, so an
+oversized axis fails loudly before anything runs) while every other axis
+stays chunked; N-d transforms apply separably, one axis at a time, so at
+most ONE axis is ever gathered per op. Per-block kernels are
+``nxp.fft.*`` calls — on the TPU executor each is one XLA FFT op that
+jits/vmaps and joins fused segments.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend_array_api import nxp
+from ..core.ops import general_blockwise, rechunk
+from .dtypes import (
+    _complex_floating_dtypes,
+    _floating_dtypes,
+    _real_floating_dtypes,
+    complex64,
+    complex128,
+    float32,
+    float64,
+)
+from .manipulation_functions import roll
+
+__all__ = [
+    "fft", "ifft", "fftn", "ifftn", "rfft", "irfft", "rfftn", "irfftn",
+    "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = (None, "backward", "ortho", "forward")
+
+
+def _complex_dtype_for(dt):
+    return complex64 if dt in (float32, complex64) else complex128
+
+
+def _real_dtype_for(dt):
+    return float32 if dt in (float32, complex64) else float64
+
+
+def _fft_axis_op(x, axis, out_len, out_dtype, kernel, op_name):
+    """Apply a per-block 1-d transform along ``axis`` (gathered to one
+    chunk); the output grid matches x's with ``axis`` re-sized."""
+    axis = axis % x.ndim
+    if len(x.chunks[axis]) > 1:
+        x = rechunk(x, {axis: x.shape[axis]})
+    out_shape = tuple(
+        out_len if d == axis else s for d, s in enumerate(x.shape)
+    )
+    out_chunks = tuple(
+        (out_len,) if d == axis else c for d, c in enumerate(x.chunks)
+    )
+    x_name = x.name
+
+    def bf(out_key):
+        return ((x_name, *out_key[1:]),)
+
+    return general_blockwise(
+        kernel, bf, x,
+        shape=out_shape,
+        dtype=np.dtype(out_dtype),
+        chunks=out_chunks,
+        op_name=op_name,
+    )
+
+
+def _check(x, fname, real_ok=True, complex_ok=True):
+    allowed = ()
+    if real_ok:
+        allowed += _real_floating_dtypes
+    if complex_ok:
+        allowed += _complex_floating_dtypes
+    if x.dtype not in allowed:
+        kinds = " or ".join(
+            k for k, ok in (("real", real_ok), ("complex", complex_ok)) if ok
+        )
+        raise TypeError(f"{fname} requires a {kinds} floating-point dtype")
+    if x.ndim == 0:
+        raise ValueError(f"{fname} requires at least 1 dimension")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(f"invalid norm: {norm!r}")
+    return norm or "backward"
+
+
+def fft(x, /, *, n=None, axis=-1, norm="backward"):
+    _check(x, "fft")
+    norm = _check_norm(norm)
+    out_n = n if n is not None else x.shape[axis % x.ndim]
+    dt = _complex_dtype_for(x.dtype)
+    return _fft_axis_op(
+        x, axis, out_n, dt,
+        lambda a: nxp.fft.fft(a, n=out_n, axis=axis, norm=norm), "fft",
+    )
+
+
+def ifft(x, /, *, n=None, axis=-1, norm="backward"):
+    _check(x, "ifft")
+    norm = _check_norm(norm)
+    out_n = n if n is not None else x.shape[axis % x.ndim]
+    dt = _complex_dtype_for(x.dtype)
+    return _fft_axis_op(
+        x, axis, out_n, dt,
+        lambda a: nxp.fft.ifft(a, n=out_n, axis=axis, norm=norm), "ifft",
+    )
+
+
+def rfft(x, /, *, n=None, axis=-1, norm="backward"):
+    _check(x, "rfft", complex_ok=False)
+    norm = _check_norm(norm)
+    in_n = n if n is not None else x.shape[axis % x.ndim]
+    out_n = in_n // 2 + 1
+    dt = _complex_dtype_for(x.dtype)
+    return _fft_axis_op(
+        x, axis, out_n, dt,
+        lambda a: nxp.fft.rfft(a, n=in_n, axis=axis, norm=norm), "rfft",
+    )
+
+
+def irfft(x, /, *, n=None, axis=-1, norm="backward"):
+    _check(x, "irfft")
+    norm = _check_norm(norm)
+    out_n = n if n is not None else 2 * (x.shape[axis % x.ndim] - 1)
+    dt = _real_dtype_for(x.dtype)
+    return _fft_axis_op(
+        x, axis, out_n, dt,
+        lambda a: nxp.fft.irfft(a, n=out_n, axis=axis, norm=norm), "irfft",
+    )
+
+
+def hfft(x, /, *, n=None, axis=-1, norm="backward"):
+    _check(x, "hfft")
+    norm = _check_norm(norm)
+    out_n = n if n is not None else 2 * (x.shape[axis % x.ndim] - 1)
+    dt = _real_dtype_for(x.dtype)
+    return _fft_axis_op(
+        x, axis, out_n, dt,
+        lambda a: nxp.fft.hfft(a, n=out_n, axis=axis, norm=norm), "hfft",
+    )
+
+
+def ihfft(x, /, *, n=None, axis=-1, norm="backward"):
+    _check(x, "ihfft", complex_ok=False)
+    norm = _check_norm(norm)
+    in_n = n if n is not None else x.shape[axis % x.ndim]
+    out_n = in_n // 2 + 1
+    dt = _complex_dtype_for(x.dtype)
+    return _fft_axis_op(
+        x, axis, out_n, dt,
+        lambda a: nxp.fft.ihfft(a, n=in_n, axis=axis, norm=norm), "ihfft",
+    )
+
+
+def _resolve_axes(x, s, axes):
+    if axes is None:
+        axes = (
+            tuple(range(x.ndim))
+            if s is None
+            else tuple(range(x.ndim - len(s), x.ndim))
+        )
+    axes = tuple(a % x.ndim for a in axes)
+    if s is None:
+        s = tuple(x.shape[a] for a in axes)
+    if len(s) != len(axes):
+        raise ValueError("s and axes must have the same length")
+    return s, axes
+
+
+def fftn(x, /, *, s=None, axes=None, norm="backward"):
+    _check(x, "fftn")
+    s, axes = _resolve_axes(x, s, axes)
+    out = x
+    for n, a in zip(s, axes):  # separable: one gathered axis per op
+        out = fft(out, n=n, axis=a, norm=norm)
+    return out
+
+
+def ifftn(x, /, *, s=None, axes=None, norm="backward"):
+    _check(x, "ifftn")
+    s, axes = _resolve_axes(x, s, axes)
+    out = x
+    for n, a in zip(s, axes):
+        out = ifft(out, n=n, axis=a, norm=norm)
+    return out
+
+
+def rfftn(x, /, *, s=None, axes=None, norm="backward"):
+    _check(x, "rfftn", complex_ok=False)
+    s, axes = _resolve_axes(x, s, axes)
+    out = rfft(x, n=s[-1], axis=axes[-1], norm=norm)
+    for n, a in zip(s[:-1], axes[:-1]):
+        out = fft(out, n=n, axis=a, norm=norm)
+    return out
+
+
+def irfftn(x, /, *, s=None, axes=None, norm="backward"):
+    _check(x, "irfftn")
+    s_given = s is not None
+    s, axes = _resolve_axes(x, s, axes)
+    if not s_given:
+        # default s: the last transformed axis inverts to 2*(m-1)
+        s = s[:-1] + (2 * (x.shape[axes[-1]] - 1),)
+    out = x
+    for n, a in zip(s[:-1], axes[:-1]):
+        out = ifft(out, n=n, axis=a, norm=norm)
+    return irfft(out, n=s[-1], axis=axes[-1], norm=norm)
+
+
+def fftfreq(n, /, *, d=1.0, dtype=None, device=None, spec=None):
+    """Sample frequencies: [0, 1, ..., (n-1)//2, -(n//2), ..., -1]/(n·d),
+    composed from chunked arange + where (no host-side materialization)."""
+    from .creation_functions import arange, asarray
+    from .elementwise_functions import divide, less, subtract
+    from .searching_functions import where
+
+    dt = np.dtype(dtype) if dtype is not None else np.dtype(np.float64)
+    if dt not in _real_floating_dtypes:
+        raise ValueError("fftfreq requires a real floating-point dtype")
+    i = arange(n, dtype=dt, spec=spec)
+    folded = where(
+        less(i, asarray((n + 1) // 2, dtype=dt, spec=spec)),
+        i,
+        subtract(i, asarray(n, dtype=dt, spec=spec)),
+    )
+    return divide(folded, asarray(n * d, dtype=dt, spec=spec))
+
+
+def rfftfreq(n, /, *, d=1.0, dtype=None, device=None, spec=None):
+    from .creation_functions import arange, asarray
+    from .elementwise_functions import divide
+
+    dt = np.dtype(dtype) if dtype is not None else np.dtype(np.float64)
+    if dt not in _real_floating_dtypes:
+        raise ValueError("rfftfreq requires a real floating-point dtype")
+    i = arange(n // 2 + 1, dtype=dt, spec=spec)
+    return divide(i, asarray(n * d, dtype=dt, spec=spec))
+
+
+def fftshift(x, /, *, axes=None):
+    if x.dtype not in _floating_dtypes:
+        raise TypeError("fftshift requires a floating-point dtype")
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    elif isinstance(axes, int):
+        axes = (axes,)
+    shift = tuple(x.shape[a % x.ndim] // 2 for a in axes)
+    return roll(x, shift, axis=tuple(a % x.ndim for a in axes))
+
+
+def ifftshift(x, /, *, axes=None):
+    if x.dtype not in _floating_dtypes:
+        raise TypeError("ifftshift requires a floating-point dtype")
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    elif isinstance(axes, int):
+        axes = (axes,)
+    shift = tuple(-(x.shape[a % x.ndim] // 2) for a in axes)
+    return roll(x, shift, axis=tuple(a % x.ndim for a in axes))

@@ -45,6 +45,7 @@ from .creation_functions import eye
 from .data_type_functions import astype, result_type
 from .dtypes import _floating_dtypes, _numeric_dtypes, float64, int64
 from .elementwise_functions import (
+    _float_of,
     abs as xp_abs,
     greater,
     multiply,
@@ -120,7 +121,7 @@ def _tsqr_row_chunks(x, n):
     return rechunk(x, {0: m})
 
 
-def _per_matrix_multi(x, kernel, shapes, chunks, op_name):
+def _per_matrix_multi(x, kernel, shapes, chunks, op_name, dtypes=None):
     """One multi-output blockwise op applying ``kernel`` to each core block
     of a single-chunk-core array over the batch grid — the decomposition
     runs ONCE per matrix and feeds every output (vs one gufunc per output
@@ -134,7 +135,7 @@ def _per_matrix_multi(x, kernel, shapes, chunks, op_name):
     return general_blockwise(
         kernel, bf, x,
         shape=shapes,
-        dtype=[x.dtype] * len(shapes),
+        dtype=list(dtypes) if dtypes else [x.dtype] * len(shapes),
         chunks=chunks,
         op_name=op_name,
     )
@@ -333,6 +334,7 @@ def svd(x, /, *, full_matrices=True):
                 _batch_chunks(xc, k, nn),
             ],
             op_name="svd_batched",
+            dtypes=[x.dtype, _float_of(x.dtype), x.dtype],
         )
         return SVDResult(u, squeeze(s2d, axis=-2), vh)
 
@@ -352,7 +354,7 @@ def svd(x, /, *, full_matrices=True):
         u_r, s2d, vh = general_blockwise(
             _svd_r, bf_svd, r,
             shape=[(n, n), (1, n), (n, n)],
-            dtype=[dt, dt, dt],
+            dtype=[dt, _float_of(dt), dt],
             chunks=[((n,), (n,)), ((1,), (n,)), ((n,), (n,))],
             op_name="svd_of_r",
         )
@@ -372,7 +374,7 @@ def svd(x, /, *, full_matrices=True):
     u, s2d, vh = general_blockwise(
         _svd_block, bf_wide, x1,
         shape=[(m, k), (1, k), (k, n)],
-        dtype=[dt, dt, dt],
+        dtype=[dt, _float_of(dt), dt],
         chunks=[((m,), (k,)), ((1,), (k,)), ((k,), (n,))],
         op_name="svd_single",
     )
@@ -393,7 +395,8 @@ def svdvals(x, /):
         target = _single_chunk_core(x)
     return apply_gufunc(
         lambda a: nxp.linalg.svd(a, compute_uv=False),
-        "(i,j)->(k)", target, output_dtypes=x.dtype, output_sizes={"k": k},
+        "(i,j)->(k)", target, output_dtypes=_float_of(x.dtype),
+        output_sizes={"k": k},
     )
 
 
@@ -436,7 +439,7 @@ def slogdet(x, /):
 
     sign, logabs = apply_gufunc(
         _slogdet, "(i,j)->(),()", _single_chunk_core(x),
-        output_dtypes=[x.dtype, x.dtype],
+        output_dtypes=[x.dtype, _float_of(x.dtype)],
     )
     return SlogdetResult(sign, logabs)
 
@@ -480,6 +483,7 @@ def eigh(x, /):
         shapes=[(*batch, 1, n), (*batch, n, n)],
         chunks=[_batch_chunks(xc, 1, n), _batch_chunks(xc, n, n)],
         op_name="eigh",
+        dtypes=[_float_of(x.dtype), x.dtype],
     )
     return EighResult(squeeze(vals2d, axis=-2), vecs)
 
@@ -489,7 +493,7 @@ def eigvalsh(x, /):
     _require_square(x, "eigvalsh")
     return apply_gufunc(
         lambda a: nxp.linalg.eigvalsh(a), "(i,j)->(i)",
-        _single_chunk_core(x), output_dtypes=x.dtype,
+        _single_chunk_core(x), output_dtypes=_float_of(x.dtype),
     )
 
 
@@ -531,11 +535,9 @@ def diagonal(x, /, *, offset=0):
     if x.ndim < 2:
         raise ValueError("diagonal requires at least 2 dimensions")
     n, m = x.shape[-2], x.shape[-1]
-    d = min(n, m - offset) if offset >= 0 else min(n + offset, m)
-    if d <= 0:
-        raise ValueError(
-            f"offset {offset} is out of bounds for shape {(n, m)}"
-        )
+    # out-of-range offsets yield an empty diagonal (numpy convention —
+    # trace of such an offset is then 0, not an error)
+    d = max(0, min(n, m - offset) if offset >= 0 else min(n + offset, m))
     from .creation_functions import asarray
     from .dtypes import bool as xp_bool
     from .searching_functions import where
@@ -558,10 +560,7 @@ def diagonal(x, /, *, offset=0):
 def trace(x, /, *, offset=0, dtype=None):
     if x.dtype not in _numeric_dtypes:
         raise TypeError("Only numeric dtypes are allowed in trace")
-    out = xp_sum(diagonal(x, offset=offset), axis=-1, dtype=dtype)
-    if dtype is not None:
-        out = astype(out, dtype)
-    return out
+    return xp_sum(diagonal(x, offset=offset), axis=-1, dtype=dtype)
 
 
 def cross(x1, x2, /, *, axis=-1):
@@ -619,7 +618,8 @@ def vector_norm(x, /, *, axis=None, keepdims=False, ord=2):
         from .searching_functions import count_nonzero
 
         return astype(
-            count_nonzero(x, axis=axis, keepdims=keepdims), x.dtype
+            count_nonzero(x, axis=axis, keepdims=keepdims),
+            _float_of(x.dtype),
         )
     if ord == 2:
         return sqrt(xp_sum(square(xp_abs(x)), axis=axis, keepdims=keepdims))

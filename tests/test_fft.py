@@ -1,0 +1,148 @@
+"""fft extension namespace (beyond the reference): chunked transforms with
+the dask semantics — the transform axis gathers to one chunk, other axes
+stay chunked; N-d transforms are separable (one gathered axis per op)."""
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+from cubed_tpu.array_api import fft
+
+
+def asnp(x):
+    return np.asarray(x.compute())
+
+
+def test_fft_ifft_roundtrip(spec):
+    an = np.random.default_rng(0).standard_normal((6, 32))
+    a = ct.from_array(an, chunks=(2, 8), spec=spec)  # chunked transform axis
+    f = fft.fft(a)
+    np.testing.assert_allclose(asnp(f), np.fft.fft(an), atol=1e-10)
+    np.testing.assert_allclose(asnp(fft.ifft(f)), an, atol=1e-10)
+
+
+def test_fft_other_axes_stay_chunked(spec):
+    an = np.random.default_rng(1).standard_normal((8, 16))
+    a = ct.from_array(an, chunks=(2, 4), spec=spec)
+    f = fft.fft(a, axis=1)
+    assert f.numblocks[0] == 4  # rows still chunked
+    np.testing.assert_allclose(asnp(f), np.fft.fft(an, axis=1), atol=1e-10)
+
+
+def test_fft_n_pad_truncate(spec):
+    an = np.random.default_rng(2).standard_normal((4, 10))
+    a = ct.from_array(an, chunks=(2, 5), spec=spec)
+    for n in (6, 16):
+        np.testing.assert_allclose(
+            asnp(fft.fft(a, n=n)), np.fft.fft(an, n=n), atol=1e-10
+        )
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_norms(spec, norm):
+    an = np.random.default_rng(3).standard_normal(24)
+    a = ct.from_array(an, chunks=(8,), spec=spec)
+    np.testing.assert_allclose(
+        asnp(fft.fft(a, norm=norm)), np.fft.fft(an, norm=norm), atol=1e-10
+    )
+
+
+def test_rfft_irfft(spec):
+    an = np.random.default_rng(4).standard_normal((3, 20))
+    a = ct.from_array(an, chunks=(1, 5), spec=spec)
+    r = fft.rfft(a)
+    assert r.shape == (3, 11)
+    np.testing.assert_allclose(asnp(r), np.fft.rfft(an), atol=1e-10)
+    np.testing.assert_allclose(asnp(fft.irfft(r)), an, atol=1e-10)
+    np.testing.assert_allclose(
+        asnp(fft.irfft(r, n=20)), np.fft.irfft(np.fft.rfft(an), n=20),
+        atol=1e-10,
+    )
+
+
+def test_hfft_ihfft(spec):
+    an = np.random.default_rng(5).standard_normal(9)
+    a = ct.from_array(an, chunks=(3,), spec=spec)
+    h = fft.ihfft(a)
+    np.testing.assert_allclose(asnp(h), np.fft.ihfft(an), atol=1e-12)
+    np.testing.assert_allclose(
+        asnp(fft.hfft(h, n=9)), np.fft.hfft(np.fft.ihfft(an), n=9),
+        atol=1e-10,
+    )
+
+
+def test_fftn_separable(spec):
+    an = np.random.default_rng(6).standard_normal((8, 12, 6))
+    a = ct.from_array(an, chunks=(2, 3, 2), spec=spec)
+    np.testing.assert_allclose(asnp(fft.fftn(a)), np.fft.fftn(an), atol=1e-9)
+    np.testing.assert_allclose(
+        asnp(fft.ifftn(fft.fftn(a))), an, atol=1e-9
+    )
+    np.testing.assert_allclose(
+        asnp(fft.fftn(a, axes=(0, 2))), np.fft.fftn(an, axes=(0, 2)),
+        atol=1e-9,
+    )
+    np.testing.assert_allclose(
+        asnp(fft.fftn(a, s=(4, 8), axes=(1, 2))),
+        np.fft.fftn(an, s=(4, 8), axes=(1, 2)), atol=1e-9,
+    )
+
+
+def test_rfftn_irfftn(spec):
+    an = np.random.default_rng(7).standard_normal((6, 10))
+    a = ct.from_array(an, chunks=(2, 5), spec=spec)
+    np.testing.assert_allclose(asnp(fft.rfftn(a)), np.fft.rfftn(an),
+                               atol=1e-10)
+    np.testing.assert_allclose(
+        asnp(fft.irfftn(fft.rfftn(a))), an, atol=1e-10
+    )
+
+
+def test_fftfreq_rfftfreq(spec):
+    for n in (8, 9):
+        np.testing.assert_allclose(
+            asnp(fft.fftfreq(n, spec=spec)), np.fft.fftfreq(n), atol=1e-15
+        )
+        np.testing.assert_allclose(
+            asnp(fft.fftfreq(n, d=0.25, spec=spec)),
+            np.fft.fftfreq(n, d=0.25), atol=1e-15,
+        )
+        np.testing.assert_allclose(
+            asnp(fft.rfftfreq(n, spec=spec)), np.fft.rfftfreq(n), atol=1e-15
+        )
+
+
+def test_fftshift_roundtrip(spec):
+    an = np.random.default_rng(8).standard_normal((5, 8))
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    np.testing.assert_allclose(asnp(fft.fftshift(a)), np.fft.fftshift(an))
+    np.testing.assert_allclose(
+        asnp(fft.ifftshift(fft.fftshift(a))), an
+    )
+    np.testing.assert_allclose(
+        asnp(fft.fftshift(a, axes=1)), np.fft.fftshift(an, axes=1)
+    )
+
+
+def test_fft_dtype_rules(spec):
+    a32 = ct.from_array(np.ones((4,), np.float32), chunks=(4,), spec=spec)
+    assert fft.fft(a32).dtype == np.complex64
+    assert fft.rfft(a32).dtype == np.complex64
+    a64 = ct.from_array(np.ones((4,), np.float64), chunks=(4,), spec=spec)
+    assert fft.fft(a64).dtype == np.complex128
+    c = fft.fft(a64)
+    assert fft.irfft(c).dtype == np.float64
+    ai = ct.from_array(np.ones((4,), np.int32), chunks=(4,), spec=spec)
+    with pytest.raises(TypeError):
+        fft.fft(ai)
+    with pytest.raises(ValueError):
+        fft.fft(a64, norm="bogus")
+
+
+def test_fft_on_jax_executor(spec):
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    an = np.random.default_rng(9).standard_normal((4, 16))
+    a = ct.from_array(an, chunks=(2, 4), spec=spec)
+    out = fft.ifft(fft.fft(a)).compute(executor=JaxExecutor())
+    np.testing.assert_allclose(np.asarray(out), an, atol=1e-8)

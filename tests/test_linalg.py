@@ -311,3 +311,38 @@ def test_per_output_chunks_length_mismatch(spec):
             dtype=[a.dtype, a.dtype],
             chunks=[((2, 2), (4,)), ((2, 2), (4,)), ((2, 2), (4,))],
         )
+
+
+def test_complex_dtype_results_are_real_where_spec_says(spec):
+    rng = np.random.default_rng(18)
+    an = (rng.standard_normal((6, 4)) + 1j * rng.standard_normal((6, 4))).astype(
+        np.complex64
+    )
+    a = ct.from_array(an, chunks=(3, 4), spec=spec)
+    s = linalg.svdvals(a)
+    assert s.dtype == np.float32
+    np.testing.assert_allclose(
+        asnp(s), np.linalg.svd(an, compute_uv=False), atol=1e-4
+    )
+    assert int(linalg.matrix_rank(a).compute()) == 4  # consumes real S
+
+    # hermitian complex: real eigenvalues / logabsdet
+    hn = (an[:4] @ an[:4].conj().T + 6 * np.eye(4)).astype(np.complex64)
+    h = ct.from_array(hn, chunks=(4, 4), spec=spec)
+    vals, vecs = linalg.eigh(h)
+    assert vals.dtype == np.float32 and vecs.dtype == np.complex64
+    np.testing.assert_allclose(asnp(vals), np.linalg.eigvalsh(hn), rtol=1e-4)
+    sign, logabs = linalg.slogdet(h)
+    assert logabs.dtype == np.float32
+    np.testing.assert_allclose(
+        float(logabs.compute()), np.linalg.slogdet(hn)[1], rtol=1e-5
+    )
+    assert linalg.vector_norm(a, ord=0).dtype == np.float32
+
+
+def test_diagonal_out_of_range_offset_is_empty(spec):
+    an = np.ones((3, 4))
+    a = ct.from_array(an, chunks=(3, 4), spec=spec)
+    out = asnp(linalg.diagonal(a, offset=10))
+    assert out.shape == (0,)
+    assert float(linalg.trace(a, offset=10).compute()) == 0.0
