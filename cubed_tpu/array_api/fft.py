@@ -90,8 +90,18 @@ def _check_norm(norm):
     return norm or "backward"
 
 
+def _check_axis(x, axis, fname):
+    if not -x.ndim <= axis < x.ndim:
+        raise IndexError(
+            f"{fname}: axis {axis} is out of bounds for array of "
+            f"dimension {x.ndim}"
+        )
+    return axis
+
+
 def fft(x, /, *, n=None, axis=-1, norm="backward"):
     _check(x, "fft")
+    _check_axis(x, axis, "fft")
     norm = _check_norm(norm)
     out_n = n if n is not None else x.shape[axis % x.ndim]
     dt = _complex_dtype_for(x.dtype)
@@ -103,6 +113,7 @@ def fft(x, /, *, n=None, axis=-1, norm="backward"):
 
 def ifft(x, /, *, n=None, axis=-1, norm="backward"):
     _check(x, "ifft")
+    _check_axis(x, axis, "ifft")
     norm = _check_norm(norm)
     out_n = n if n is not None else x.shape[axis % x.ndim]
     dt = _complex_dtype_for(x.dtype)
@@ -114,6 +125,7 @@ def ifft(x, /, *, n=None, axis=-1, norm="backward"):
 
 def rfft(x, /, *, n=None, axis=-1, norm="backward"):
     _check(x, "rfft", complex_ok=False)
+    _check_axis(x, axis, "rfft")
     norm = _check_norm(norm)
     in_n = n if n is not None else x.shape[axis % x.ndim]
     out_n = in_n // 2 + 1
@@ -126,6 +138,7 @@ def rfft(x, /, *, n=None, axis=-1, norm="backward"):
 
 def irfft(x, /, *, n=None, axis=-1, norm="backward"):
     _check(x, "irfft")
+    _check_axis(x, axis, "irfft")
     norm = _check_norm(norm)
     out_n = n if n is not None else 2 * (x.shape[axis % x.ndim] - 1)
     dt = _real_dtype_for(x.dtype)
@@ -137,6 +150,7 @@ def irfft(x, /, *, n=None, axis=-1, norm="backward"):
 
 def hfft(x, /, *, n=None, axis=-1, norm="backward"):
     _check(x, "hfft")
+    _check_axis(x, axis, "hfft")
     norm = _check_norm(norm)
     out_n = n if n is not None else 2 * (x.shape[axis % x.ndim] - 1)
     dt = _real_dtype_for(x.dtype)
@@ -148,6 +162,7 @@ def hfft(x, /, *, n=None, axis=-1, norm="backward"):
 
 def ihfft(x, /, *, n=None, axis=-1, norm="backward"):
     _check(x, "ihfft", complex_ok=False)
+    _check_axis(x, axis, "ihfft")
     norm = _check_norm(norm)
     in_n = n if n is not None else x.shape[axis % x.ndim]
     out_n = in_n // 2 + 1
@@ -160,11 +175,13 @@ def ihfft(x, /, *, n=None, axis=-1, norm="backward"):
 
 def _resolve_axes(x, s, axes):
     if axes is None:
+        # numpy's convention: s without axes means the LAST len(s) axes,
+        # expressed negatively so an over-long s lands out of bounds below
         axes = (
-            tuple(range(x.ndim))
-            if s is None
-            else tuple(range(x.ndim - len(s), x.ndim))
+            tuple(range(x.ndim)) if s is None else tuple(range(-len(s), 0))
         )
+    for a in axes:
+        _check_axis(x, a, "fftn")
     axes = tuple(a % x.ndim for a in axes)
     if s is None:
         s = tuple(x.shape[a] for a in axes)
@@ -250,8 +267,11 @@ def fftshift(x, /, *, axes=None):
         axes = tuple(range(x.ndim))
     elif isinstance(axes, int):
         axes = (axes,)
-    shift = tuple(x.shape[a % x.ndim] // 2 for a in axes)
-    return roll(x, shift, axis=tuple(a % x.ndim for a in axes))
+    out = x
+    for a in axes:
+        _check_axis(x, a, "fftshift")
+        out = roll(out, x.shape[a % x.ndim] // 2, axis=a % x.ndim)
+    return out
 
 
 def ifftshift(x, /, *, axes=None):
@@ -261,5 +281,8 @@ def ifftshift(x, /, *, axes=None):
         axes = tuple(range(x.ndim))
     elif isinstance(axes, int):
         axes = (axes,)
-    shift = tuple(-(x.shape[a % x.ndim] // 2) for a in axes)
-    return roll(x, shift, axis=tuple(a % x.ndim for a in axes))
+    out = x
+    for a in axes:
+        _check_axis(x, a, "ifftshift")
+        out = roll(out, -(x.shape[a % x.ndim] // 2), axis=a % x.ndim)
+    return out

@@ -626,10 +626,14 @@ def vector_norm(x, /, *, axis=None, keepdims=False, ord=2):
     p = float(ord)
     from .creation_functions import asarray
 
-    powed = xp_pow(xp_abs(x), asarray(p, dtype=x.dtype, spec=x.spec))
+    # exponents carry the REAL counterpart dtype: abs() already demoted
+    # complex input, and a complex-dtyped constant would promote the whole
+    # chain back to complex
+    rd = _float_of(x.dtype)
+    powed = xp_pow(xp_abs(x), asarray(p, dtype=rd, spec=x.spec))
     return xp_pow(
         xp_sum(powed, axis=axis, keepdims=keepdims),
-        asarray(1.0 / p, dtype=x.dtype, spec=x.spec),
+        asarray(1.0 / p, dtype=rd, spec=x.spec),
     )
 
 

@@ -146,3 +146,21 @@ def test_fft_on_jax_executor(spec):
     a = ct.from_array(an, chunks=(2, 4), spec=spec)
     out = fft.ifft(fft.fft(a)).compute(executor=JaxExecutor())
     np.testing.assert_allclose(np.asarray(out), an, atol=1e-8)
+
+
+def test_axis_and_s_validation(spec):
+    a = ct.from_array(np.ones(8), chunks=(4,), spec=spec)
+    with pytest.raises(IndexError):
+        fft.fft(a, axis=3)
+    with pytest.raises(IndexError):
+        fft.fftn(a, s=(4, 4))  # more transform axes than dimensions
+    with pytest.raises(IndexError):
+        fft.fftshift(a, axes=2)
+
+
+def test_fftshift_repeated_axes(spec):
+    an = np.arange(5.0)
+    a = ct.from_array(an, chunks=(5,), spec=spec)
+    np.testing.assert_allclose(
+        asnp(fft.fftshift(a, axes=(0, 0))), np.fft.fftshift(an, axes=(0, 0))
+    )

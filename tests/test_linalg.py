@@ -346,3 +346,13 @@ def test_diagonal_out_of_range_offset_is_empty(spec):
     out = asnp(linalg.diagonal(a, offset=10))
     assert out.shape == (0,)
     assert float(linalg.trace(a, offset=10).compute()) == 0.0
+
+
+def test_vector_norm_complex_p_is_real(spec):
+    an = (np.ones(4) + 1j * np.ones(4)).astype(np.complex64)
+    a = ct.from_array(an, chunks=(4,), spec=spec)
+    out = linalg.vector_norm(a, ord=3)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(
+        float(out.compute()), np.linalg.norm(an, ord=3), rtol=1e-5
+    )
