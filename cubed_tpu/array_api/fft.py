@@ -173,7 +173,7 @@ def ihfft(x, /, *, n=None, axis=-1, norm="backward"):
     )
 
 
-def _resolve_axes(x, s, axes):
+def _resolve_axes(x, s, axes, fname):
     if axes is None:
         # numpy's convention: s without axes means the LAST len(s) axes,
         # expressed negatively so an over-long s lands out of bounds below
@@ -181,7 +181,7 @@ def _resolve_axes(x, s, axes):
             tuple(range(x.ndim)) if s is None else tuple(range(-len(s), 0))
         )
     for a in axes:
-        _check_axis(x, a, "fftn")
+        _check_axis(x, a, fname)
     axes = tuple(a % x.ndim for a in axes)
     if s is None:
         s = tuple(x.shape[a] for a in axes)
@@ -192,7 +192,7 @@ def _resolve_axes(x, s, axes):
 
 def fftn(x, /, *, s=None, axes=None, norm="backward"):
     _check(x, "fftn")
-    s, axes = _resolve_axes(x, s, axes)
+    s, axes = _resolve_axes(x, s, axes, "fftn")
     out = x
     for n, a in zip(s, axes):  # separable: one gathered axis per op
         out = fft(out, n=n, axis=a, norm=norm)
@@ -201,7 +201,7 @@ def fftn(x, /, *, s=None, axes=None, norm="backward"):
 
 def ifftn(x, /, *, s=None, axes=None, norm="backward"):
     _check(x, "ifftn")
-    s, axes = _resolve_axes(x, s, axes)
+    s, axes = _resolve_axes(x, s, axes, "ifftn")
     out = x
     for n, a in zip(s, axes):
         out = ifft(out, n=n, axis=a, norm=norm)
@@ -210,7 +210,7 @@ def ifftn(x, /, *, s=None, axes=None, norm="backward"):
 
 def rfftn(x, /, *, s=None, axes=None, norm="backward"):
     _check(x, "rfftn", complex_ok=False)
-    s, axes = _resolve_axes(x, s, axes)
+    s, axes = _resolve_axes(x, s, axes, "rfftn")
     out = rfft(x, n=s[-1], axis=axes[-1], norm=norm)
     for n, a in zip(s[:-1], axes[:-1]):
         out = fft(out, n=n, axis=a, norm=norm)
@@ -220,7 +220,7 @@ def rfftn(x, /, *, s=None, axes=None, norm="backward"):
 def irfftn(x, /, *, s=None, axes=None, norm="backward"):
     _check(x, "irfftn")
     s_given = s is not None
-    s, axes = _resolve_axes(x, s, axes)
+    s, axes = _resolve_axes(x, s, axes, "irfftn")
     if not s_given:
         # default s: the last transformed axis inverts to 2*(m-1)
         s = s[:-1] + (2 * (x.shape[axes[-1]] - 1),)

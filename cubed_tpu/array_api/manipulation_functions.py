@@ -378,7 +378,11 @@ def roll(x, /, shift, *, axis=None):
         axis = (int(axis),)
     if len(shift) != len(axis):
         raise ValueError("shift and axis must have the same length")
-    shifts = {ax % x.ndim: int(s) for ax, s in zip(axis, shift)}
+    # repeated axes accumulate (numpy convention): roll(x, (1, 1), (0, 0))
+    # shifts axis 0 by 2
+    shifts: dict = {}
+    for ax, s in zip(axis, shift):
+        shifts[ax % x.ndim] = shifts.get(ax % x.ndim, 0) + int(s)
 
     out = x
     for ax, s in sorted(shifts.items()):

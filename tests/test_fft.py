@@ -164,3 +164,14 @@ def test_fftshift_repeated_axes(spec):
     np.testing.assert_allclose(
         asnp(fft.fftshift(a, axes=(0, 0))), np.fft.fftshift(an, axes=(0, 0))
     )
+
+
+def test_roll_repeated_axes_accumulate(spec):
+    import cubed_tpu.array_api as xp
+
+    an = np.arange(5.0)
+    a = ct.from_array(an, chunks=(5,), spec=spec)
+    np.testing.assert_allclose(
+        asnp(xp.roll(a, (1, 1), axis=(0, 0))),
+        np.roll(an, (1, 1), axis=(0, 0)),
+    )
