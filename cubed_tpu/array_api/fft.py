@@ -60,11 +60,18 @@ def _fft_axis_op(x, axis, out_len, out_dtype, kernel, op_name):
     def bf(out_key):
         return ((x_name, *out_key[1:]),)
 
+    # fusable=False: XLA:CPU's fft thunk RET_CHECKs a dim0-major input
+    # layout (fft_thunk.cc:167) and a fused producer (e.g. ifft(fft(x))
+    # in one segment) can hand it a transposed layout — observed on a
+    # 4-device virtual mesh. Standalone programs always see default
+    # layouts; the transform is compute-dominated, so the lost
+    # elementwise fusion is noise.
     return general_blockwise(
         kernel, bf, x,
         shape=out_shape,
         dtype=np.dtype(out_dtype),
         chunks=out_chunks,
+        fusable=False,
         op_name=op_name,
     )
 
