@@ -1,0 +1,80 @@
+"""Out-of-core least squares + spectral filtering on chunked arrays.
+
+Demonstrates the two extension namespaces the reference lacks:
+
+1. ``xp.linalg.qr`` — TSQR over row-chunked data: solve a least-squares
+   problem whose row dimension never has to fit in one task.
+2. ``xp.fft`` — band-pass filter a batch of signals; the transform axis
+   gathers to one chunk, the batch axis stays chunked.
+
+Run: ``python examples/linalg_fft.py`` (any executor; pass ``--tpu`` to
+use the JaxExecutor).
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import cubed_tpu as ct  # noqa: E402
+import cubed_tpu.array_api as xp  # noqa: E402
+
+
+def main() -> None:
+    executor = None
+    if "--tpu" in sys.argv:
+        from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+        executor = JaxExecutor()
+    kw = {"executor": executor} if executor else {}
+
+    spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="500MB")
+    rng = np.random.default_rng(0)
+
+    # --- least squares via TSQR -------------------------------------------
+    n_obs, n_feat = 20_000, 12
+    X_np = rng.standard_normal((n_obs, n_feat))
+    beta_true = rng.standard_normal(n_feat)
+    y_np = X_np @ beta_true + 0.01 * rng.standard_normal(n_obs)
+
+    X = ct.from_array(X_np, chunks=(2_500, n_feat), spec=spec)
+    y = ct.from_array(y_np.reshape(-1, 1), chunks=(2_500, 1), spec=spec)
+
+    Q, R = xp.linalg.qr(X)  # 8 row panels; Q never lives in one task
+    beta = xp.linalg.solve(R, xp.matmul(xp.matrix_transpose(Q), y))
+    beta_hat = np.asarray(beta.compute(**kw)).ravel()
+    err = float(np.max(np.abs(beta_hat - beta_true)))
+    print(f"TSQR least squares: max |beta - beta_true| = {err:.2e}")
+    assert err < 0.01
+
+    # --- spectral band-pass over a chunked batch --------------------------
+    n_sig, n_t = 64, 1024
+    t = np.arange(n_t) / n_t
+    clean = np.sin(2 * np.pi * 12 * t)  # 12-cycle tone
+    noisy = clean + rng.standard_normal((n_sig, n_t))
+
+    sig = ct.from_array(noisy, chunks=(16, 256), spec=spec)
+    F = xp.fft.rfft(sig)  # batch stays chunked; time axis gathers
+    freqs = np.fft.rfftfreq(n_t, d=1 / n_t)
+    keep = ((freqs > 8) & (freqs < 16)).astype(np.complex128)
+    mask = ct.from_array(
+        np.broadcast_to(keep, (n_sig, freqs.size)).copy(),
+        chunks=(16, freqs.size),
+        spec=spec,
+    )
+    filtered = xp.fft.irfft(xp.multiply(F, mask), n=n_t)
+    out = np.asarray(filtered.compute(**kw))
+    corr = float(
+        np.mean(
+            [np.corrcoef(out[i], clean)[0, 1] for i in range(n_sig)]
+        )
+    )
+    print(f"band-pass: mean corr(filtered, clean tone) = {corr:.3f}")
+    assert corr > 0.9
+
+
+if __name__ == "__main__":
+    main()
