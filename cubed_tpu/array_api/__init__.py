@@ -170,3 +170,11 @@ from .utility_functions import all, any, diff  # noqa: F401
 
 from . import fft  # noqa: F401  (extension namespace, beyond reference)
 from . import linalg  # noqa: F401  (extension namespace, beyond reference)
+from .searching_functions import nonzero  # noqa: F401  (loud rejection)
+from .set_functions import (  # noqa: F401  (loud rejections)
+    unique_all,
+    unique_counts,
+    unique_inverse,
+    unique_values,
+)
+from .creation_functions import from_dlpack  # noqa: F401

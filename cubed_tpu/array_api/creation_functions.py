@@ -291,3 +291,33 @@ def _like_args(x, dtype=None, chunks=None, spec=None):
     if spec is None:
         spec = x.spec
     return dict(shape=x.shape, dtype=dtype, chunks=chunks, spec=spec)
+
+
+def from_dlpack(x, /, *, device=None, copy=None, chunks="auto", spec=None):
+    """Construct a chunked array from any DLPack-exporting object (torch
+    CPU tensors, jax arrays, numpy arrays, ...). The reference lists this
+    as a known gap (reference api_status.md); here it lands as a host
+    import through ``asarray``.
+
+    The import always COPIES: a lazy plan may compute long after the
+    exporter mutates its buffer, so aliasing semantics would corrupt
+    results; ``copy=False`` is therefore rejected."""
+    if not hasattr(x, "__dlpack__"):
+        raise TypeError(
+            f"from_dlpack requires an object with __dlpack__; got "
+            f"{type(x).__name__}"
+        )
+    if copy is False:
+        raise ValueError(
+            "from_dlpack(copy=False) is not supported: chunked arrays "
+            "always import host data by copy (the plan may compute after "
+            "the exporter's buffer changes)"
+        )
+    if device is not None:
+        raise ValueError(
+            "from_dlpack(device=...) is not supported: arrays are placed "
+            "by the executor at compute time"
+        )
+    return asarray(
+        np.array(np.from_dlpack(x), copy=True), chunks=chunks, spec=spec
+    )

@@ -60,3 +60,17 @@ def count_nonzero(x, /, *, axis=None, keepdims=False, split_every=None):
         astype(mask, int64), axis=axis, keepdims=keepdims,
         split_every=split_every,
     )
+
+
+def nonzero(x, /):
+    """Rejected by design, with an actionable message: the output shape
+    depends on the DATA, which cannot exist in a statically-shaped lazy
+    plan (the reference omits the function entirely and CI-skips it;
+    this build rejects it loudly). ``where``/``count_nonzero`` cover the
+    static-shape uses."""
+    raise NotImplementedError(
+        "nonzero has a data-dependent output shape, which a lazy, "
+        "statically-shaped plan cannot express. Use where(cond, a, b) "
+        "for selection, count_nonzero for counting, or compute the "
+        "array and call numpy's nonzero on the result."
+    )

@@ -1,0 +1,31 @@
+"""Set functions — rejected by design, loudly.
+
+Every ``unique_*`` function has a data-dependent output shape, which a
+lazy, statically-shaped plan cannot express (the reference omits the
+whole module and CI-skips it: reference .github/workflows/
+array-api-tests.yml skip list). Raising with an actionable message beats
+an AttributeError mid-pipeline.
+"""
+
+_MSG = (
+    "{name} has a data-dependent output shape, which a lazy, statically-"
+    "shaped plan cannot express. Compute the array first and use numpy's "
+    "unique on the result, or express the computation with sort/"
+    "searchsorted/count_nonzero, whose shapes are static."
+)
+
+
+def unique_all(x, /):
+    raise NotImplementedError(_MSG.format(name="unique_all"))
+
+
+def unique_counts(x, /):
+    raise NotImplementedError(_MSG.format(name="unique_counts"))
+
+
+def unique_inverse(x, /):
+    raise NotImplementedError(_MSG.format(name="unique_inverse"))
+
+
+def unique_values(x, /):
+    raise NotImplementedError(_MSG.format(name="unique_values"))

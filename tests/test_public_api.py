@@ -78,3 +78,33 @@ def test_array_api_superset_of_reference():
 def test_array_api_extensions_present():
     missing = {n for n in EXTENSION_ARRAY_API if not hasattr(xp, n)}
     assert not missing, sorted(missing)
+
+
+def test_from_dlpack_and_loud_rejections():
+    import numpy as np
+    import pytest
+
+    a = xp.from_dlpack(np.arange(6.0))
+    assert a.shape == (6,)
+    with pytest.raises(NotImplementedError, match="data-dependent"):
+        xp.nonzero(a)
+    for fn in (xp.unique_all, xp.unique_counts, xp.unique_inverse,
+               xp.unique_values):
+        with pytest.raises(NotImplementedError, match="data-dependent"):
+            fn(a)
+
+
+def test_from_dlpack_copies():
+    import numpy as np
+
+    src = np.arange(4.0)
+    a = xp.from_dlpack(src)
+    src *= 0  # mutate the exporter AFTER import, BEFORE compute
+    np.testing.assert_allclose(np.asarray(a.compute()), [0.0, 1.0, 2.0, 3.0])
+
+    import pytest
+
+    with pytest.raises(ValueError, match="copy"):
+        xp.from_dlpack(np.ones(3), copy=False)
+    with pytest.raises(ValueError, match="device"):
+        xp.from_dlpack(np.ones(3), device="tpu")
