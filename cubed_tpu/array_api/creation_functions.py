@@ -318,6 +318,11 @@ def from_dlpack(x, /, *, device=None, copy=None, chunks="auto", spec=None):
             "from_dlpack(device=...) is not supported: arrays are placed "
             "by the executor at compute time"
         )
-    return asarray(
-        np.array(np.from_dlpack(x), copy=True), chunks=chunks, spec=spec
-    )
+    try:
+        host = np.from_dlpack(x)
+    except BufferError:
+        # some exporters refuse read-only buffers (DLPack cannot signal
+        # readonly); the import copies unconditionally, so a plain host
+        # conversion is just as safe
+        host = np.asarray(x)
+    return asarray(np.array(host, copy=True), chunks=chunks, spec=spec)

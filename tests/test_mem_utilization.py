@@ -43,6 +43,10 @@ OPS = {
     "concat": lambda a, b: xp.concat([a, b], axis=0),
     "stack": lambda a, b: xp.stack([a, b], axis=0),
     "reshape": lambda a, b: xp.reshape(a, (a.shape[0] * a.shape[1],)),
+    "sort_axis": lambda a, b: xp.sort(a, axis=1),
+    "qr_q": lambda a, b: xp.linalg.qr(a).Q,
+    "svdvals": lambda a, b: xp.linalg.svdvals(a),
+    "fft_abs": lambda a, b: xp.abs(xp.fft.fft(a, axis=1)),
 }
 
 

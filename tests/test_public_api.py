@@ -108,3 +108,13 @@ def test_from_dlpack_copies():
         xp.from_dlpack(np.ones(3), copy=False)
     with pytest.raises(ValueError, match="device"):
         xp.from_dlpack(np.ones(3), device="tpu")
+
+
+def test_from_dlpack_readonly_exporter():
+    import numpy as np
+
+    src = np.arange(4.0)
+    src.flags.writeable = False
+    np.testing.assert_allclose(
+        np.asarray(xp.from_dlpack(src).compute()), [0.0, 1.0, 2.0, 3.0]
+    )
