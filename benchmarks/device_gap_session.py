@@ -42,6 +42,44 @@ import bench  # noqa: E402  (repo root on path)
 from device_session import THREEFRY_AB, V5E_BF16_PEAK_GFLOPS, record  # noqa: E402
 from tunnel_probe import probe  # noqa: E402
 
+
+def _parse_json_lines(text: str) -> list:
+    """Every parseable JSON line in ``text`` — a truncated trailing line
+    (crash/OOM mid-print) is skipped, never fatal."""
+    out = []
+    for ln in text.strip().splitlines():
+        if ln.startswith("{"):
+            try:
+                out.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+def run_json_phase(phase: str, script: str, timeout: int,
+                   args: tuple = (), summary_leg: str | None = None) -> None:
+    """One measurement subprocess -> one recorded phase row; the shared
+    run/parse/record shape for raw bounds, mxu_sat, and tsqr."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(HERE, script), *args],
+            capture_output=True, text=True, timeout=timeout,
+            env=dict(os.environ), cwd=REPO,
+        )
+        lines = _parse_json_lines(out.stdout)
+        row: dict = {"rc": out.returncode,
+                     "stderr": out.stderr[-300:] if out.returncode else ""}
+        if summary_leg is not None:
+            row["legs"] = lines
+            row["summary"] = next(
+                (l for l in lines if l.get("leg") == summary_leg), None)
+        else:
+            row["bounds"] = lines
+        record(phase, row)
+    except subprocess.TimeoutExpired:
+        record(phase, {"error": "timeout", "script": script,
+                       "args": list(args)})
+
 #: gap priority: smallest HBM footprint x highest information first.
 #: (metric names mirror bench.CONFIGS; addsum/addsum_scaled landed in the
 #: 01:03Z session but stay listed so a fresh DEVICE_R5.jsonl still works.)
@@ -99,9 +137,14 @@ def main() -> int:
     mxu_sat_pending = not any(
         r.get("phase") == "mxu_sat" and r.get("summary") for r in _rows
     )
+    tsqr_pending = not any(
+        r.get("phase") == "tsqr" and r.get("summary") for r in _rows
+    )
     print(f"gaps={gaps} raw_gaps={raw_gaps} threefry={threefry_gaps} "
-          f"mxu_sat_pending={mxu_sat_pending}", flush=True)
-    if not (gaps or raw_gaps or threefry_gaps or mxu_sat_pending):
+          f"mxu_sat_pending={mxu_sat_pending} tsqr_pending={tsqr_pending}",
+          flush=True)
+    if not (gaps or raw_gaps or threefry_gaps or mxu_sat_pending
+            or tsqr_pending):
         return 0
 
     baselines = bench.get_baselines()
@@ -141,19 +184,8 @@ def main() -> int:
     for cfg in sorted(raw_gaps, key=RAW_ORDER.index):
         if not probe(75):
             return 1
-        try:
-            out = subprocess.run(
-                [sys.executable, os.path.join(HERE, "raw_jax_bound.py"),
-                 "--configs", cfg],
-                capture_output=True, text=True, timeout=300,
-                env=dict(os.environ), cwd=REPO,
-            )
-            lines = [json.loads(ln) for ln in out.stdout.strip().splitlines()
-                     if ln.startswith("{")]
-            record("raw", {"bounds": lines, "rc": out.returncode,
-                           "stderr": out.stderr[-300:] if out.returncode else ""})
-        except subprocess.TimeoutExpired:
-            record("raw", {"error": "timeout", "config": cfg})
+        run_json_phase("raw", "raw_jax_bound.py", 300,
+                       args=("--configs", cfg))
 
     for flag in threefry_gaps:
         if not probe(60):
@@ -180,22 +212,15 @@ def main() -> int:
     if mxu_sat_pending:
         if not probe(75):
             return 1
-        try:
-            out = subprocess.run(
-                [sys.executable, os.path.join(HERE, "mxu_saturation.py")],
-                capture_output=True, text=True, timeout=480,
-                env=dict(os.environ), cwd=REPO,
-            )
-            lines = [json.loads(ln) for ln in out.stdout.strip().splitlines()
-                     if ln.startswith("{")]
-            summary = next(
-                (l for l in lines if l.get("leg") == "summary"), None)
-            record("mxu_sat", {"legs": lines, "summary": summary,
-                               "rc": out.returncode,
-                               "stderr": out.stderr[-300:] if out.returncode
-                               else ""})
-        except subprocess.TimeoutExpired:
-            record("mxu_sat", {"error": "timeout"})
+        run_json_phase("mxu_sat", "mxu_saturation.py", 480,
+                       summary_leg="summary")
+
+    # TSQR device throughput (out-of-core QR, beyond-reference) — after
+    # every baseline-config gap, once
+    if tsqr_pending:
+        if not probe(75):
+            return 1
+        run_json_phase("tsqr", "tsqr_device.py", 480, summary_leg="summary")
 
     # MXU fraction-of-peak summary over EVERYTHING recorded so far
     try:

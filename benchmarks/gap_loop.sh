@@ -7,7 +7,7 @@ cd "$(dirname "$0")/.." || exit 1
 for i in $(seq 1 40); do
   echo "=== gap_loop iteration $i $(date -u +%FT%TZ) ===" >> benchmarks/gap_loop.log
   python benchmarks/device_gap_session.py >> benchmarks/gap_loop.log 2>&1
-  if grep -q "gaps=\[\] raw_gaps=\[\] threefry=\[\] mxu_sat_pending=False" <(tail -40 benchmarks/gap_loop.log); then
+  if grep -q "gaps=\[\] raw_gaps=\[\] threefry=\[\] mxu_sat_pending=False tsqr_pending=False" <(tail -40 benchmarks/gap_loop.log); then
     echo "all gaps filled $(date -u +%FT%TZ)" >> benchmarks/gap_loop.log
     exit 0
   fi

@@ -1,0 +1,105 @@
+"""TSQR on device: out-of-core QR throughput on one chip.
+
+Framework leg: ``xp.linalg.qr`` over a tall-skinny f32 array
+(4M x 64 = 1 GB; 16 row panels of 64 MB) on the JaxExecutor — the panels
+batch into one jit(vmap) dispatch, the stacked-R QR is a single small
+task, and Q re-forms blockwise. Raw leg: one ``jnp.linalg.qr`` of the
+same array in a single jit for the lower bound.
+
+The reference has no QR at all, so there is no baseline to beat — the
+numbers position the framework against raw JAX on identical math.
+Output: one JSON line per leg + a summary. Run with the device env.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+M, N = 4_000_000, 64
+CHUNK_ROWS = 250_000  # 16 panels x 64 MB
+BYTES = M * N * 4
+FLOPS = 2 * M * N * N  # tall-skinny QR ~ 2mn^2
+REPS = 3
+
+
+def framework_leg() -> dict:
+    import numpy as np
+
+    import cubed_tpu as ct
+    import cubed_tpu.array_api as xp
+    import cubed_tpu.random
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="6GB")
+    executor = JaxExecutor(compute_dtype="float32")
+
+    def build():
+        a = cubed_tpu.random.random((M, N), chunks=(CHUNK_ROWS, N), spec=spec)
+        q, r = xp.linalg.qr(a)
+        # consume both factors on device: orthonormality residual is a
+        # scalar fetch and verifies correctness in the same pass
+        qtq = xp.matmul(xp.matrix_transpose(q), q)
+        eye = xp.asarray(np.eye(N), spec=spec)
+        return xp.max(xp.abs(xp.subtract(qtq, eye)))
+
+    resid = float(build().compute(executor=executor))  # compile + caches
+    assert resid < 1e-3, resid
+    best = float("inf")
+    for _ in range(REPS):
+        s = build()
+        t0 = time.perf_counter()
+        float(s.compute(executor=executor))
+        best = min(best, time.perf_counter() - t0)
+    return {"leg": "framework_tsqr", "elapsed_s": round(best, 4),
+            "gb_per_s": round(BYTES / best / 1e9, 2),
+            "gflops": round(FLOPS / best / 1e9, 1),
+            "orthonormality_residual": float(resid)}
+
+
+def raw_leg() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_threefry_partitionable", True)
+
+    @jax.jit
+    def step(seed):
+        key = jax.random.fold_in(jax.random.key(0), seed * 7919)
+        a = jax.random.uniform(key, (M, N), dtype=jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        return jnp.max(jnp.abs(q.T @ q - jnp.eye(N, dtype=jnp.float32)))
+
+    float(step(0))  # compile
+    best = float("inf")
+    for i in range(REPS):
+        t0 = time.perf_counter()
+        float(step(100 + i))  # distinct seed defeats the tunnel result cache
+        best = min(best, time.perf_counter() - t0)
+    return {"leg": "raw_jax_qr", "elapsed_s": round(best, 4),
+            "gb_per_s": round(BYTES / best / 1e9, 2),
+            "gflops": round(FLOPS / best / 1e9, 1)}
+
+
+def main() -> int:
+    fw = framework_leg()
+    print(json.dumps(fw), flush=True)
+    raw = raw_leg()
+    print(json.dumps(raw), flush=True)
+    print(json.dumps({
+        "leg": "summary",
+        "framework_gb_per_s": fw["gb_per_s"],
+        "raw_jax_gb_per_s": raw["gb_per_s"],
+        "fw_over_raw": round(raw["elapsed_s"] / fw["elapsed_s"], 3),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

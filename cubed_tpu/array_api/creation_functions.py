@@ -323,6 +323,9 @@ def from_dlpack(x, /, *, device=None, copy=None, chunks="auto", spec=None):
     except BufferError:
         # some exporters refuse read-only buffers (DLPack cannot signal
         # readonly); the import copies unconditionally, so a plain host
-        # conversion is just as safe
+        # conversion is just as safe — but only when numpy genuinely
+        # converts (an object-dtype wrap means it could not)
         host = np.asarray(x)
+        if host.dtype == object:
+            raise
     return asarray(np.array(host, copy=True), chunks=chunks, spec=spec)

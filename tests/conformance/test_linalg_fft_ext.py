@@ -1,0 +1,146 @@
+"""linalg + fft extension namespaces against the numpy oracle.
+
+Parity role: array-api-tests extension suites (test_linalg.py /
+test_fft.py) — the reference has neither namespace, so this is
+beyond-reference conformance. Decomposition factors are compared via
+backend-invariant properties (reconstruction, orthonormality,
+triangularity, uniqueness of singular/eigen values), not raw factor
+equality, because LAPACK sign conventions are not part of the spec.
+Tolerances scale with the input dtype's eps (the generators draw float32
+as well as float64).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+import cubed_tpu.array_api as xp
+
+from .harness import arrays, run, wrap
+
+
+def _tol(an, k=100, extra=None):
+    """eps-scaled absolute tolerance for a result derived from ``an``."""
+    scale = max(1.0, float(np.max(np.abs(an))) if an.size else 1.0)
+    if extra is not None:
+        scale = max(scale, float(np.max(np.abs(extra))) if np.size(extra) else 1.0)
+    return float(np.finfo(an.dtype).eps) * k * scale
+
+
+@settings(max_examples=20, deadline=None)
+@given(data=st.data())
+def test_qr_properties(data):
+    m = data.draw(st.integers(2, 12))
+    n = data.draw(st.integers(1, min(m, 6)))
+    an = data.draw(arrays(shape=(m, n)))
+    a = wrap(an, None)
+    a = a.rechunk((data.draw(st.integers(1, m)), n))
+    q, r = xp.linalg.qr(a)
+    qn, rn = run(q), run(r)
+    assert qn.shape == (m, n) and rn.shape == (n, n)
+    tol = _tol(an, k=200)
+    np.testing.assert_allclose(qn @ rn, an, atol=tol)
+    np.testing.assert_allclose(
+        qn.T @ qn, np.eye(n), atol=float(np.finfo(an.dtype).eps) * 200
+    )
+    np.testing.assert_allclose(np.triu(rn), rn, atol=tol)
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=st.data())
+def test_svd_and_svdvals_match_numpy(data):
+    m = data.draw(st.integers(1, 10))
+    n = data.draw(st.integers(1, 10))
+    an = data.draw(arrays(shape=(m, n)))
+    a = wrap(an, None)
+    if m > 1:
+        a = a.rechunk((data.draw(st.integers(1, m)), n))
+    s_expect = np.linalg.svd(an, compute_uv=False)
+    tol = _tol(an, k=200, extra=s_expect)
+    np.testing.assert_allclose(run(xp.linalg.svdvals(a)), s_expect, atol=tol)
+    u, s, vh = xp.linalg.svd(a, full_matrices=False)
+    un, sn, vhn = run(u), run(s), run(vh)
+    np.testing.assert_allclose(sn, s_expect, atol=tol)
+    np.testing.assert_allclose((un * sn) @ vhn, an, atol=tol * 5)
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=st.data())
+def test_solve_inv_det_roundtrip(data):
+    n = data.draw(st.integers(1, 6))
+    base = data.draw(arrays(shape=(n, n)))
+    # normalize before forming the SPD matrix: a huge draw makes
+    # base@base.T rank-1-dominant and the ridge negligible, i.e. an
+    # ill-conditioned system where f32 legitimately loses ~cond*eps
+    denom = max(1.0, float(np.max(np.abs(base))) if base.size else 1.0)
+    base = (base / denom).astype(base.dtype)
+    an = (base @ base.T + n * np.eye(n)).astype(base.dtype)  # SPD, cond O(1)
+    a = wrap(an, None)
+    bn = data.draw(
+        arrays(shape=(n, data.draw(st.integers(1, 3))))
+    ).astype(an.dtype)
+    b = wrap(bn, None)
+    xn = run(xp.linalg.solve(a, b))
+    tol = _tol(an, k=500 * n, extra=bn)
+    np.testing.assert_allclose(an @ xn, bn, atol=tol)
+    np.testing.assert_allclose(
+        run(xp.linalg.inv(a)) @ an, np.eye(n),
+        atol=float(np.finfo(an.dtype).eps) * 500 * n,
+    )
+    det_expect = np.linalg.det(an)
+    np.testing.assert_allclose(
+        np.asarray(run(xp.linalg.det(a))), det_expect,
+        atol=float(np.finfo(an.dtype).eps) * 500 * max(1.0, abs(float(det_expect))),
+    )
+
+
+@settings(max_examples=20, deadline=None)
+@given(data=st.data())
+def test_fft_matches_numpy(data):
+    an = data.draw(arrays(min_dims=1))
+    ndim = an.ndim
+    axis = data.draw(st.integers(-ndim, ndim - 1))
+    norm = data.draw(st.sampled_from(["backward", "ortho", "forward"]))
+    a = wrap(an, None)
+    expect = np.fft.fft(an, axis=axis, norm=norm)
+    np.testing.assert_allclose(
+        run(xp.fft.fft(a, axis=axis, norm=norm)), expect,
+        atol=_tol(an, k=100, extra=np.abs(expect)),
+    )
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=st.data())
+def test_rfft_irfft_roundtrip_property(data):
+    an = data.draw(arrays(min_dims=1))
+    ndim = an.ndim
+    axis = data.draw(st.integers(-ndim, ndim - 1))
+    if an.shape[axis] < 2:
+        return
+    a = wrap(an, None)
+    out = run(xp.fft.irfft(xp.fft.rfft(a, axis=axis), n=an.shape[axis],
+                           axis=axis))
+    np.testing.assert_allclose(out, an, atol=_tol(an, k=100))
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=st.data())
+def test_norms_match_numpy(data):
+    an = data.draw(arrays(shape=(
+        data.draw(st.integers(1, 7)), data.draw(st.integers(1, 7))
+    )))
+    a = wrap(an, None)
+    ordv = data.draw(st.sampled_from(["fro", 1, -1, np.inf, -np.inf]))
+    expect = np.linalg.norm(an, ord=ordv)
+    np.testing.assert_allclose(
+        float(run(xp.linalg.matrix_norm(a, ord=ordv))), expect,
+        atol=_tol(an, k=100, extra=expect),
+    )
+    vord = data.draw(st.sampled_from([2, 1, 3, np.inf]))
+    expect_v = np.linalg.norm(an.ravel(), ord=vord)
+    np.testing.assert_allclose(
+        float(run(xp.linalg.vector_norm(a, ord=vord))), expect_v,
+        atol=_tol(an, k=100, extra=expect_v),
+    )
