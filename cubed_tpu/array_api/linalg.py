@@ -406,8 +406,31 @@ def svdvals(x, /):
 
 
 def cholesky(x, /, *, upper=False):
+    """Cholesky factorization. Stacks (and 2-d matrices that fit one task)
+    run a per-matrix gufunc; a 2-d SPD matrix too large for one task runs
+    a **blocked right-looking factorization over the chunk grid** — a
+    sequential plan of nb panel steps whose every task touches only
+    block-sized operands, so ``n`` may exceed `allowed_mem`."""
     _require_floating(x, "cholesky")
     _require_square(x, "cholesky")
+
+    if x.ndim == 2:
+        n = x.shape[-1]
+        itemsize = np.dtype(x.dtype).itemsize
+        allowed = x.spec.allowed_mem or (2**63)
+        # the gufunc path gathers the full matrix into one task (~2 input
+        # + 2 output chunk copies); route to the blocked factorization
+        # when that cannot fit
+        if 5 * n * n * itemsize > allowed:
+            lo = _blocked_cholesky(x)
+            if not upper:
+                return lo
+            up = matrix_transpose(lo)
+            if np.dtype(x.dtype).kind == "c":
+                from .elementwise_functions import conj
+
+                up = conj(up)
+            return up
 
     def _chol(a):
         lo = nxp.linalg.cholesky(a)
@@ -417,6 +440,105 @@ def cholesky(x, /, *, upper=False):
 
     return apply_gufunc(
         _chol, "(i,j)->(i,j)", _single_chunk_core(x), output_dtypes=x.dtype
+    )
+
+
+def _blocked_cholesky(x):
+    """Right-looking blocked Cholesky on the chunk grid (lower factor).
+
+    Classic panel algorithm, expressed entirely in chunked ops over
+    single-block panels:
+
+        for k:  L[k][k] = chol( A[k][k] - Σ_j L[k][j] L[k][j]^T )
+                L[i][k] = ( A[i][k] - Σ_j L[i][j] L[k][j]^T )
+                          · solve(L[k][k]^T)          for i > k
+
+    The plan has O(nb^3) small matmul nodes with a sequential depth of nb
+    panel steps — each task holds only (c, c) blocks, so the matrix may
+    exceed ``allowed_mem``. Solves use ``nxp.linalg.solve`` on the (c, c)
+    diagonal factor (no explicit inverse); complex Hermitian inputs use
+    the conjugate transpose throughout (A = L L^H). The final factor
+    assembles in ONE map_direct write (each task reads exactly one L
+    block or emits zeros) — no intermediate row concatenation."""
+    from ..core.ops import map_direct
+    from .elementwise_functions import conj
+
+    n = x.shape[0]
+    itemsize = np.dtype(x.dtype).itemsize
+    allowed = x.spec.allowed_mem or (2**63)
+    # block size: keep the existing square chunking when its blocks fit
+    # the per-task budget (no rechunk at all); otherwise pick the largest
+    # (c, c) that does and rechunk once
+    cur = x.chunksize
+    if cur[0] == cur[1] and 16 * cur[0] * cur[0] * itemsize <= allowed:
+        c = cur[0]
+    else:
+        c = max(
+            1,
+            min(n, int(math.isqrt(max(1, int(allowed // (16 * itemsize)))))),
+        )
+    nb = math.ceil(n / c)
+    if x.chunksize != (c, c):
+        x = rechunk(x, {0: c, 1: c})
+    bounds = [min(n, i * c) for i in range(nb + 1)]
+
+    is_complex = np.dtype(x.dtype).kind == "c"
+
+    def ct_(a):
+        # conjugate transpose for the Hermitian update (plain transpose
+        # for real dtypes — conj would be a no-op graph node)
+        t = matrix_transpose(a)
+        return conj(t) if is_complex else t
+
+    def block(arr, i, j):
+        return arr[bounds[i]:bounds[i + 1], bounds[j]:bounds[j + 1]]
+
+    def chol_block(a):
+        return apply_gufunc(
+            lambda m: nxp.linalg.cholesky(m), "(i,j)->(i,j)", a,
+            output_dtypes=a.dtype,
+        )
+
+    L: dict = {}
+    for k in range(nb):
+        s = block(x, k, k)
+        for j in range(k):
+            s = subtract(s, matmul(L[k, j], ct_(L[k, j])))
+        L[k, k] = chol_block(s)
+        for i in range(k + 1, nb):
+            t = block(x, i, k)
+            for j in range(k):
+                t = subtract(t, matmul(L[i, j], ct_(L[k, j])))
+            # L[i][k] = t @ L[k][k]^-H  ==  (solve(L[k][k], t^H))^H
+            L[i, k] = ct_(solve(L[k, k], ct_(t)))
+
+    if nb == 1:
+        return L[0, 0]
+
+    # single-write assembly: output block (i, j) copies its L block or
+    # emits zeros; side-input reads are one block per task
+    ordered = sorted(L)  # (i, j) -> positional side-input index
+    index_of = {ij: p for p, ij in enumerate(ordered)}
+    axis_chunks = tuple(bounds[i + 1] - bounds[i] for i in range(nb))
+    out_dtype = np.dtype(x.dtype)
+
+    def _assemble_block(out_chunk, *zarrs, block_id=None):
+        i, j = block_id
+        if j > i:
+            return np.zeros(
+                (axis_chunks[i], axis_chunks[j]), dtype=out_dtype
+            )
+        return np.asarray(zarrs[index_of[(i, j)]][:, :])
+
+    block_bytes = max(axis_chunks) ** 2 * out_dtype.itemsize
+    return map_direct(
+        _assemble_block,
+        *[L[ij] for ij in ordered],
+        shape=(n, n),
+        dtype=out_dtype,
+        chunks=(axis_chunks, axis_chunks),
+        extra_projected_mem=2 * block_bytes,
+        spec=x.spec,
     )
 
 

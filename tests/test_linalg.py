@@ -356,3 +356,61 @@ def test_vector_norm_complex_p_is_real(spec):
     np.testing.assert_allclose(
         float(out.compute()), np.linalg.norm(an, ord=3), rtol=1e-5
     )
+
+
+def test_blocked_cholesky_exceeds_single_task_memory(tmp_path):
+    # 200x200 f64 = 320 KB; the gufunc path needs ~5x that in one task,
+    # so a 600 KB budget forces the blocked right-looking factorization
+    rng = np.random.default_rng(19)
+    n = 200
+    base = rng.standard_normal((n, n)) / n**0.5
+    an = base @ base.T + np.eye(n)
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem=600_000)
+    a = ct.from_array(an, chunks=(50, 50), spec=spec)
+    expect = np.linalg.cholesky(an)
+    np.testing.assert_allclose(asnp(linalg.cholesky(a)), expect, atol=1e-9)
+    np.testing.assert_allclose(
+        asnp(linalg.cholesky(a, upper=True)), expect.T, atol=1e-9
+    )
+
+
+def test_blocked_cholesky_on_jax_executor(tmp_path):
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    rng = np.random.default_rng(20)
+    n = 120
+    base = rng.standard_normal((n, n)) / n**0.5
+    an = base @ base.T + np.eye(n)
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem=250_000)
+    a = ct.from_array(an, chunks=(40, 40), spec=spec)
+    got = np.asarray(linalg.cholesky(a).compute(executor=JaxExecutor()))
+    np.testing.assert_allclose(got, np.linalg.cholesky(an), atol=1e-8)
+
+
+def test_blocked_cholesky_ragged_last_block(tmp_path):
+    rng = np.random.default_rng(21)
+    n = 170  # not divisible by the chosen block size
+    base = rng.standard_normal((n, n)) / n**0.5
+    an = base @ base.T + np.eye(n)
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem=500_000)
+    a = ct.from_array(an, chunks=(60, 60), spec=spec)
+    np.testing.assert_allclose(
+        asnp(linalg.cholesky(a)), np.linalg.cholesky(an), atol=1e-9
+    )
+
+
+def test_blocked_cholesky_complex_hermitian(tmp_path):
+    rng = np.random.default_rng(22)
+    n = 160
+    base = (
+        rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    ) / n**0.5
+    an = (base @ base.conj().T + np.eye(n)).astype(np.complex128)
+    # complex128 blocks are 2x f64: force the blocked route
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem=900_000)
+    a = ct.from_array(an, chunks=(40, 40), spec=spec)
+    expect = np.linalg.cholesky(an)
+    np.testing.assert_allclose(asnp(linalg.cholesky(a)), expect, atol=1e-9)
+    np.testing.assert_allclose(
+        asnp(linalg.cholesky(a, upper=True)), expect.conj().T, atol=1e-9
+    )
