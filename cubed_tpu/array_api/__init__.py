@@ -178,3 +178,4 @@ from .set_functions import (  # noqa: F401  (loud rejections)
     unique_values,
 )
 from .creation_functions import from_dlpack  # noqa: F401
+from .einsum_functions import einsum  # noqa: F401  (beyond-standard extension)

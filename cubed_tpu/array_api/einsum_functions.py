@@ -1,0 +1,143 @@
+"""Chunked ``einsum`` — beyond both the standard and the reference.
+
+Generalizes the matmul/tensordot contraction pattern
+(linear_algebra_functions.py; reference analogue
+cubed/array_api/linear_algebra_functions.py:13-149) to arbitrary
+subscripts: one n-ary blockwise op contracts block-locally with every
+contracted label kept as a size-1 axis (``adjust_chunks``), then a tree
+reduction sums over the contracted axes. Shared labels align their chunk
+grids via the blockwise core's ``unify_chunks``; on the TPU executor each
+per-block kernel is a single ``nxp.einsum`` (an MXU contraction for the
+matmul-shaped cases) and the sum lowers to the collective tree.
+
+Not supported (raise ``NotImplementedError``): ellipsis and repeated
+labels within one operand (block-local traces/diagonals don't compose
+across a chunk grid without a gather).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend_array_api import nxp
+from ..core.ops import blockwise
+from .data_type_functions import result_type
+from .dtypes import _numeric_dtypes
+
+__all__ = ["einsum"]
+
+
+def _parse(subscripts: str, n_operands: int):
+    subscripts = subscripts.replace(" ", "")
+    if "..." in subscripts:
+        raise NotImplementedError("einsum: ellipsis is not supported")
+    if "->" in subscripts:
+        lhs, out_labels = subscripts.split("->")
+        explicit = True
+    else:
+        lhs, out_labels, explicit = subscripts, "", False
+    in_labels = lhs.split(",")
+    if len(in_labels) != n_operands:
+        raise ValueError(
+            f"einsum: {len(in_labels)} operand subscripts for "
+            f"{n_operands} operands"
+        )
+    for labels in in_labels:
+        if not labels.isalpha() and labels != "":
+            raise ValueError(f"einsum: invalid subscript {labels!r}")
+        if len(set(labels)) != len(labels):
+            raise NotImplementedError(
+                "einsum: repeated labels within one operand (diagonal/"
+                "trace) are not supported"
+            )
+    counts: dict = {}
+    for labels in in_labels:
+        for ch in labels:
+            counts[ch] = counts.get(ch, 0) + 1
+    if not explicit:
+        out_labels = "".join(sorted(ch for ch, c in counts.items() if c == 1))
+    if len(set(out_labels)) != len(out_labels):
+        raise ValueError("einsum: repeated output labels")
+    for ch in out_labels:
+        if ch not in counts:
+            raise ValueError(f"einsum: output label {ch!r} not in inputs")
+    contracted = sorted(ch for ch in counts if ch not in out_labels)
+    return in_labels, out_labels, contracted
+
+
+def einsum(subscripts, /, *operands, dtype=None):
+    """Evaluate the Einstein summation over chunked arrays.
+
+    ``einsum("ij,jk->ik", a, b)`` and friends; any number of operands,
+    batch labels, multiple contractions (``"abc,cd,be->ae"``), implicit
+    output. Memory-bounded like every other op: the contraction runs
+    per block and sums through the reduction tree.
+    """
+    if not operands:
+        raise ValueError("einsum requires at least one operand")
+    for op in operands:
+        if op.dtype not in _numeric_dtypes:
+            raise TypeError("Only numeric dtypes are allowed in einsum")
+    in_labels, out_labels, contracted = _parse(subscripts, len(operands))
+    for labels, op in zip(in_labels, operands):
+        if len(labels) != op.ndim:
+            raise ValueError(
+                f"einsum: subscript {labels!r} does not match operand "
+                f"with {op.ndim} dimensions"
+            )
+
+    if dtype is None:
+        dtype = result_type(*operands)
+    dtype = np.dtype(dtype)
+
+    sym = {ch: i for i, ch in enumerate(out_labels + "".join(contracted))}
+    out_ind = tuple(sym[ch] for ch in out_labels) + tuple(
+        sym[ch] for ch in contracted
+    )
+
+    # block kernel: contract locally to the OUTPUT labels, then append a
+    # size-1 axis per contracted label (out_ind keeps them for the tree)
+    kernel_spec = ",".join(in_labels) + "->" + out_labels
+    n_contracted = len(contracted)
+
+    def _einsum_block(*blocks):
+        # contract IN the requested dtype (np.einsum dtype semantics):
+        # an int32 product must not overflow before a float64 cast
+        res = nxp.einsum(kernel_spec, *[b.astype(dtype) for b in blocks])
+        for _ in range(n_contracted):
+            res = nxp.expand_dims(res, axis=res.ndim)
+        return res
+
+    _einsum_block.__name__ = f"einsum[{subscripts}]"
+
+    blockwise_args = []
+    for labels, op in zip(in_labels, operands):
+        blockwise_args.extend([op, tuple(sym[ch] for ch in labels)])
+
+    # contraction temporaries: same 3-output-block pricing as matmul
+    # (linear_algebra_functions.py) — the block result materializes before
+    # the fusable sum consumes it, plus the write-path copy
+    label_chunk = {}
+    for labels, op in zip(in_labels, operands):
+        for ch, c in zip(labels, op.chunksize):
+            label_chunk[ch] = max(label_chunk.get(ch, 1), c)
+    out_block_elems = 1
+    for ch in out_labels:
+        out_block_elems *= label_chunk[ch]
+    contraction_extra = 3 * out_block_elems * dtype.itemsize
+
+    out = blockwise(
+        _einsum_block,
+        out_ind,
+        *blockwise_args,
+        dtype=dtype,
+        adjust_chunks={sym[ch]: 1 for ch in contracted},
+        extra_projected_mem=contraction_extra,
+    )
+
+    if contracted:
+        from .statistical_functions import sum as xp_sum
+
+        axes = tuple(range(len(out_labels), len(out_labels) + n_contracted))
+        out = xp_sum(out, axis=axes, dtype=dtype)
+    return out

@@ -130,7 +130,7 @@ def test_random_linalg_and_stats_match_oracle(data, spec):
     kind = data.draw(st.sampled_from(
         ["matmul", "tensordot", "var", "std", "nanmean", "index", "sort",
          "argsort", "take_along_axis", "count_nonzero", "gufunc_multi",
-         "qr_recon", "svdvals", "fft", "ifft_roundtrip"]
+         "qr_recon", "svdvals", "fft", "ifft_roundtrip", "einsum"]
     ))
     if kind == "matmul":
         expr = xp.matmul(a, b)
@@ -190,6 +190,12 @@ def test_random_linalg_and_stats_match_oracle(data, spec):
     elif kind == "ifft_roundtrip":
         ax = data.draw(st.integers(0, 1))
         expr = xp.real(xp.fft.ifft(xp.fft.fft(a, axis=ax), axis=ax))
+    elif kind == "einsum":
+        spec_s = data.draw(st.sampled_from(
+            ["ij,jk->ik", "ij,jk->", "ij,ij->i", "ij,ij->j"]
+        ))
+        second = b if "jk" in spec_s else a  # shapes must align per labels
+        expr = xp.einsum(spec_s, a, second)
     else:
         expr = xp.sort(a, axis=data.draw(st.integers(0, 1)))
 

@@ -1,0 +1,96 @@
+"""Chunked einsum (beyond-standard extension; no reference counterpart).
+
+One n-ary blockwise contraction + tree-sum; shared labels unify chunks."""
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+
+
+def asnp(x):
+    return np.asarray(x.compute())
+
+
+CASES = [
+    ("ij,jk->ik", [(20, 12), (12, 8)], [(5, 4), (4, 4)]),
+    ("ij,jk", [(6, 5), (5, 7)], [(3, 5), (5, 7)]),
+    ("bij,bjk->bik", [(3, 6, 5), (3, 5, 4)], [(1, 3, 5), (1, 5, 2)]),
+    ("i,i->", [(24,), (24,)], [(6,), (8,)]),
+    ("ij,ij->ij", [(6, 4), (6, 4)], [(3, 2), (2, 4)]),
+    ("i,j->ij", [(5,), (7,)], [(2,), (3,)]),
+    ("abc,cd,be->ade", [(3, 4, 5), (5, 6), (4, 2)],
+     [(1, 2, 5), (5, 3), (2, 2)]),
+    ("ijk->ki", [(3, 4, 5)], [(1, 2, 5)]),
+    ("ij->", [(5, 6)], [(2, 3)]),
+    ("ij,kj->ik", [(4, 6), (5, 6)], [(2, 3), (5, 2)]),
+]
+
+
+@pytest.mark.parametrize("subscripts,shapes,chunksets", CASES)
+def test_einsum_matches_numpy(spec, subscripts, shapes, chunksets):
+    rng = np.random.default_rng(0)
+    arrs_np = [rng.standard_normal(s) for s in shapes]
+    arrs = [
+        ct.from_array(a, chunks=c, spec=spec)
+        for a, c in zip(arrs_np, chunksets)
+    ]
+    np.testing.assert_allclose(
+        asnp(xp.einsum(subscripts, *arrs)),
+        np.einsum(subscripts, *arrs_np),
+        atol=1e-10,
+    )
+
+
+def test_einsum_on_jax_executor(spec):
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    rng = np.random.default_rng(1)
+    an, bn = rng.standard_normal((16, 12)), rng.standard_normal((12, 10))
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    b = ct.from_array(bn, chunks=(4, 5), spec=spec)
+    got = np.asarray(
+        xp.einsum("ij,jk->ik", a, b).compute(executor=JaxExecutor())
+    )
+    np.testing.assert_allclose(got, an @ bn, atol=1e-8)
+
+
+def test_einsum_contraction_larger_than_memory(tmp_path):
+    # contracted axis spans many chunks; every task touches only blocks
+    rng = np.random.default_rng(2)
+    an = rng.standard_normal((8, 4000))
+    bn = rng.standard_normal((4000, 8))
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem=300_000)
+    a = ct.from_array(an, chunks=(8, 250), spec=spec)
+    b = ct.from_array(bn, chunks=(250, 8), spec=spec)
+    np.testing.assert_allclose(
+        asnp(xp.einsum("ij,jk->ik", a, b)), an @ bn, atol=1e-8
+    )
+
+
+def test_einsum_validation(spec):
+    a = ct.from_array(np.ones((3, 3)), chunks=(3, 3), spec=spec)
+    with pytest.raises(NotImplementedError, match="ellipsis"):
+        xp.einsum("...i,i->...", a, a)
+    with pytest.raises(NotImplementedError, match="repeated"):
+        xp.einsum("ii->i", a)
+    with pytest.raises(ValueError, match="operand"):
+        xp.einsum("ij,jk->ik", a)
+    with pytest.raises(ValueError, match="dimensions"):
+        xp.einsum("ijk->k", a)
+    bi = ct.from_array(np.ones((3, 3), dtype=bool), chunks=(3, 3), spec=spec)
+    with pytest.raises(TypeError):
+        xp.einsum("ij,jk->ik", bi, bi)
+
+
+def test_einsum_dtype_applies_to_block_contraction(spec):
+    # int32 products would overflow per block without the dtype cast
+    an = np.full((4, 64), 100_000_000, dtype=np.int32)
+    bn = np.full((64, 4), 1, dtype=np.int32)
+    a = ct.from_array(an, chunks=(4, 16), spec=spec)
+    b = ct.from_array(bn, chunks=(16, 4), spec=spec)
+    got = asnp(xp.einsum("ij,jk->ik", a, b, dtype=np.float64))
+    np.testing.assert_allclose(
+        got, np.einsum("ij,jk->ik", an, bn, dtype=np.float64)
+    )
