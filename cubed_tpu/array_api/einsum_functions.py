@@ -79,12 +79,19 @@ def einsum(subscripts, /, *operands, dtype=None):
         if op.dtype not in _numeric_dtypes:
             raise TypeError("Only numeric dtypes are allowed in einsum")
     in_labels, out_labels, contracted = _parse(subscripts, len(operands))
+    extents: dict = {}
     for labels, op in zip(in_labels, operands):
         if len(labels) != op.ndim:
             raise ValueError(
                 f"einsum: subscript {labels!r} does not match operand "
                 f"with {op.ndim} dimensions"
             )
+        for ch, size in zip(labels, op.shape):
+            if extents.setdefault(ch, size) != size:
+                raise ValueError(
+                    f"einsum: label {ch!r} has inconsistent sizes "
+                    f"{extents[ch]} and {size}"
+                )
 
     if dtype is None:
         dtype = result_type(*operands)
@@ -102,8 +109,12 @@ def einsum(subscripts, /, *operands, dtype=None):
 
     def _einsum_block(*blocks):
         # contract IN the requested dtype (np.einsum dtype semantics):
-        # an int32 product must not overflow before a float64 cast
-        res = nxp.einsum(kernel_spec, *[b.astype(dtype) for b in blocks])
+        # an int32 product must not overflow before a float64 cast; cast
+        # only blocks whose dtype differs (astype always copies)
+        res = nxp.einsum(
+            kernel_spec,
+            *[b if b.dtype == dtype else b.astype(dtype) for b in blocks],
+        )
         for _ in range(n_contracted):
             res = nxp.expand_dims(res, axis=res.ndim)
         return res
@@ -125,6 +136,14 @@ def einsum(subscripts, /, *operands, dtype=None):
     for ch in out_labels:
         out_block_elems *= label_chunk[ch]
     contraction_extra = 3 * out_block_elems * dtype.itemsize
+    # widened input-block copies (the kernel casts mismatched dtypes and
+    # briefly holds original + widened block together)
+    for labels, op in zip(in_labels, operands):
+        if np.dtype(op.dtype) != dtype:
+            in_elems = 1
+            for ch in labels:
+                in_elems *= label_chunk[ch]
+            contraction_extra += in_elems * dtype.itemsize
 
     out = blockwise(
         _einsum_block,

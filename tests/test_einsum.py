@@ -94,3 +94,10 @@ def test_einsum_dtype_applies_to_block_contraction(spec):
     np.testing.assert_allclose(
         got, np.einsum("ij,jk->ik", an, bn, dtype=np.float64)
     )
+
+
+def test_einsum_label_size_mismatch_names_label(spec):
+    a = ct.from_array(np.ones((2, 3)), chunks=(2, 3), spec=spec)
+    b = ct.from_array(np.ones((4, 2)), chunks=(4, 2), spec=spec)
+    with pytest.raises(ValueError, match="label 'j'"):
+        xp.einsum("ij,jk->ik", a, b)

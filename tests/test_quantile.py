@@ -1,0 +1,98 @@
+"""Exact quantile/median over chunked axes (beyond-standard extension;
+dask only approximates multi-chunk quantiles — here the axis rides the
+scale-out sort network and the result is two static slices)."""
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+
+
+def asnp(x):
+    return np.asarray(x.compute())
+
+
+@pytest.mark.parametrize("q", [0.0, 0.25, 0.5, 0.9, 1.0])
+def test_quantile_matches_numpy(spec, q):
+    an = np.random.default_rng(0).standard_normal((6, 101))
+    a = ct.from_array(an, chunks=(2, 25), spec=spec)
+    np.testing.assert_allclose(
+        asnp(xp.quantile(a, q, axis=1)), np.quantile(an, q, axis=1),
+        atol=1e-12,
+    )
+
+
+@pytest.mark.parametrize("method", ["lower", "higher", "nearest"])
+def test_quantile_methods(spec, method):
+    an = np.random.default_rng(1).standard_normal(53)
+    a = ct.from_array(an, chunks=(10,), spec=spec)
+    np.testing.assert_allclose(
+        float(xp.quantile(a, 0.37, axis=0, method=method).compute()),
+        np.quantile(an, 0.37, method=method),
+        atol=1e-12,
+    )
+
+
+def test_median_axis_none_and_keepdims(spec):
+    an = np.random.default_rng(2).standard_normal((5, 8))
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    np.testing.assert_allclose(
+        float(xp.median(a).compute()), np.median(an), atol=1e-12
+    )
+    out = xp.median(a, axis=1, keepdims=True)
+    assert out.shape == (5, 1)
+    np.testing.assert_allclose(
+        asnp(out), np.median(an, axis=1, keepdims=True), atol=1e-12
+    )
+    out0 = xp.quantile(a, 0.5, keepdims=True)
+    assert out0.shape == (1, 1)
+
+
+def test_quantile_axis_larger_than_memory(tmp_path):
+    # the sorted axis exceeds allowed_mem: the sort network carries it
+    an = np.random.default_rng(3).standard_normal(120_000)
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem=400_000)
+    a = ct.from_array(an, chunks=(10_000,), spec=spec)
+    np.testing.assert_allclose(
+        float(xp.quantile(a, 0.75, axis=0).compute()),
+        np.quantile(an, 0.75),
+        atol=1e-12,
+    )
+
+
+def test_quantile_on_jax_executor(spec):
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    an = np.random.default_rng(4).standard_normal((4, 64))
+    a = ct.from_array(an, chunks=(2, 16), spec=spec)
+    got = np.asarray(
+        xp.quantile(a, 0.5, axis=1).compute(executor=JaxExecutor())
+    )
+    np.testing.assert_allclose(got, np.quantile(an, 0.5, axis=1), atol=1e-10)
+
+
+def test_quantile_validation(spec):
+    a = ct.from_array(np.ones(5), chunks=(5,), spec=spec)
+    with pytest.raises(ValueError):
+        xp.quantile(a, 1.5)
+    with pytest.raises(TypeError):
+        xp.quantile(a, [0.5])
+    with pytest.raises(ValueError):
+        xp.quantile(a, 0.5, method="bogus")
+    ai = ct.from_array(np.ones(5, dtype=np.int32), chunks=(5,), spec=spec)
+    with pytest.raises(TypeError):
+        xp.quantile(ai, 0.5)
+
+
+def test_quantile_nan_propagates(spec):
+    an = np.array([1.0, np.nan, 3.0, 2.0, 5.0])
+    a = ct.from_array(an, chunks=(2,), spec=spec)
+    assert np.isnan(float(xp.quantile(a, 0.5, axis=0).compute()))
+    # rows without NaN stay exact
+    bn = np.array([[1.0, np.nan, 3.0], [4.0, 5.0, 6.0]])
+    b = ct.from_array(bn, chunks=(1, 2), spec=spec)
+    got = np.asarray(xp.median(b, axis=1).compute())
+    assert np.isnan(got[0]) and got[1] == 5.0
+    with pytest.raises(IndexError):
+        xp.quantile(b, 0.5, axis=5)
