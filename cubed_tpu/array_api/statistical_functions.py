@@ -491,15 +491,17 @@ def quantile(x, q, /, *, axis=None, keepdims=False, method="linear"):
         one_minus = asarray(1.0 - frac, dtype=x.dtype, spec=x.spec)
         out = add(multiply(out, one_minus), multiply(s[sel_hi], w))
 
-    # numpy semantics: any NaN along the axis poisons the quantile (sort
-    # parks NaNs at the end, which would otherwise silently shift the
-    # selected index)
+    # numpy semantics: any NaN along the axis poisons the quantile. sort
+    # parks NaNs at the END of the axis, so the LAST element alone tells
+    # whether any NaN exists — one static slice, not a second full pass
     from .creation_functions import asarray as _asarray
     from .elementwise_functions import isnan
     from .searching_functions import where
-    from .utility_functions import any as xp_any
 
-    has_nan = xp_any(isnan(x), axis=axis, keepdims=True)
+    sel_last = tuple(
+        slice(n - 1, n) if d == axis else slice(None) for d in range(x.ndim)
+    )
+    has_nan = isnan(s[sel_last])
     out = where(has_nan, _asarray(float("nan"), dtype=x.dtype, spec=x.spec),
                 out)
     return out if keepdims else squeeze(out, axis=axis)
@@ -509,3 +511,202 @@ def median(x, /, *, axis=None, keepdims=False):
     """Exact median via :func:`quantile` (q=0.5) — the sorted axis may
     exceed ``allowed_mem`` (sort network)."""
     return quantile(x, 0.5, axis=axis, keepdims=keepdims)
+
+
+def histogram(x, /, *, bins=10, range=None, weights=None, density=False):
+    """Chunked histogram (numpy semantics; no reference counterpart).
+
+    Output shapes are STATIC: ``bins`` is an int (with optional
+    ``range``) or an explicit edges sequence; when ``range`` is omitted
+    the data min/max are computed lazily IN the plan (data-dependent
+    values, never data-dependent shapes). Per-block partial counts sum
+    through the reduction tree, so ``x`` may exceed ``allowed_mem``.
+    Returns ``(counts, edges)``; ``weights``/``density`` as in numpy."""
+    from ..core.ops import general_blockwise
+    from .creation_functions import arange, asarray
+    from .data_type_functions import astype
+    from .elementwise_functions import add, divide, greater, multiply, subtract
+    from .manipulation_functions import flatten
+    from .searching_functions import where
+    from .utility_functions import diff
+
+    if x.dtype not in _real_floating_dtypes:
+        raise TypeError(
+            "Only real floating-point dtypes are allowed in histogram"
+        )
+    flat = flatten(x)
+    wflat = None
+    if weights is not None:
+        if weights.shape != x.shape:
+            raise ValueError("histogram: weights must match x's shape")
+        wflat = flatten(weights)
+        if wflat.chunks != flat.chunks:
+            wflat = wflat.rechunk(flat.chunksize)
+
+    spec = x.spec
+    if np.ndim(bins) == 0:
+        nbins = int(bins)
+        if nbins <= 0:
+            raise ValueError("histogram: bins must be positive")
+        if range is not None:
+            lo_v, hi_v = float(range[0]), float(range[1])
+            if not lo_v <= hi_v:
+                raise ValueError("histogram: range must be increasing")
+            if lo_v == hi_v:
+                lo_v, hi_v = lo_v - 0.5, hi_v + 0.5
+            # exact endpoints (numpy linspace semantics): the max sample
+            # must land IN the closed last bin
+            edges = asarray(
+                np.linspace(lo_v, hi_v, nbins + 1), spec=spec
+            )
+        else:
+            # lazy data extent in ONE pass over the data: a {lo, hi}
+            # field tree (the mean/var pytree machinery) instead of two
+            # independent min/max reductions
+            from ..core.ops import _aggregate_fields, reduction_fields
+
+            parts = reduction_fields(
+                flat, _extent_func, _extent_combine, axis=(0,),
+                fields={"lo": np.dtype(np.float64),
+                        "hi": np.dtype(np.float64)},
+            )
+            names = ["lo", "hi"]
+            f64 = np.dtype(np.float64)
+            lo = _aggregate_fields(parts, _take_lo, f64, names)
+            hi = _aggregate_fields(parts, _take_hi, f64, names)
+            degenerate = greater(hi, lo)
+            half = asarray(0.5, dtype=np.dtype(np.float64), spec=spec)
+            lo = where(degenerate, lo, subtract(lo, half))
+            hi = where(degenerate, hi, add(hi, half))
+            # convex combination lo*(1-t) + hi*t with t = i/nbins: the
+            # first/last edges equal lo/hi EXACTLY (a lo + i*step form
+            # can round the last edge below the data max, dropping the
+            # max sample from the closed last bin)
+            t = divide(
+                arange(nbins + 1, dtype=np.dtype(np.float64), spec=spec),
+                asarray(float(nbins), dtype=np.dtype(np.float64), spec=spec),
+            )
+            one = asarray(1.0, dtype=np.dtype(np.float64), spec=spec)
+            edges = add(
+                multiply(lo, subtract(one, t)), multiply(hi, t)
+            )
+    else:
+        edges_np = np.asarray(bins, dtype=np.float64)
+        if edges_np.ndim != 1 or edges_np.size < 2:
+            raise ValueError("histogram: bins edges must be 1-d with >= 2")
+        if np.any(np.diff(edges_np) < 0):
+            raise ValueError("histogram: bins edges must be monotonic")
+        nbins = edges_np.size - 1
+        edges = asarray(edges_np, spec=spec)
+
+    if len(edges.chunks[0]) > 1:
+        edges = edges.rechunk((nbins + 1,))
+
+    nb = flat.numblocks[0]
+    out_dtype = (
+        np.dtype(np.float64) if wflat is not None or density
+        else np.dtype(np.int64)
+    )
+    flat_name, edges_name = flat.name, edges.name
+    w_name = wflat.name if wflat is not None else None
+
+    def bf(out_key):
+        i = out_key[1]
+        keys = [(flat_name, i), (edges_name, 0)]
+        if w_name is not None:
+            keys.append((w_name, i))
+        return tuple(keys)
+
+    def _hist_block(xb, eb, *maybe_w):
+        wb = maybe_w[0] if maybe_w else None
+        counts, _ = nxp.histogram(xb, bins=eb, weights=wb)
+        return nxp.reshape(counts.astype(out_dtype), (1, -1))
+
+    args = [flat, edges] + ([wflat] if wflat is not None else [])
+    partial = general_blockwise(
+        _hist_block, bf, *args,
+        shape=(nb, nbins),
+        dtype=out_dtype,
+        chunks=((1,) * nb, (nbins,)),
+        op_name="histogram_partial",
+    )
+    counts = sum(partial, axis=0, dtype=out_dtype)
+
+    if density:
+        widths = diff(edges)
+        total = sum(astype(counts, np.dtype(np.float64)))
+        counts = divide(
+            astype(counts, np.dtype(np.float64)), multiply(total, widths)
+        )
+    return counts, edges
+
+
+def _extent_func(a, axis=None, keepdims=True, **kwargs):
+    return {
+        "lo": nxp.min(a, axis=axis, keepdims=keepdims).astype(np.float64),
+        "hi": nxp.max(a, axis=axis, keepdims=keepdims).astype(np.float64),
+    }
+
+
+def _extent_combine(a, axis=None, keepdims=True, **kwargs):
+    return {
+        "lo": nxp.min(a["lo"], axis=axis, keepdims=keepdims),
+        "hi": nxp.max(a["hi"], axis=axis, keepdims=keepdims),
+    }
+
+
+def _take_lo(d):
+    return d["lo"]
+
+
+def _take_hi(d):
+    return d["hi"]
+
+
+def cov(m, /, *, rowvar=True, ddof=1):
+    """Covariance matrix of chunked observations (numpy semantics, no
+    reference counterpart): centering + one blockwise contraction, so
+    the observation axis may exceed ``allowed_mem``."""
+    from .linear_algebra_functions import matmul, matrix_transpose
+
+    if m.ndim != 2:
+        raise ValueError("cov requires a 2-d array")
+    if m.dtype not in _real_floating_dtypes:
+        raise TypeError("Only real floating-point dtypes are allowed in cov")
+    x = m if rowvar else matrix_transpose(m)
+    n_obs = x.shape[1]
+    if n_obs - ddof <= 0:
+        raise ValueError("cov: not enough observations for ddof")
+    centered = _subtract_mean(x, axis=1)
+    from .elementwise_functions import divide
+    from .creation_functions import asarray
+
+    return divide(
+        matmul(centered, matrix_transpose(centered)),
+        asarray(float(n_obs - ddof), dtype=x.dtype, spec=x.spec),
+    )
+
+
+def _subtract_mean(x, axis):
+    from .elementwise_functions import subtract
+
+    m = mean(x, axis=axis, keepdims=True)
+    return subtract(x, m)
+
+
+def corrcoef(m, /, *, rowvar=True):
+    """Correlation matrix from :func:`cov` (numpy semantics)."""
+    from .elementwise_functions import clip, divide, sqrt
+    from .linalg import diagonal
+
+    c = cov(m, rowvar=rowvar, ddof=1)
+    d = sqrt(diagonal(c))
+    # rounding can push perfectly-correlated entries past 1; numpy clips
+    return clip(divide(c, _outer_like(d)), min=-1.0, max=1.0)
+
+
+def _outer_like(d):
+    from .elementwise_functions import multiply
+    from .manipulation_functions import expand_dims
+
+    return multiply(expand_dims(d, axis=1), expand_dims(d, axis=0))

@@ -207,13 +207,20 @@ def blockwise(
     if align_arrays:
         _, arrays = unify_chunks(*itertools.chain(*zip(arrays, inds)))
 
-    # chunking of each index symbol (max-blocks rule over aligned inputs)
+    # chunking of each index symbol (max-blocks rule over aligned inputs;
+    # ties break toward the larger extent — a size-1 dim BROADCASTS
+    # against the symbol and must not define the output chunking)
     chunkss: dict = {}
     for a, ind in zip(arrays, inds):
         if ind is None:
             continue
         for sym, c in zip(ind, a.chunks):
-            if sym not in chunkss or len(c) > len(chunkss[sym]):
+            prev = chunkss.get(sym)
+            if (
+                prev is None
+                or len(c) > len(prev)
+                or (len(c) == len(prev) and sum(c) > sum(prev))
+            ):
                 chunkss[sym] = c
     if new_axes:
         for sym, size in new_axes.items():
@@ -479,7 +486,12 @@ def map_blocks(
     blockwise_args = []
     for a in args:
         if isinstance(a, CoreArray):
-            blockwise_args.extend([a, tuple(range(a.ndim)) if a.ndim else None])
+            # 0-d arrays use the EMPTY index (their single block reads via
+            # key (name,)), matching elemwise; None would mean dask's
+            # "pass the raw argument through", which the runtime's
+            # _read_keys has no reader for — a computed 0-d array through
+            # astype/map_blocks crashed on exactly that
+            blockwise_args.extend([a, tuple(range(a.ndim))])
         else:
             # non-array args are closed over
             raise ValueError("non-array positional args not supported; use kwargs")
@@ -962,23 +974,10 @@ def reduction(
         # (structured arrays can't ride make_array_from_callback). The
         # reference instead stores a single structured array
         # (cubed/array_api/statistical_functions.py:33-36).
-        parts = _multi_field_map(
-            x,
-            partial(_initial_reduce, func=func, axis=axis, kw=kw),
-            fields,
-            chunks=tuple(
-                (1,) * x.numblocks[i] if i in axis else c
-                for i, c in enumerate(x.chunks)
-            ),
-            op_name="initial_reduce",
+        parts = reduction_fields(
+            x, func, combine_func, axis=axis, fields=fields,
+            split_every=split, extra_func_kwargs=kw,
         )
-        while any(parts[0].numblocks[ax] > 1 for ax in axis):
-            parts = partial_reduce_multi(
-                parts,
-                _StreamingCombineMulti(combine_func, axis, kw, list(fields)),
-                split_every={ax: split for ax in axis},
-                fields=fields,
-            )
         result = _aggregate_fields(parts, aggregate_func, dtype, list(fields))
     else:
         # initial per-block reduction (reduced axes -> size 1)
@@ -1124,6 +1123,43 @@ def partial_reduce(
         fusable=False,
         op_name="partial_reduce",
     )
+
+
+def reduction_fields(
+    x: CoreArray,
+    func: Callable,
+    combine_func: Callable,
+    *,
+    axis: tuple,
+    fields: dict,
+    split_every: int = 4,
+    extra_func_kwargs: Optional[dict] = None,
+):
+    """The pytree-field reduction TREE without the final aggregate: per-
+    block ``func`` produces a dict of field arrays, combine rounds shrink
+    the reduced axes to one block, and the returned dict of (tiny,
+    1-block-per-reduced-axis) field arrays is ready for one or SEVERAL
+    cheap aggregates — e.g. histogram's single-pass {lo, hi} extent scan
+    reads the data once and aggregates both fields from the final block."""
+    kw = dict(extra_func_kwargs or {})
+    parts = _multi_field_map(
+        x,
+        partial(_initial_reduce, func=func, axis=axis, kw=kw),
+        fields,
+        chunks=tuple(
+            (1,) * x.numblocks[i] if i in axis else c
+            for i, c in enumerate(x.chunks)
+        ),
+        op_name="initial_reduce",
+    )
+    while any(parts[0].numblocks[ax] > 1 for ax in axis):
+        parts = partial_reduce_multi(
+            parts,
+            _StreamingCombineMulti(combine_func, axis, kw, list(fields)),
+            split_every={ax: split_every for ax in axis},
+            fields=fields,
+        )
+    return parts
 
 
 def _fields_of(intermediate_dtype) -> Optional[dict]:

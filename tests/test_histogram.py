@@ -1,0 +1,116 @@
+"""Chunked histogram / cov / corrcoef (beyond-standard extensions)."""
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+
+
+def asnp(x):
+    return np.asarray(x.compute())
+
+
+def test_histogram_implicit_range_lazy_minmax(spec):
+    an = np.random.default_rng(0).standard_normal(5000)
+    a = ct.from_array(an, chunks=(500,), spec=spec)
+    h, e = xp.histogram(a, bins=16)
+    hx, ex = np.histogram(an, bins=16)
+    np.testing.assert_allclose(asnp(e), ex, atol=1e-12)
+    np.testing.assert_array_equal(asnp(h), hx)
+
+
+def test_histogram_range_edges_weights_density(spec):
+    an = np.random.default_rng(1).standard_normal(3000)
+    a = ct.from_array(an, chunks=(400,), spec=spec)
+    h, _ = xp.histogram(a, bins=8, range=(-2, 2))
+    np.testing.assert_array_equal(
+        asnp(h), np.histogram(an, bins=8, range=(-2, 2))[0]
+    )
+    edges = np.linspace(-3, 3, 13)
+    w = ct.from_array(np.abs(an), chunks=(400,), spec=spec)
+    h2, _ = xp.histogram(a, bins=edges, weights=w)
+    np.testing.assert_allclose(
+        asnp(h2), np.histogram(an, bins=edges, weights=np.abs(an))[0],
+        atol=1e-10,
+    )
+    h3, _ = xp.histogram(a, bins=edges, density=True)
+    np.testing.assert_allclose(
+        asnp(h3), np.histogram(an, bins=edges, density=True)[0], atol=1e-12
+    )
+
+
+def test_histogram_2d_input_and_degenerate(spec):
+    an = np.random.default_rng(2).standard_normal((40, 30))
+    a = ct.from_array(an, chunks=(10, 10), spec=spec)
+    h, e = xp.histogram(a, bins=5)
+    hx, ex = np.histogram(an, bins=5)
+    np.testing.assert_array_equal(asnp(h), hx)
+    # all-equal values: numpy's +-0.5 degenerate-range fixup
+    cn = np.full(64, 3.0)
+    c = ct.from_array(cn, chunks=(16,), spec=spec)
+    h2, e2 = xp.histogram(c, bins=4)
+    hx2, ex2 = np.histogram(cn, bins=4)
+    np.testing.assert_array_equal(asnp(h2), hx2)
+    np.testing.assert_allclose(asnp(e2), ex2, atol=1e-12)
+
+
+def test_histogram_on_jax_executor(spec):
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    an = np.random.default_rng(3).standard_normal(2000)
+    a = ct.from_array(an, chunks=(250,), spec=spec)
+    h, _ = xp.histogram(a, bins=np.linspace(-3, 3, 10))
+    got = np.asarray(h.compute(executor=JaxExecutor()))
+    np.testing.assert_array_equal(
+        got, np.histogram(an, bins=np.linspace(-3, 3, 10))[0]
+    )
+
+
+def test_histogram_validation(spec):
+    a = ct.from_array(np.ones(8), chunks=(4,), spec=spec)
+    with pytest.raises(ValueError):
+        xp.histogram(a, bins=0)
+    with pytest.raises(ValueError):
+        xp.histogram(a, bins=[3.0, 2.0, 1.0])  # non-monotonic
+    with pytest.raises(ValueError):
+        xp.histogram(a, bins=4, range=(2, 1))
+    w = ct.from_array(np.ones(5), chunks=(5,), spec=spec)
+    with pytest.raises(ValueError, match="weights"):
+        xp.histogram(a, bins=4, weights=w)
+
+
+def test_cov_corrcoef(spec):
+    rng = np.random.default_rng(4)
+    mn = rng.standard_normal((4, 300))
+    m = ct.from_array(mn, chunks=(2, 50), spec=spec)
+    np.testing.assert_allclose(asnp(xp.cov(m)), np.cov(mn), atol=1e-10)
+    np.testing.assert_allclose(
+        asnp(xp.cov(m, rowvar=False)), np.cov(mn, rowvar=False), atol=1e-10
+    )
+    np.testing.assert_allclose(
+        asnp(xp.corrcoef(m)), np.corrcoef(mn), atol=1e-10
+    )
+    np.testing.assert_allclose(
+        asnp(xp.cov(m, ddof=0)), np.cov(mn, ddof=0), atol=1e-10
+    )
+
+
+def test_astype_of_computed_0d(spec):
+    # regression: map_blocks handed 0-d arrays a None blockwise index
+    a = ct.from_array(np.arange(12.0), chunks=(4,), spec=spec)
+    assert float(xp.astype(xp.sum(a), np.float32).compute()) == 66.0
+
+
+def test_size_one_dim_broadcast(spec):
+    # regression: a (1,) operand's chunks must not define the output grid
+    one = ct.from_array(np.array([5.0]), chunks=(1,), spec=spec)
+    six = ct.from_array(np.arange(6.0), chunks=(3,), spec=spec)
+    np.testing.assert_allclose(
+        asnp(xp.add(one, six)), 5.0 + np.arange(6.0)
+    )
+    r = ct.from_array(np.arange(4.0).reshape(1, 4), chunks=(1, 2), spec=spec)
+    m = ct.from_array(np.ones((3, 4)), chunks=(2, 2), spec=spec)
+    np.testing.assert_allclose(
+        asnp(xp.add(r, m)), np.arange(4.0).reshape(1, 4) + np.ones((3, 4))
+    )
