@@ -119,7 +119,12 @@ def concat(arrays, /, *, axis=0):
     shape[axis] = sum(a.shape[axis] for a in arrays)
     shape = tuple(shape)
 
-    chunksize = arrays[0].chunksize
+    # non-axis chunking is unified above; along the axis take the LARGEST
+    # source chunksize — deriving it from arrays[0] let a thin first part
+    # (e.g. pad's 1-wide sliver) rechunk the whole output to 1-wide blocks
+    chunksize = list(arrays[0].chunksize)
+    chunksize[axis] = max(a.chunksize[axis] for a in arrays)
+    chunksize = tuple(chunksize)
     chunks = normalize_chunks(chunksize, shape, dtype=dtype)
 
     # cumulative extents of sources along axis
@@ -528,4 +533,104 @@ def tile(x, repetitions, /):
             out = out[sel]
         else:
             out = concat([out] * r, axis=d)
+    return out
+
+
+def pad(x, pad_width, mode="constant", *, constant_values=0):
+    """Pad with constants or edge replication (numpy-style subset; no
+    reference counterpart). Constant pads are FREE in the plan — they
+    concat never-materialized virtual full arrays; "edge" replicates the
+    boundary slice via broadcast_to (reads only the edge blocks).
+
+    ``pad_width``: int, (before, after), or per-axis sequence of either.
+    """
+    from .creation_functions import full
+
+    if mode not in ("constant", "edge"):
+        raise NotImplementedError(f"pad: unsupported mode {mode!r}")
+    # normalize pad_width to ((b0, a0), (b1, a1), ...)
+    if isinstance(pad_width, (int, np.integer)):
+        widths = [(int(pad_width), int(pad_width))] * x.ndim
+    else:
+        pw = list(pad_width)
+        if pw and isinstance(pw[0], (int, np.integer)):
+            if len(pw) != 2:
+                raise ValueError(
+                    "pad_width must be an int, (before, after), or a "
+                    "per-axis sequence of those"
+                )
+            widths = [(int(pw[0]), int(pw[1]))] * x.ndim
+        else:
+            if len(pw) != x.ndim:
+                raise ValueError(
+                    f"pad_width has {len(pw)} entries for {x.ndim} axes"
+                )
+            widths = [
+                (int(b), int(a)) for b, a in
+                (w if not isinstance(w, (int, np.integer)) else (w, w)
+                 for w in pw)
+            ]
+    if any(b < 0 or a < 0 for b, a in widths):
+        raise ValueError("pad widths must be non-negative")
+
+    out = x
+    for ax, (before, after) in enumerate(widths):
+        if before == 0 and after == 0:
+            continue
+        parts = []
+        if mode == "constant":
+            def pad_shape(n):
+                return tuple(
+                    n if d == ax else s for d, s in enumerate(out.shape)
+                )
+
+            ck = tuple(
+                min(out.chunksize[d], out.shape[d]) or 1
+                for d in range(out.ndim)
+            )
+            if before:
+                parts.append(full(
+                    pad_shape(before), constant_values, dtype=out.dtype,
+                    chunks=tuple(
+                        min(before, ck[d]) if d == ax else ck[d]
+                        for d in range(out.ndim)
+                    ),
+                    spec=x.spec,
+                ))
+            parts.append(out)
+            if after:
+                parts.append(full(
+                    pad_shape(after), constant_values, dtype=out.dtype,
+                    chunks=tuple(
+                        min(after, ck[d]) if d == ax else ck[d]
+                        for d in range(out.ndim)
+                    ),
+                    spec=x.spec,
+                ))
+        else:  # edge
+            n = out.shape[ax]
+            if n == 0:
+                raise ValueError("pad: cannot edge-pad an empty axis")
+            first = tuple(
+                slice(0, 1) if d == ax else slice(None)
+                for d in range(out.ndim)
+            )
+            last = tuple(
+                slice(n - 1, n) if d == ax else slice(None)
+                for d in range(out.ndim)
+            )
+            if before:
+                parts.append(broadcast_to(
+                    out[first],
+                    tuple(before if d == ax else s
+                          for d, s in enumerate(out.shape)),
+                ))
+            parts.append(out)
+            if after:
+                parts.append(broadcast_to(
+                    out[last],
+                    tuple(after if d == ax else s
+                          for d, s in enumerate(out.shape)),
+                ))
+        out = concat(parts, axis=ax) if len(parts) > 1 else parts[0]
     return out

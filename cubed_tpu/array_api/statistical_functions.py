@@ -521,7 +521,13 @@ def histogram(x, /, *, bins=10, range=None, weights=None, density=False):
     the data min/max are computed lazily IN the plan (data-dependent
     values, never data-dependent shapes). Per-block partial counts sum
     through the reduction tree, so ``x`` may exceed ``allowed_mem``.
-    Returns ``(counts, edges)``; ``weights``/``density`` as in numpy."""
+    Returns ``(counts, edges)``; ``weights``/``density`` as in numpy.
+
+    Documented deviation: NaN data with an IMPLICIT range yields NaN
+    edges (and meaningless counts) instead of numpy's runtime
+    ValueError — a lazy plan cannot raise on data-dependent values.
+    Pass an explicit ``range``/edges (numpy-identical semantics: NaNs
+    fall outside every bin) or filter NaNs first."""
     from ..core.ops import general_blockwise
     from .creation_functions import arange, asarray
     from .data_type_functions import astype

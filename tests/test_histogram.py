@@ -114,3 +114,47 @@ def test_size_one_dim_broadcast(spec):
     np.testing.assert_allclose(
         asnp(xp.add(r, m)), np.arange(4.0).reshape(1, 4) + np.ones((3, 4))
     )
+
+
+@pytest.mark.parametrize("pw", [2, (1, 3), ((1, 2), (0, 3))])
+def test_pad_constant(spec, pw):
+    an = np.arange(24.0).reshape(4, 6)
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    np.testing.assert_allclose(asnp(xp.pad(a, pw)), np.pad(an, pw))
+
+
+def test_pad_value_edge_and_validation(spec):
+    an = np.arange(24.0).reshape(4, 6)
+    a = ct.from_array(an, chunks=(2, 3), spec=spec)
+    np.testing.assert_allclose(
+        asnp(xp.pad(a, 2, constant_values=9.0)),
+        np.pad(an, 2, constant_values=9.0),
+    )
+    np.testing.assert_allclose(
+        asnp(xp.pad(a, ((2, 1), (1, 2)), mode="edge")),
+        np.pad(an, ((2, 1), (1, 2)), mode="edge"),
+    )
+    with pytest.raises(NotImplementedError):
+        xp.pad(a, 1, mode="reflect")
+    with pytest.raises(ValueError):
+        xp.pad(a, -1)
+    with pytest.raises(ValueError):
+        xp.pad(a, ((1, 1),))  # wrong number of axes
+
+
+def test_pad_on_jax_executor(spec):
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    an = np.arange(12.0).reshape(3, 4)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    got = np.asarray(xp.pad(a, 1).compute(executor=JaxExecutor()))
+    np.testing.assert_allclose(got, np.pad(an, 1))
+
+
+def test_pad_keeps_chunk_granularity(spec):
+    # a 1-wide pad sliver must not rechunk the output to 1-wide blocks
+    an = np.arange(1000.0)
+    a = ct.from_array(an, chunks=(250,), spec=spec)
+    p = xp.pad(a, 1)
+    assert p.numblocks[0] <= 6, p.chunks
+    np.testing.assert_allclose(asnp(p), np.pad(an, 1))
