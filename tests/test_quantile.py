@@ -49,6 +49,7 @@ def test_median_axis_none_and_keepdims(spec):
     assert out0.shape == (1, 1)
 
 
+@pytest.mark.slow
 def test_quantile_axis_larger_than_memory(tmp_path):
     # the sorted axis exceeds allowed_mem: the sort network carries it
     an = np.random.default_rng(3).standard_normal(120_000)
