@@ -144,3 +144,40 @@ def test_norms_match_numpy(data):
         float(run(xp.linalg.vector_norm(a, ord=vord))), expect_v,
         atol=_tol(an, k=100, extra=expect_v),
     )
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=st.data())
+def test_quantile_matches_numpy_property(data):
+    # NaN poisoning is pinned by tests/test_quantile.py (the harness
+    # generators draw finite values only)
+    an = data.draw(arrays(min_dims=1))
+    axis = data.draw(st.integers(0, an.ndim - 1))
+    if an.shape[axis] == 0:
+        return
+    q = data.draw(st.sampled_from([0.0, 0.1, 0.5, 0.9, 1.0]))
+    a = wrap(an.astype(np.float64), None)
+    got = run(xp.quantile(a, q, axis=axis))
+    expect = np.quantile(an.astype(np.float64), q, axis=axis)
+    np.testing.assert_allclose(got, expect, atol=1e-12)
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=st.data())
+def test_histogram_matches_numpy_property(data):
+    an = data.draw(arrays(min_dims=1))
+    if an.size == 0:
+        return
+    an = an.astype(np.float64)
+    nbins = data.draw(st.integers(1, 8))
+    a = wrap(an, None)
+    h, e = xp.histogram(a, bins=nbins)
+    en = run(e)
+    # edges match numpy's linspace to a few ulps of the extent (the
+    # convex-combination formula differs in the last bits; a sample
+    # within an ulp of an interior edge may legitimately bin differently)
+    _, ex = np.histogram(an, bins=nbins)
+    scale = max(1.0, float(np.max(np.abs(ex))))
+    np.testing.assert_allclose(en, ex, atol=16 * np.finfo(np.float64).eps * scale)
+    # counts validate against numpy binning with OUR edges: exact
+    np.testing.assert_array_equal(run(h), np.histogram(an, bins=en)[0])
