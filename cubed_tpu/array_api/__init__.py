@@ -182,3 +182,4 @@ from .einsum_functions import einsum  # noqa: F401  (beyond-standard extension)
 from .statistical_functions import median, quantile  # noqa: F401  (beyond-standard)
 from .statistical_functions import corrcoef, cov, histogram  # noqa: F401  (beyond-standard)
 from .manipulation_functions import pad  # noqa: F401  (beyond-standard)
+from .statistical_functions import nanmedian, nanquantile  # noqa: F401  (beyond-standard)

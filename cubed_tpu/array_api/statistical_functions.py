@@ -417,6 +417,31 @@ def cumulative_prod(x, /, *, axis=None, dtype=None, include_initial=False):
     )
 
 
+def _check_quantile_args(x, q, fname):
+    if not isinstance(q, (int, float)) or isinstance(q, bool):
+        raise TypeError(f"{fname}: q must be a python float in [0, 1]")
+    q = float(q)
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"{fname}: q must be in [0, 1]")
+    if x.dtype not in _real_floating_dtypes:
+        raise TypeError(
+            f"Only real floating-point dtypes are allowed in {fname}"
+        )
+    return q
+
+
+def _check_quantile_axis(x, axis, fname):
+    if not -x.ndim <= axis < x.ndim:
+        raise IndexError(
+            f"{fname}: axis {axis} is out of bounds for array of "
+            f"dimension {x.ndim}"
+        )
+    axis = axis % x.ndim
+    if x.shape[axis] == 0:
+        raise ValueError(f"{fname} of an empty axis")
+    return axis
+
+
 def quantile(x, q, /, *, axis=None, keepdims=False, method="linear"):
     """EXACT quantile along an axis — beyond both the standard and the
     reference (dask only approximates multi-chunk quantiles): the axis
@@ -431,15 +456,7 @@ def quantile(x, q, /, *, axis=None, keepdims=False, method="linear"):
     from .manipulation_functions import flatten, squeeze
     from .sorting_functions import sort
 
-    if not isinstance(q, (int, float)) or isinstance(q, bool):
-        raise TypeError("quantile: q must be a python float in [0, 1]")
-    q = float(q)
-    if not 0.0 <= q <= 1.0:
-        raise ValueError("quantile: q must be in [0, 1]")
-    if x.dtype not in _real_floating_dtypes:
-        raise TypeError(
-            "Only real floating-point dtypes are allowed in quantile"
-        )
+    q = _check_quantile_args(x, q, "quantile")
     if method not in ("linear", "lower", "higher", "nearest"):
         raise ValueError(f"quantile: unsupported method {method!r}")
 
@@ -453,15 +470,8 @@ def quantile(x, q, /, *, axis=None, keepdims=False, method="linear"):
                 out = expand_dims(out, axis=0)
         return out
 
-    if not -x.ndim <= axis < x.ndim:
-        raise IndexError(
-            f"quantile: axis {axis} is out of bounds for array of "
-            f"dimension {x.ndim}"
-        )
-    axis = axis % x.ndim
+    axis = _check_quantile_axis(x, axis, "quantile")
     n = x.shape[axis]
-    if n == 0:
-        raise ValueError("quantile of an empty axis")
 
     pos = q * (n - 1)
     lo = int(np.floor(pos))
@@ -716,3 +726,84 @@ def _outer_like(d):
     from .manipulation_functions import expand_dims
 
     return multiply(expand_dims(d, axis=1), expand_dims(d, axis=0))
+
+
+def nanquantile(x, q, /, *, axis=None, keepdims=False):
+    """EXACT quantile ignoring NaNs (numpy.nanquantile semantics, linear
+    interpolation). The sorted axis parks NaNs at the END, so the number
+    of valid elements per lane gives COMPUTED gather indices — resolved
+    with ``take_along_axis`` (chunked, memory-bounded) rather than static
+    slices; all shapes stay static. All-NaN lanes yield NaN."""
+    from .creation_functions import asarray
+    from .data_type_functions import astype
+    from .elementwise_functions import (
+        add, floor, isnan, logical_not, multiply, subtract,
+    )
+    from .indexing_functions import take_along_axis
+    from .manipulation_functions import expand_dims, flatten, squeeze
+    from .searching_functions import where
+    from .sorting_functions import sort
+
+    q = _check_quantile_args(x, q, "nanquantile")
+    if axis is None:
+        out = nanquantile(flatten(x), q, axis=0)
+        if keepdims:
+            for _ in range(x.ndim):
+                out = expand_dims(out, axis=0)
+        return out
+
+    axis = _check_quantile_axis(x, axis, "nanquantile")
+
+    s = sort(x, axis=axis)
+    # valid (non-NaN) count per lane, kept as a size-1 axis
+    n_valid = sum(
+        astype(logical_not(isnan(x)), np.dtype(np.int64)),
+        axis=axis, keepdims=True,
+    )
+    nf = astype(n_valid, np.dtype(np.float64))
+    qk = asarray(q, dtype=np.dtype(np.float64), spec=x.spec)
+    one = asarray(1.0, dtype=np.dtype(np.float64), spec=x.spec)
+    pos = multiply(qk, subtract(nf, one))          # q * (n_valid - 1)
+    zero = asarray(0.0, dtype=np.dtype(np.float64), spec=x.spec)
+    # n_valid == 0 gives pos = -q: clamp (the all-NaN overwrite below
+    # decides the lane's value either way)
+    pos = where(pos < zero, zero, pos)
+    lo_f = floor(pos)
+    frac = astype(subtract(pos, lo_f), x.dtype)
+    lo_i = astype(lo_f, np.dtype(np.int64))
+    hi_i = where(
+        add(lo_i, asarray(1, dtype=np.dtype(np.int64), spec=x.spec))
+        < n_valid,
+        add(lo_i, asarray(1, dtype=np.dtype(np.int64), spec=x.spec)),
+        lo_i,
+    )
+    # ONE streamed gather for both bounds (take_along_axis reads every
+    # chunk of the sorted axis per output block; two calls would read
+    # the whole sorted array twice)
+    from .manipulation_functions import concat
+
+    both = take_along_axis(s, concat([lo_i, hi_i], axis=axis), axis=axis)
+    sel_lo = tuple(
+        slice(0, 1) if d == axis else slice(None) for d in range(x.ndim)
+    )
+    sel_hi = tuple(
+        slice(1, 2) if d == axis else slice(None) for d in range(x.ndim)
+    )
+    v_lo, v_hi = both[sel_lo], both[sel_hi]
+    out = add(
+        multiply(v_lo, subtract(asarray(1.0, dtype=x.dtype, spec=x.spec),
+                                frac)),
+        multiply(v_hi, frac),
+    )
+    # all-NaN lanes: no valid data -> NaN
+    nan_c = asarray(float("nan"), dtype=x.dtype, spec=x.spec)
+    out = where(
+        n_valid < asarray(1, dtype=np.dtype(np.int64), spec=x.spec),
+        nan_c, out,
+    )
+    return out if keepdims else squeeze(out, axis=axis)
+
+
+def nanmedian(x, /, *, axis=None, keepdims=False):
+    """Exact median ignoring NaNs (see :func:`nanquantile`)."""
+    return nanquantile(x, 0.5, axis=axis, keepdims=keepdims)

@@ -97,3 +97,47 @@ def test_quantile_nan_propagates(spec):
     assert np.isnan(got[0]) and got[1] == 5.0
     with pytest.raises(IndexError):
         xp.quantile(b, 0.5, axis=5)
+
+
+def test_nanquantile_matches_numpy(spec):
+    import warnings
+
+    rng = np.random.default_rng(5)
+    an = rng.standard_normal((6, 60))
+    an[an > 1.2] = np.nan
+    an[3] = np.nan  # all-NaN row
+    a = ct.from_array(an, chunks=(2, 15), spec=spec)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for q in (0.0, 0.3, 0.9, 1.0):
+            np.testing.assert_allclose(
+                asnp(xp.nanquantile(a, q, axis=1)),
+                np.nanquantile(an, q, axis=1),
+                atol=1e-12, equal_nan=True,
+            )
+        np.testing.assert_allclose(
+            asnp(xp.nanmedian(a, axis=0)), np.nanmedian(an, axis=0),
+            atol=1e-12, equal_nan=True,
+        )
+        got = float(xp.nanmedian(a).compute())
+        assert np.isclose(got, np.nanmedian(an))
+    out = xp.nanquantile(a, 0.5, axis=1, keepdims=True)
+    assert out.shape == (6, 1)
+
+
+def test_nanquantile_on_jax_executor(spec):
+    import warnings
+
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    an = np.random.default_rng(6).standard_normal((4, 32))
+    an[0, :5] = np.nan
+    a = ct.from_array(an, chunks=(2, 8), spec=spec)
+    got = np.asarray(
+        xp.nanquantile(a, 0.5, axis=1).compute(executor=JaxExecutor())
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        np.testing.assert_allclose(
+            got, np.nanquantile(an, 0.5, axis=1), atol=1e-10, equal_nan=True
+        )
