@@ -183,3 +183,4 @@ from .statistical_functions import median, quantile  # noqa: F401  (beyond-stand
 from .statistical_functions import corrcoef, cov, histogram  # noqa: F401  (beyond-standard)
 from .manipulation_functions import pad  # noqa: F401  (beyond-standard)
 from .statistical_functions import nanmedian, nanquantile  # noqa: F401  (beyond-standard)
+from .sorting_functions import argtopk, topk  # noqa: F401  (beyond-standard)

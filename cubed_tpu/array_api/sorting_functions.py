@@ -248,3 +248,159 @@ def _searchsorted_partial_counts(x1, x2, side):
     from .statistical_functions import sum as _sum
 
     return _sum(partials, axis=0)
+
+
+def _topk_args(x, k, axis, fname):
+    if not isinstance(k, (int, np.integer)) or isinstance(k, bool) or k == 0:
+        raise ValueError(f"{fname}: k must be a non-zero integer")
+    axis = _normalize_axis(x, axis)
+    if abs(int(k)) > x.shape[axis]:
+        raise ValueError(
+            f"{fname}: |k|={abs(int(k))} exceeds axis length {x.shape[axis]}"
+        )
+    return int(k), axis
+
+
+def _topk_impl(x, k, axis, want_indices):
+    """Shared topk/argtopk engine.
+
+    Fast path (k << n): ONE pass over the data — each block keeps its
+    local top |k| (global indices carried alongside via a traced-offset
+    multi-output op), then a single task merges the nb*|k| survivors.
+    When the survivors would strain the memory bound (or the axis is one
+    chunk anyway), falls back to the sort/argsort network + static slice.
+    """
+    from ..core.ops import (
+        _offsets_array_for,
+        block_index_from_offset,
+        general_blockwise,
+    )
+
+    kk, desc = abs(k), k > 0
+    n = x.shape[axis]
+    nb = x.numblocks[axis]
+    survivors = nb * kk
+    itemsize = np.dtype(x.dtype).itemsize
+    allowed = x.spec.allowed_mem or (2**63)
+    other = 1
+    for d in range(x.ndim):
+        if d != axis:
+            other *= x.chunksize[d]
+    merge_bytes = survivors * other * (itemsize + 8) * 4
+
+    if nb == 1 or survivors >= n or merge_bytes > allowed:
+        # network fallback: full sort then a static slice
+        s = argsort(x, axis=axis, descending=desc) if want_indices else sort(
+            x, axis=axis, descending=desc
+        )
+        sel = tuple(
+            slice(0, kk) if d == axis else slice(None)
+            for d in range(x.ndim)
+        )
+        return s[sel]
+
+    c = x.chunksize[axis]
+    numblocks = x.numblocks
+    sentinel = -np.inf if desc else np.inf
+    offsets = _offsets_array_for(x)
+    x_name, off_name = x.name, offsets.name
+
+    def bf_local(out_key):
+        return ((x_name, *out_key[1:]), (off_name, *out_key[1:]))
+
+    def _local_topk(block, off):
+        bi = block_index_from_offset(off, axis, numblocks)
+        key = nxp.negative(block) if desc else block
+        order = nxp.argsort(key, axis=axis, stable=True)
+        vals = nxp.take_along_axis(block, order, axis=axis)
+        idxs = (order + bi * c).astype(np.int64)
+        ln = block.shape[axis]
+        if ln >= kk:
+            sel = tuple(
+                slice(0, kk) if d == axis else slice(None)
+                for d in range(block.ndim)
+            )
+            return vals[sel], idxs[sel]
+        pad_shape = tuple(
+            kk - ln if d == axis else block.shape[d]
+            for d in range(block.ndim)
+        )
+        pad_v = nxp.full(pad_shape, sentinel, dtype=block.dtype)
+        pad_i = nxp.full(pad_shape, -1, dtype=np.int64)
+        return (
+            nxp.concatenate([vals, pad_v], axis=axis),
+            nxp.concatenate([idxs, pad_i], axis=axis),
+        )
+
+    _local_topk.traced_offsets = True
+    out_shape = tuple(
+        nb * kk if d == axis else s for d, s in enumerate(x.shape)
+    )
+    out_chunks = tuple(
+        (kk,) * nb if d == axis else ch for d, ch in enumerate(x.chunks)
+    )
+    vals, idxs = general_blockwise(
+        _local_topk, bf_local, x, offsets,
+        shape=[out_shape, out_shape],
+        dtype=[x.dtype, np.dtype(np.int64)],
+        chunks=out_chunks,
+        op_name="topk_local",
+    )
+
+    # single merge task over the nb*kk survivors
+    v_name, i_name = vals.name, idxs.name
+
+    def bf_merge(out_key):
+        coords = out_key[1:]
+        return (
+            [(v_name, *coords[:axis], j, *coords[axis + 1:])
+             for j in range(nb)],
+            [(i_name, *coords[:axis], j, *coords[axis + 1:])
+             for j in range(nb)],
+        )
+
+    def _merge_topk(v_blocks, i_blocks):
+        v = nxp.concatenate(list(v_blocks), axis=axis)
+        i = nxp.concatenate(list(i_blocks), axis=axis)
+        key = nxp.negative(v) if desc else v
+        order = nxp.argsort(key, axis=axis, stable=True)
+        sel = tuple(
+            slice(0, kk) if d == axis else slice(None)
+            for d in range(v.ndim)
+        )
+        if want_indices:
+            return nxp.take_along_axis(i, order, axis=axis)[sel]
+        return nxp.take_along_axis(v, order, axis=axis)[sel]
+
+    final_shape = tuple(
+        kk if d == axis else s for d, s in enumerate(x.shape)
+    )
+    final_chunks = tuple(
+        (kk,) if d == axis else ch for d, ch in enumerate(x.chunks)
+    )
+    return general_blockwise(
+        _merge_topk, bf_merge, vals, idxs,
+        shape=final_shape,
+        dtype=np.dtype(np.int64) if want_indices else x.dtype,
+        chunks=final_chunks,
+        num_input_blocks=(nb, nb),
+        extra_projected_mem=2 * merge_bytes,
+        op_name="topk_merge",
+    )
+
+
+def topk(x, k, /, *, axis=-1):
+    """The ``k`` largest (k>0) or smallest (k<0) values along ``axis``,
+    sorted accordingly (dask.array.topk semantics; no reference
+    counterpart). One pass over the data when k << n (per-block top-k +
+    one merge of the nb*|k| survivors); sort-network + static slice
+    otherwise. Exact at any scale, static shapes."""
+    k, axis = _topk_args(x, k, axis, "topk")
+    return _topk_impl(x, k, axis, want_indices=False)
+
+
+def argtopk(x, k, /, *, axis=-1):
+    """Indices of the ``k`` largest (k>0) / smallest (k<0) values along
+    ``axis`` (see :func:`topk`)."""
+    k, axis = _topk_args(x, k, axis, "argtopk")
+    return _topk_impl(x, k, axis, want_indices=True)

@@ -141,3 +141,47 @@ def test_nanquantile_on_jax_executor(spec):
         np.testing.assert_allclose(
             got, np.nanquantile(an, 0.5, axis=1), atol=1e-10, equal_nan=True
         )
+
+
+def test_topk_argtopk(spec):
+    rng = np.random.default_rng(7)
+    an = rng.standard_normal((5, 40))
+    a = ct.from_array(an, chunks=(2, 10), spec=spec)
+    got = asnp(xp.topk(a, 3, axis=1))
+    np.testing.assert_allclose(got, -np.sort(-an, axis=1)[:, :3])
+    got_small = asnp(xp.topk(a, -2, axis=1))
+    np.testing.assert_allclose(got_small, np.sort(an, axis=1)[:, :2])
+    gi = asnp(xp.argtopk(a, 3, axis=1))
+    np.testing.assert_allclose(
+        np.take_along_axis(an, gi, axis=1), -np.sort(-an, axis=1)[:, :3]
+    )
+    with pytest.raises(ValueError):
+        xp.topk(a, 0)
+    with pytest.raises(ValueError):
+        xp.topk(a, 99, axis=1)
+
+
+def test_topk_one_pass_engine(tmp_path):
+    # k << n with a tight budget: the one-pass path must fire (the full
+    # sort network would also work, but the plan should carry topk ops)
+    rng = np.random.default_rng(8)
+    an = rng.standard_normal(200_000)
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem=600_000)
+    a = ct.from_array(an, chunks=(10_000,), spec=spec)
+    t = xp.topk(a, 5)
+    ops = [d.get("op_name", "") for _, d in t.plan.dag.nodes(data=True)]
+    assert any("topk_local" in o for o in ops), ops
+    np.testing.assert_allclose(asnp(t), -np.sort(-an)[:5])
+    gi = asnp(xp.argtopk(a, 5))
+    np.testing.assert_allclose(an[gi], -np.sort(-an)[:5])
+
+
+def test_topk_ragged_and_jax(spec):
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    an = np.random.default_rng(9).standard_normal((3, 23))  # ragged last
+    a = ct.from_array(an, chunks=(2, 5), spec=spec)
+    got = np.asarray(xp.topk(a, 4, axis=1).compute(executor=JaxExecutor()))
+    np.testing.assert_allclose(got, -np.sort(-an, axis=1)[:, :4])
+    with pytest.raises(IndexError):
+        xp.topk(a, 2, axis=5)
