@@ -23,6 +23,7 @@ from .core.ops import (  # noqa: F401
     from_zarr,
     map_blocks,
     map_direct,
+    map_overlap,
     merge_chunks,
     rechunk,
     store,
@@ -52,6 +53,7 @@ __all__ = [
     "to_zarr",
     "apply_gufunc",
     "map_direct",
+    "map_overlap",
     "merge_chunks",
     "nanmax",
     "nanmean",
