@@ -165,9 +165,17 @@ if {warmup!r}:
     print("warmup done in", round(time.perf_counter() - w0, 2), "s",
           file=sys.stderr, flush=True)
 
+# capture the per-compute observability snapshot (task counters, IO bytes,
+# per-op wall clock) so bench records carry metric trajectories for free
+class _StatsCapture:
+    stats = None
+    def on_compute_end(self, event):
+        self.stats = event.executor_stats
+
+cap = _StatsCapture()
 s = build()
 t0 = time.perf_counter()
-val = s.compute(**kw)
+val = s.compute(callbacks=[cap], **kw)
 t1 = time.perf_counter()
 v = float(val)
 if workload in ("addsum", "addsum_scaled"):
@@ -186,7 +194,10 @@ elif workload == "reduce":
     assert 0.45 < v < 0.55, v  # max over 8000 column means of uniforms ~ 0.5
 else:
     assert 0.45 < v < 0.55, v  # mean of u1*u2 + u3*u4 over uniforms is ~0.5
-print(json.dumps({{"elapsed": t1 - t0, "value": v}}), flush=True)
+print(json.dumps(
+    {{"elapsed": t1 - t0, "value": v, "executor_stats": cap.stats}},
+    default=str,
+), flush=True)
 """
 
 SMOKE = r"""
@@ -526,6 +537,7 @@ def main() -> None:
             if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 30:
                 cpu_results[workload] = measure_cpu(workload, cap)
 
+    metrics_record: dict = {}
     for workload, metric, work, unit, cap in CONFIGS:
         res, sfx = device_results.get(workload), ""
         if res is None:
@@ -534,6 +546,26 @@ def main() -> None:
                 sfx = "_unavailable"
         base = baselines.get(BASELINE_KEY.get(workload, workload))
         emit(metric + sfx, res, base, work, unit=unit)
+        if res is not None:
+            metrics_record[metric + sfx] = {
+                "elapsed": res.get("elapsed"),
+                "value": res.get("value"),
+                "executor_stats": res.get("executor_stats"),
+            }
+
+    # per-op timing / IO-byte trajectories ride alongside the headline
+    # numbers so future rounds can localize regressions without re-profiling
+    try:
+        path = os.path.join(REPO, "BENCH_METRICS.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"t": time.strftime("%Y-%m-%dT%H:%M:%S"), "configs": metrics_record},
+                f, indent=1, default=str,
+            )
+        os.replace(tmp, path)
+    except OSError as e:
+        print(f"could not write BENCH_METRICS.json: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

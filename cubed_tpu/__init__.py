@@ -34,6 +34,7 @@ from .nan_functions import nanmax, nanmean, nanmin, nansum  # noqa: F401
 
 from . import array_api  # noqa: F401
 from .array_api import Array  # noqa: F401  (reference: cubed/__init__.py)
+from . import observability  # noqa: F401
 from . import random  # noqa: F401
 
 __all__ = [
@@ -60,5 +61,6 @@ __all__ = [
     "nanmin",
     "nansum",
     "array_api",
+    "observability",
     "random",
 ]

@@ -11,7 +11,7 @@ Reference parity: cubed/core/plan.py (behavioral; clean-room).
 from __future__ import annotations
 
 import inspect
-import itertools
+import logging
 import shutil
 import tempfile
 import uuid
@@ -21,23 +21,25 @@ from typing import Any, Callable, Optional, Sequence
 import networkx as nx
 
 from ..primitive.types import CubedPipeline, PrimitiveOperation
-from ..runtime.pipeline import already_computed
+from ..runtime.pipeline import already_computed, iter_op_nodes
 from ..runtime.types import (
     ComputeEndEvent,
     ComputeStartEvent,
     callbacks_on,
 )
 from ..storage.zarr import LazyZarrArray
-from ..utils import StackSummary, extract_stack_summaries, join_path, memory_repr
+from ..utils import (  # noqa: F401  (gensym re-exported for plan builders)
+    StackSummary,
+    extract_stack_summaries,
+    gensym,
+    join_path,
+    memory_repr,
+)
+
+logger = logging.getLogger(__name__)
 
 #: unique run id for this client process; work_dir data lives under it
 CONTEXT_ID = f"cubed-{uuid.uuid4().hex[:10]}"
-
-sym_counter = itertools.count()
-
-
-def gensym(name: str = "op") -> str:
-    return f"{name}-{next(sym_counter):03d}"
 
 
 def new_temp_path(name: str, spec=None) -> str:
@@ -197,12 +199,8 @@ class Plan:
         )
         # run before every other op (reference: edges to all pipeline nodes,
         # cubed/core/plan.py:136-176)
-        for name, data in list(dag.nodes(data=True)):
-            if (
-                data.get("type") == "op"
-                and name != op_node
-                and data.get("primitive_op") is not None
-            ):
+        for name, _ in list(iter_op_nodes(dag)):
+            if name != op_node:
                 dag.add_edge(op_node, name)
         return dag
 
@@ -227,20 +225,64 @@ class Plan:
         finalized = self._finalize(optimize_graph, optimize_function, array_names)
         dag = finalized.dag
 
-        callbacks_on(callbacks, "on_compute_start", ComputeStartEvent(dag, resume))
-        executor.execute_dag(
-            dag,
-            callbacks=callbacks,
-            array_names=array_names,
-            resume=resume,
-            spec=spec,
-            **kwargs,
-        )
-        callbacks_on(
-            callbacks,
-            "on_compute_end",
-            ComputeEndEvent(dag, executor_stats=getattr(executor, "stats", None)),
-        )
+        # every compute carries an aggregator: it folds per-task stats
+        # (completion counts, storage bytes measured where each task ran)
+        # into the process metrics registry and builds the per-op summary
+        from ..observability.callback import _ComputeAggregator
+        from ..observability.metrics import get_registry
+
+        aggregator = _ComputeAggregator()
+        all_callbacks = list(callbacks) if callbacks else []
+        all_callbacks.append(aggregator)
+        metrics_before = get_registry().snapshot()
+
+        callbacks_on(all_callbacks, "on_compute_start", ComputeStartEvent(dag, resume))
+        try:
+            executor.execute_dag(
+                dag,
+                callbacks=all_callbacks,
+                array_names=array_names,
+                resume=resume,
+                spec=spec,
+                **kwargs,
+            )
+        finally:
+            # on_compute_end fires even when the compute FAILS: that is when
+            # a trace of the partial run (TracingCallback's trace.json) and
+            # the stats gathered so far matter most. Stats assembly is
+            # guarded so it can never mask the executor's own exception.
+            #
+            # executor_stats: the executor's own counters, overlaid with
+            # this compute's metrics delta (task/retry/byte counters) and
+            # the per-op wall-clock + projected-vs-measured summary.
+            # Overlay order is deliberate: where an executor's lifetime
+            # counter shares a name with a registry metric (a persistent
+            # distributed fleet's task_timeouts/workers_lost), the
+            # PER-COMPUTE windowed value wins — lifetime totals remain
+            # available on executor.stats itself.
+            #
+            # Known limitation: the registry is process-global, so computes
+            # running CONCURRENTLY in one process see each other's counter
+            # increments in their windows (docs/observability.md). The
+            # event-derived numbers (per_op, tasks/bytes via the
+            # aggregator's own fold) are exact per compute either way.
+            stats: dict = {}
+            try:
+                executor_own = getattr(executor, "stats", None)
+                if executor_own:
+                    stats.update(dict(executor_own))
+                stats.update(get_registry().snapshot_delta(metrics_before))
+                stats.update(aggregator.summary())
+            except Exception:
+                logger.exception(
+                    "failed to assemble executor_stats; reporting partial "
+                    "stats (%d keys)", len(stats)
+                )
+            callbacks_on(
+                all_callbacks,
+                "on_compute_end",
+                ComputeEndEvent(dag, executor_stats=stats or None),
+            )
 
     # -- introspection -----------------------------------------------------
 
@@ -300,11 +342,7 @@ class FinalizedPlan:
         return sum(1 for _, d in self.dag.nodes(data=True) if d.get("type") == "array")
 
     def num_ops(self) -> int:
-        return sum(
-            1
-            for _, d in self.dag.nodes(data=True)
-            if d.get("type") == "op" and d.get("primitive_op") is not None
-        )
+        return sum(1 for _ in iter_op_nodes(self.dag))
 
     def max_projected_mem(self, resume=None) -> int:
         nodes = dict(self.dag.nodes(data=True))

@@ -1,6 +1,10 @@
 """HistoryCallback: record plan-time projections and per-task measurements,
 write CSVs, and compute projected-memory utilization.
 
+A thin view over the unified observability event stream
+(``observability.EventLogCallback`` collects plan rows, task events and op
+timings; this class only adds the CSV dump).
+
 Reference parity: cubed/extensions/history.py:11-103.
 """
 
@@ -9,47 +13,18 @@ from __future__ import annotations
 import csv
 import os
 import time
-from dataclasses import asdict, dataclass, field
-from typing import Optional
+from dataclasses import asdict
 
-from ..runtime.types import Callback, TaskEndEvent
-
-
-@dataclass
-class PlanRow:
-    array_name: str
-    op_name: str
-    projected_mem: int
-    reserved_mem: int
-    num_tasks: int
+from ..observability.events import EventLogCallback, PlanRow  # noqa: F401
 
 
-class HistoryCallback(Callback):
+class HistoryCallback(EventLogCallback):
     def __init__(self, history_dir: str = "history"):
+        super().__init__()
         self.history_dir = history_dir
-        self.plan: list[PlanRow] = []
-        self.events: list[TaskEndEvent] = []
-
-    def on_compute_start(self, event) -> None:
-        self.plan = []
-        self.events = []
-        for name, d in event.dag.nodes(data=True):
-            if d.get("type") == "op" and d.get("primitive_op") is not None:
-                op = d["primitive_op"]
-                self.plan.append(
-                    PlanRow(
-                        array_name=name,
-                        op_name=d.get("op_name", ""),
-                        projected_mem=op.projected_mem,
-                        reserved_mem=op.reserved_mem,
-                        num_tasks=op.num_tasks,
-                    )
-                )
-
-    def on_task_end(self, event: TaskEndEvent) -> None:
-        self.events.append(event)
 
     def on_compute_end(self, event) -> None:
+        super().on_compute_end(event)
         ts = int(time.time())
         os.makedirs(self.history_dir, exist_ok=True)
         self._write_csv(
@@ -66,22 +41,7 @@ class HistoryCallback(Callback):
 
     def stats(self) -> list[dict]:
         """Join plan projections against measured peaks per op."""
-        peak_by_array: dict[str, int] = {}
-        for e in self.events:
-            if e.peak_measured_mem_end is not None:
-                peak_by_array[e.array_name] = max(
-                    peak_by_array.get(e.array_name, 0), e.peak_measured_mem_end
-                )
-        rows = []
-        for r in self.plan:
-            peak = peak_by_array.get(r.array_name)
-            row = asdict(r)
-            row["peak_measured_mem"] = peak
-            row["projected_mem_utilization"] = (
-                peak / r.projected_mem if peak and r.projected_mem else None
-            )
-            rows.append(row)
-        return rows
+        return self.projected_vs_measured()
 
     @staticmethod
     def _write_csv(path: str, rows: list[dict]) -> None:

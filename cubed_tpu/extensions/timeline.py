@@ -1,36 +1,30 @@
 """TimelineVisualizationCallback: scatter plot of task lifecycle timestamps.
 
-Reference parity: cubed/extensions/timeline.py:17-103. Degrades to a CSV dump
-when matplotlib is unavailable.
+A thin view over the unified observability event stream
+(``observability.EventLogCallback``); this class only adds the plot/CSV
+rendering. Degrades to a CSV dump when matplotlib is unavailable.
+
+Reference parity: cubed/extensions/timeline.py:17-103.
 """
 
 from __future__ import annotations
 
 import os
 import time
-from typing import Optional
 
-from ..runtime.types import Callback, TaskEndEvent
+from ..observability.events import EventLogCallback
 
 
-class TimelineVisualizationCallback(Callback):
+class TimelineVisualizationCallback(EventLogCallback):
     def __init__(self, plots_dir: str = "plots", format: str = "png"):
+        super().__init__()
         self.plots_dir = plots_dir
         self.format = format
-        self.start_tstamp: Optional[float] = None
-        self.stats: list[TaskEndEvent] = []
-
-    def on_compute_start(self, event) -> None:
-        self.start_tstamp = time.time()
-        self.stats = []
-
-    def on_task_end(self, event: TaskEndEvent) -> None:
-        self.stats.append(event)
 
     def on_compute_end(self, event) -> None:
-        end_tstamp = time.time()
+        super().on_compute_end(event)
         os.makedirs(self.plots_dir, exist_ok=True)
-        ts = int(self.start_tstamp or end_tstamp)
+        ts = int(self.start_tstamp or self.end_tstamp or time.time())
         try:
             self._plot(ts)
         except ImportError:
@@ -39,7 +33,7 @@ class TimelineVisualizationCallback(Callback):
     def _rows(self):
         t0 = self.start_tstamp or 0
         rows = []
-        for i, e in enumerate(self.stats):
+        for i, e in enumerate(self.events):
             rows.append(
                 dict(
                     index=i,

@@ -1,4 +1,7 @@
-"""TqdmProgressBar: one progress bar per op, updated on task end.
+"""TqdmProgressBar: one progress bar per op, driven by the unified callback
+lifecycle — a bar opens on ``on_operation_start``, advances on
+``on_task_end``, and closes on ``on_operation_end`` (so ops that never ran,
+e.g. under ``resume``, never show a bar).
 
 Reference parity: cubed/extensions/tqdm.py:10-55. Falls back to a plain
 line-printing bar when tqdm is unavailable.
@@ -34,31 +37,41 @@ class TqdmProgressBar(Callback):
     def __init__(self, **tqdm_kwargs):
         self.tqdm_kwargs = tqdm_kwargs
         self.bars: Dict[str, object] = {}
+        self._position = 0
 
     def on_compute_start(self, event) -> None:
         self.bars = {}
+        self._position = 0
         try:
             from tqdm.auto import tqdm  # noqa: F401
 
             self._tqdm = tqdm
         except ImportError:
             self._tqdm = None
-        i = 0
-        for name, d in event.dag.nodes(data=True):
-            if d.get("type") == "op" and d.get("primitive_op") is not None:
-                total = d["primitive_op"].num_tasks
-                if self._tqdm is not None:
-                    self.bars[name] = self._tqdm(
-                        desc=name, total=total, position=i, **self.tqdm_kwargs
-                    )
-                else:
-                    self.bars[name] = _PlainBar(name, total)
-                i += 1
+
+    def on_operation_start(self, event) -> None:
+        if event.name in self.bars:
+            return
+        if self._tqdm is not None:
+            self.bars[event.name] = self._tqdm(
+                desc=event.name,
+                total=event.num_tasks,
+                position=self._position,
+                **self.tqdm_kwargs,
+            )
+        else:
+            self.bars[event.name] = _PlainBar(event.name, event.num_tasks)
+        self._position += 1
 
     def on_task_end(self, event: TaskEndEvent) -> None:
         bar = self.bars.get(event.array_name)
         if bar is not None:
             bar.update(event.num_tasks)
+
+    def on_operation_end(self, event) -> None:
+        bar = self.bars.get(event.name)
+        if bar is not None:
+            bar.close()
 
     def on_compute_end(self, event) -> None:
         for bar in self.bars.values():

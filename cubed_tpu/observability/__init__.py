@@ -1,0 +1,32 @@
+"""Unified observability: span tracing, metrics, and byte accounting.
+
+Every executor reports through one event stream (the ``Callback`` lifecycle
+in ``runtime/types.py``); this package turns that stream into
+
+- **traces**: :class:`TracingCallback` writes a Perfetto/chrome://tracing
+  loadable ``trace.json`` with one span per task (op, chunk key, attempt,
+  executor, peak memory) — see ``docs/observability.md``;
+- **metrics**: a process-local :class:`MetricsRegistry`
+  (:func:`get_registry`) of counters/gauges/histograms, snapshotted into
+  ``ComputeEndEvent.executor_stats`` for every compute;
+- **byte accounting**: the Zarr storage layer records per-store
+  ``bytes_read`` / ``bytes_written``, attributed to the task that did the
+  IO even across process boundaries (``accounting.task_scope``).
+"""
+
+from .accounting import (  # noqa: F401
+    record_bytes_read,
+    record_bytes_written,
+    record_virtual_read,
+    reset_store_totals,
+    store_totals,
+    task_scope,
+)
+from .callback import TracingCallback  # noqa: F401
+from .events import EventLogCallback, PlanRow  # noqa: F401
+from .metrics import (  # noqa: F401
+    MetricsRegistry,
+    get_registry,
+    merge_snapshots,
+)
+from .tracer import Tracer  # noqa: F401

@@ -1,0 +1,156 @@
+"""Byte accounting for storage IO, with per-task attribution.
+
+The storage layer calls ``record_bytes_read`` / ``record_bytes_written`` on
+every chunk transfer. Attribution rules:
+
+- Inside an active **task scope** (``task_scope()`` — entered by
+  ``execute_with_stats`` around every task body), bytes accumulate on the
+  scope object and ride back to the client in the task's stats dict. This is
+  what makes the numbers survive process boundaries: multiprocess and
+  distributed workers measure their own IO and the client aggregates it from
+  ``TaskEndEvent``s.
+- Outside any task scope (the JAX executor's whole-array preloads/flushes,
+  plan-level metadata ops), bytes go straight to the process registry.
+
+The two paths are exclusive by construction, so summing task-event bytes
+into the registry (``callback._ComputeAggregator``) never double-counts.
+
+A bounded per-store breakdown (``store_totals()``) is kept in-process either
+way, for debugging which store dominates IO; overflow beyond
+``MAX_TRACKED_STORES`` aggregates under ``"<other>"``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from .metrics import get_registry
+
+#: cap on per-store breakdown entries (plans create one temp store per
+#: intermediate array; an unbounded dict would grow with every plan)
+MAX_TRACKED_STORES = 128
+
+_tls = threading.local()
+
+_store_lock = threading.Lock()
+_store_totals: Dict[str, list] = {}
+
+
+class TaskScope:
+    """Accumulates IO attributed to one task body."""
+
+    __slots__ = (
+        "bytes_read",
+        "bytes_written",
+        "chunks_read",
+        "chunks_written",
+        "virtual_bytes_read",
+    )
+
+    def __init__(self):
+        self.bytes_read = 0
+        self.bytes_written = 0
+        self.chunks_read = 0
+        self.chunks_written = 0
+        self.virtual_bytes_read = 0
+
+    def stats(self) -> dict:
+        return {
+            "bytes_read": self.bytes_read,
+            "bytes_written": self.bytes_written,
+            "chunks_read": self.chunks_read,
+            "chunks_written": self.chunks_written,
+            "virtual_bytes_read": self.virtual_bytes_read,
+        }
+
+
+class task_scope:
+    """Context manager establishing a per-task accounting scope.
+
+    Scopes nest (a task body running a nested compute): each byte is
+    attributed to the INNERMOST scope only, never folded outward — the
+    inner task's event already carries those bytes into client-side
+    aggregation, so folding them into the outer task's stats as well would
+    count them twice.
+    """
+
+    def __enter__(self) -> TaskScope:
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        self._scope = TaskScope()
+        stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc) -> None:
+        _tls.stack.pop()
+
+
+def current_scope() -> Optional[TaskScope]:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def _track_store(store: str, read: int, written: int) -> None:
+    key = str(store)
+    with _store_lock:
+        entry = _store_totals.get(key)
+        if entry is None:
+            if len(_store_totals) >= MAX_TRACKED_STORES:
+                key = "<other>"
+                entry = _store_totals.get(key)
+            if entry is None:
+                entry = _store_totals[key] = [0, 0]
+        entry[0] += read
+        entry[1] += written
+
+
+def record_bytes_read(store: str, n: int) -> None:
+    scope = current_scope()
+    if scope is not None:
+        scope.bytes_read += n
+        scope.chunks_read += 1
+    else:
+        reg = get_registry()
+        reg.counter("bytes_read").inc(n)
+        reg.counter("chunks_read").inc()
+    _track_store(store, n, 0)
+
+
+def record_bytes_written(store: str, n: int) -> None:
+    scope = current_scope()
+    if scope is not None:
+        scope.bytes_written += n
+        scope.chunks_written += 1
+    else:
+        reg = get_registry()
+        reg.counter("bytes_written").inc(n)
+        reg.counter("chunks_written").inc()
+    _track_store(store, 0, n)
+
+
+def record_virtual_read(n: int) -> None:
+    """A read served by a virtual (never-materialized) array: logical bytes,
+    no IO — tracked separately from ``bytes_read`` so that stays an IO
+    number, but still scope-attributed so worker-side virtual reads reach
+    the client like real IO does."""
+    scope = current_scope()
+    if scope is not None:
+        scope.virtual_bytes_read += n
+    else:
+        get_registry().counter("virtual_bytes_read").inc(n)
+
+
+def store_totals() -> Dict[str, dict]:
+    """Per-store {bytes_read, bytes_written} seen by THIS process."""
+    with _store_lock:
+        return {
+            k: {"bytes_read": r, "bytes_written": w}
+            for k, (r, w) in _store_totals.items()
+        }
+
+
+def reset_store_totals() -> None:
+    with _store_lock:
+        _store_totals.clear()

@@ -1,0 +1,277 @@
+"""Process-local metrics: counters, gauges, and histograms.
+
+One registry serves the whole process (``get_registry()``); executors, the
+distributed coordinator and the storage layer all report through it, so a
+compute's ``ComputeEndEvent.executor_stats`` can carry a single coherent
+snapshot. ``snapshot()`` is a plain flat dict (JSON-serializable), so it can
+ride inside bench records, cross process boundaries, and be merged with
+``merge_snapshots`` (worker-side snapshots folding into a coordinator's).
+
+The canonical metric names used across the codebase:
+
+- ``tasks_completed`` / ``tasks_started`` — task lifecycle counts
+- ``task_retries`` / ``task_timeouts`` / ``speculative_backups`` /
+  ``workers_lost`` — the reliability machinery's counters
+- ``bytes_read`` / ``bytes_written`` / ``chunks_read`` / ``chunks_written``
+  — Zarr store IO (see ``accounting.py``)
+- ``virtual_bytes_read`` — reads served by virtual (never-materialized) arrays
+- ``queue_depth`` — gauge of in-flight tasks in the completion-ordered map
+- ``op_wall_clock_s`` — histogram of per-operation wall clock
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class Counter:
+    """A monotonically increasing integer."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """A point-in-time value; tracks the maximum it has ever been set to."""
+
+    __slots__ = ("name", "_value", "_max", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._max = 0
+        self._lock = threading.Lock()
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def max(self):
+        return self._max
+
+
+class Histogram:
+    """Streaming summary (count/sum/min/max) of an observed quantity."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min,
+                "max": self.max,
+                "mean": (self.sum / self.count) if self.count else None,
+            }
+
+
+class MetricsRegistry:
+    """Named counters/gauges/histograms with a flat dict snapshot.
+
+    Snapshot keys: a counter appears under its name; a gauge under its name
+    plus ``<name>_max``; a histogram under ``<name>`` as a nested summary
+    dict. ``snapshot_delta(before)`` subtracts counter/histogram
+    accumulations so a long-lived process (a persistent fleet, a REPL) can
+    report per-compute numbers.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name)
+            return h
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            histograms = list(self._histograms.values())
+        out: dict = {}
+        for c in counters:
+            out[c.name] = c.value
+        for g in gauges:
+            out[g.name] = g.value
+            out[f"{g.name}_max"] = g.max
+        for h in histograms:
+            out[h.name] = h.summary()
+        return out
+
+    def snapshot_delta(self, before: dict) -> dict:
+        """Current snapshot minus a previous one.
+
+        Counters and histogram count/sum/mean subtract, so the result is a
+        true per-window reading. Quantities that CANNOT be windowed from two
+        snapshots are dropped rather than reported stale: a gauge's
+        ``_max`` key appears only if the window set a new high, a gauge's
+        instantaneous value is omitted entirely (the end-of-window reading —
+        e.g. ``queue_depth`` after the queue drained — measures nothing),
+        and histogram summaries omit lifetime min/max (a long-lived process
+        — persistent fleet, bench loop — must not attribute an old
+        compute's extremes to a later one)."""
+        now = self.snapshot()
+        with self._lock:
+            gauge_names = set(self._gauges)
+        out: dict = {}
+        for k, v in now.items():
+            prev = before.get(k)
+            if isinstance(v, dict):  # histogram summary
+                pc = (prev or {}).get("count", 0) if isinstance(prev, dict) else 0
+                ps = (prev or {}).get("sum", 0.0) if isinstance(prev, dict) else 0.0
+                count = v["count"] - pc
+                out[k] = {
+                    "count": count,
+                    "sum": v["sum"] - ps,
+                    "mean": ((v["sum"] - ps) / count) if count else None,
+                }
+            elif k.endswith("_max") and k[: -len("_max")] in gauge_names:
+                # lifetime high-water mark: only meaningful for this window
+                # if the window raised it
+                if not isinstance(prev, (int, float)) or v > prev:
+                    out[k] = v
+            elif k in gauge_names:
+                continue  # instantaneous reading: not a per-window quantity
+            elif isinstance(prev, (int, float)):
+                out[k] = v - prev
+            else:
+                out[k] = v
+        return out
+
+    def report(self) -> str:
+        """Human-readable table of the current snapshot."""
+        snap = self.snapshot()
+        if not snap:
+            return "(no metrics recorded)"
+        rows = []
+        for k in sorted(snap):
+            v = snap[k]
+            if isinstance(v, dict):
+                mean = v.get("mean")
+                rows.append(
+                    (k, f"count={v['count']} sum={_fmt(v['sum'])} "
+                        f"mean={_fmt(mean)} min={_fmt(v['min'])} "
+                        f"max={_fmt(v['max'])}")
+                )
+            else:
+                rows.append((k, _fmt(v)))
+        width = max(len(k) for k, _ in rows)
+        lines = [f"{k.ljust(width)}  {v}" for k, v in rows]
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def merge_snapshots(a: dict, b: dict) -> dict:
+    """Combine two snapshots: counters add, histogram summaries fold, and
+    gauge readings take the max. A gauge is recognized structurally — a key
+    whose ``<key>_max`` sibling exists in either snapshot (``snapshot()``
+    always emits both) — because summing point-in-time readings (e.g. two
+    workers each reporting queue_depth=3) would claim load that never
+    existed at any instant. Used to merge worker-side metrics into a
+    coordinator-side view."""
+    out = dict(a)
+    for k, v in b.items():
+        if k not in out:
+            out[k] = v
+        elif (
+            isinstance(v, (int, float))
+            and isinstance(out[k], (int, float))
+            and (f"{k}_max" in a or f"{k}_max" in b)
+        ):
+            out[k] = max(out[k], v)  # gauge reading: point-in-time, not additive
+        elif isinstance(v, dict) and isinstance(out[k], dict):
+            ac, bc = out[k], v
+            count = (ac.get("count") or 0) + (bc.get("count") or 0)
+            total = (ac.get("sum") or 0.0) + (bc.get("sum") or 0.0)
+            mins = [x for x in (ac.get("min"), bc.get("min")) if x is not None]
+            maxs = [x for x in (ac.get("max"), bc.get("max")) if x is not None]
+            out[k] = {
+                "count": count,
+                "sum": total,
+                "mean": (total / count) if count else None,
+                "min": min(mins) if mins else None,
+                "max": max(maxs) if maxs else None,
+            }
+        elif isinstance(v, (int, float)) and isinstance(out[k], (int, float)):
+            out[k] = max(out[k], v) if k.endswith("_max") else out[k] + v
+        else:
+            out[k] = v
+    return out
+
+
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry."""
+    return _default_registry

@@ -31,19 +31,21 @@ from ..backend_array_api import (
 from ..chunks import numblocks as chunks_to_numblocks
 from ..chunks import blockdims_from_blockshape
 from ..storage.zarr import lazy_empty
-from ..utils import chunk_memory, get_item, map_nested, memory_repr, split_into, to_chunksize
+from ..utils import (  # noqa: F401  (gensym re-exported for rechunk/tests)
+    chunk_memory,
+    gensym,
+    get_item,
+    map_nested,
+    memory_repr,
+    split_into,
+    to_chunksize,
+)
 from .types import (
     CubedArrayProxy,
     CubedPipeline,
     MemoryModeller,
     PrimitiveOperation,
 )
-
-sym_counter = itertools.count()
-
-
-def gensym(name: str = "op") -> str:
-    return f"{name}-{next(sym_counter):03d}"
 
 
 # ---------------------------------------------------------------------------

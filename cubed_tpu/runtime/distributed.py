@@ -54,6 +54,8 @@ from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Any, Dict, Optional
 
+from ..observability.metrics import get_registry
+
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct(">Q")
@@ -149,6 +151,8 @@ class _WorkerConn:
         #: different ghost's slot)
         self.ghost_ids: set[int] = set()
         self.blobs_sent: set[str] = set()
+        #: total tasks ever routed to this worker (load diagnostics)
+        self.tasks_sent = 0
         self.alive = True
 
 
@@ -184,11 +188,16 @@ class Coordinator:
         #: already hold it are not resent)
         self._blob_cache: OrderedDict[tuple, tuple] = OrderedDict()
         self._blob_cache_size = max(1, blob_cache_size)
+        #: final load rows of workers that left (crash/shutdown), so the
+        #: stats snapshot doesn't erase history exactly when a worker is
+        #: lost; bounded LRU (a long-lived fleet churns workers)
+        self._departed: OrderedDict[str, dict] = OrderedDict()
         self.task_timeout = task_timeout
         self.timeout_strikes = timeout_strikes
         #: diagnostics: blob bytes actually sent vs referenced by id
         self.stats: Dict[str, int] = {
             "blobs_sent": 0, "tasks_sent": 0, "task_timeouts": 0,
+            "workers_lost": 0,
         }
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="coordinator-accept", daemon=True
@@ -253,6 +262,16 @@ class Coordinator:
             if conn in self._workers:
                 self._workers.remove(conn)
             orphans = list(conn.outstanding.items())
+            self._departed[conn.name] = {
+                "alive": False,
+                "reason": reason,
+                "nthreads": conn.nthreads,
+                "outstanding": 0,
+                "ghosts": len(conn.ghost_ids),
+                "tasks_sent": conn.tasks_sent,
+            }
+            while len(self._departed) > 32:
+                self._departed.popitem(last=False)
             conn.outstanding.clear()
             conn.deadlines.clear()
         try:
@@ -264,6 +283,8 @@ class Coordinator:
                 fut, WorkerLostError(f"worker {conn.name} lost: {reason}")
             )
         if orphans or reason != "shutdown":
+            self.stats["workers_lost"] += 1
+            get_registry().counter("workers_lost").inc()
             logger.warning(
                 "worker %s dropped (%s); failed %d in-flight tasks",
                 conn.name, reason, len(orphans),
@@ -346,6 +367,7 @@ class Coordinator:
                             timed_out.append((fut, conn.name, tid))
                     if overdue:
                         self.stats["task_timeouts"] += len(overdue)
+                        get_registry().counter("task_timeouts").inc(len(overdue))
                         # only tasks the worker acked as started count as
                         # hangs; queued/cold-start timeouts just reroute
                         conn.timeout_strikes += sum(
@@ -447,10 +469,30 @@ class Coordinator:
             with self._lock:
                 # only mark the blob delivered once the send has succeeded
                 conn.blobs_sent.add(blob_id)
+                conn.tasks_sent += 1
             self.stats["tasks_sent"] += 1
             if first_use:
                 self.stats["blobs_sent"] += 1
             return fut
+
+    def stats_snapshot(self) -> dict:
+        """Counters plus a per-worker load view (outstanding tasks, ghost
+        slots, lifetime tasks routed) for ``executor_stats``/debugging.
+        Departed workers keep their final row (``alive: False`` + drop
+        reason) so worker loss remains visible in the snapshot."""
+        out: dict = dict(self.stats)
+        with self._lock:
+            workers: dict = {name: dict(row) for name, row in self._departed.items()}
+            for w in self._workers:
+                workers[w.name] = {
+                    "alive": w.alive,
+                    "nthreads": w.nthreads,
+                    "outstanding": len(w.outstanding),
+                    "ghosts": len(w.ghost_ids),
+                    "tasks_sent": w.tasks_sent,
+                }
+        out["workers"] = workers
+        return out
 
     def close(self) -> None:
         self._closed.set()

@@ -32,8 +32,13 @@ from typing import Optional
 
 from ..distributed import Coordinator
 from ..pipeline import visit_node_generations, visit_nodes
-from ..types import DagExecutor, OperationStartEvent, callbacks_on
-from ..utils import merge_generation
+from ..types import (
+    DagExecutor,
+    OperationEndEvent,
+    OperationStartEvent,
+    callbacks_on,
+)
+from ..utils import end_generation, merge_generation
 from .multiprocess import _PLUGIN_ENV_PREFIXES
 from .python_async import DEFAULT_RETRIES, map_unordered
 
@@ -103,11 +108,12 @@ class DistributedDagExecutor(DagExecutor):
 
     @property
     def stats(self) -> dict:
-        """Coordinator counters (blobs_sent, tasks_sent, task_timeouts);
-        empty before the fleet starts."""
+        """Coordinator counters (blobs_sent, tasks_sent, task_timeouts,
+        workers_lost) plus a per-worker load snapshot; empty before the
+        fleet starts."""
         if self._coordinator is None:
             return {}
-        return dict(self._coordinator.stats)
+        return self._coordinator.stats_snapshot()
 
     @property
     def coordinator_address(self) -> Optional[str]:
@@ -220,6 +226,7 @@ class DistributedDagExecutor(DagExecutor):
             for generation in visit_node_generations(dag, resume=resume):
                 merged, pipelines = merge_generation(generation, callbacks)
                 if not merged:
+                    end_generation(generation, callbacks)
                     continue
                 map_unordered(
                     _InterleavedPool(coord, pipelines),
@@ -230,7 +237,9 @@ class DistributedDagExecutor(DagExecutor):
                     batch_size=batch_size,
                     callbacks=callbacks,
                     array_names=[name for name, _ in merged],
+                    executor_name=self.name,
                 )
+                end_generation(generation, callbacks)
         else:
             for name, node in visit_nodes(dag, resume=resume):
                 primitive_op = node["primitive_op"]
@@ -248,7 +257,12 @@ class DistributedDagExecutor(DagExecutor):
                     batch_size=batch_size,
                     callbacks=callbacks,
                     array_name=name,
+                    executor_name=self.name,
                     config=pipeline.config,
+                )
+                callbacks_on(
+                    callbacks, "on_operation_end",
+                    OperationEndEvent(name, primitive_op.num_tasks),
                 )
 
 

@@ -59,10 +59,12 @@ from ..pipeline import visit_nodes
 from ..types import (
     Callback,
     DagExecutor,
+    OperationEndEvent,
     OperationStartEvent,
     TaskEndEvent,
     callbacks_on,
 )
+from ..utils import fire_task_start
 
 logger = logging.getLogger(__name__)
 
@@ -391,6 +393,7 @@ class JaxExecutor(DagExecutor):
                 callbacks, "on_operation_start",
                 OperationStartEvent(name, primitive_op.num_tasks),
             )
+            fire_task_start(callbacks, name, num_tasks=primitive_op.num_tasks)
             t0 = time.time()
             self.stats["eager_ops"] += 1
             if pipeline.function is apply_blockwise:
@@ -416,7 +419,12 @@ class JaxExecutor(DagExecutor):
                     function_start_tstamp=t0,
                     function_end_tstamp=t1,
                     task_result_tstamp=t1,
+                    executor=self.name,
                 ),
+            )
+            callbacks_on(
+                callbacks, "on_operation_end",
+                OperationEndEvent(name, primitive_op.num_tasks),
             )
 
         for name, node in visit_nodes(dag, resume=resume):
@@ -575,6 +583,9 @@ class JaxExecutor(DagExecutor):
                 callbacks, "on_operation_start",
                 OperationStartEvent(name, node["primitive_op"].num_tasks),
             )
+            fire_task_start(
+                callbacks, name, num_tasks=node["primitive_op"].num_tasks
+            )
 
         traced = False
         if len(ops) > 0:
@@ -618,7 +629,12 @@ class JaxExecutor(DagExecutor):
                     function_start_tstamp=start,
                     function_end_tstamp=end,
                     task_result_tstamp=end,
+                    executor=self.name,
                 ),
+            )
+            callbacks_on(
+                callbacks, "on_operation_end",
+                OperationEndEvent(name, num_tasks),
             )
             start = end
 

@@ -30,8 +30,13 @@ import os
 from typing import Optional
 
 from ..pipeline import visit_node_generations, visit_nodes
-from ..types import DagExecutor, OperationStartEvent, callbacks_on
-from ..utils import merge_generation
+from ..types import (
+    DagExecutor,
+    OperationEndEvent,
+    OperationStartEvent,
+    callbacks_on,
+)
+from ..utils import end_generation, merge_generation
 from .python_async import DEFAULT_RETRIES, map_unordered
 
 logger = logging.getLogger(__name__)
@@ -167,7 +172,9 @@ class MultiprocessDagExecutor(DagExecutor):
                         batch_size=batch_size,
                         callbacks=callbacks,
                         array_names=[m[0] for m in merged],
+                        executor_name=self.name,
                     )
+                    end_generation(generation, callbacks)
             else:
                 for name, node in visit_nodes(dag, resume=resume):
                     primitive_op = node["primitive_op"]
@@ -186,6 +193,11 @@ class MultiprocessDagExecutor(DagExecutor):
                         batch_size=batch_size,
                         callbacks=callbacks,
                         array_name=name,
+                        executor_name=self.name,
+                    )
+                    callbacks_on(
+                        callbacks, "on_operation_end",
+                        OperationEndEvent(name, primitive_op.num_tasks),
                     )
         finally:
             pool.shutdown(wait=False, cancel_futures=True)

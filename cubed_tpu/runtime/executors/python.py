@@ -1,6 +1,7 @@
 """Sequential in-process executor — the correctness oracle.
 
-Reference parity: cubed/runtime/executors/python.py:14-32.
+Reference parity: cubed/runtime/executors/python.py:14-32, extended with the
+full callback lifecycle (task start / operation end).
 """
 
 from __future__ import annotations
@@ -9,15 +10,12 @@ import time
 
 from ..pipeline import visit_nodes
 from ..types import (
-    Callback,
-    ComputeEndEvent,
-    ComputeStartEvent,
     DagExecutor,
+    OperationEndEvent,
     OperationStartEvent,
-    TaskEndEvent,
     callbacks_on,
 )
-from ..utils import execute_with_stats, handle_callbacks
+from ..utils import chunk_key, execute_with_stats, fire_task_start, handle_callbacks
 
 
 class PythonDagExecutor(DagExecutor):
@@ -40,8 +38,20 @@ class PythonDagExecutor(DagExecutor):
             )
             for m in pipeline.mappable:
                 created = time.time()
+                key = chunk_key(m)
+                fire_task_start(callbacks, name, chunk_key_str=key)
                 _, stats = execute_with_stats(pipeline.function, m, config=pipeline.config)
                 handle_callbacks(
                     callbacks,
-                    dict(stats, array_name=name, task_create_tstamp=created),
+                    dict(
+                        stats,
+                        array_name=name,
+                        task_create_tstamp=created,
+                        chunk_key=key,
+                        executor=self.name,
+                    ),
                 )
+            callbacks_on(
+                callbacks, "on_operation_end",
+                OperationEndEvent(name, primitive_op.num_tasks),
+            )

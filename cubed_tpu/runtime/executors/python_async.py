@@ -14,15 +14,23 @@ import logging
 import time
 from typing import Callable, Dict, Iterable, Optional
 
+from ...observability.metrics import get_registry
 from ..backup import should_launch_backup
 from ..pipeline import visit_node_generations, visit_nodes
 from ..types import (
-    Callback,
     DagExecutor,
+    OperationEndEvent,
     OperationStartEvent,
     callbacks_on,
 )
-from ..utils import batched, execute_with_stats, handle_callbacks, merge_generation
+from ..utils import (
+    chunk_key,
+    end_generation,
+    execute_with_stats,
+    fire_task_start,
+    handle_callbacks,
+    merge_generation,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -40,6 +48,7 @@ def map_unordered(
     callbacks=None,
     array_name: Optional[str] = None,
     array_names: Optional[list] = None,
+    executor_name: Optional[str] = None,
     **kwargs,
 ) -> None:
     """Run function over inputs, handling completion order, retries, backups.
@@ -57,7 +66,7 @@ def map_unordered(
     if batch_size is None:
         _map_unordered_batch(
             executor, function, list(inputs), retries, use_backups,
-            callbacks, array_name, array_names, **kwargs,
+            callbacks, array_name, array_names, executor_name, **kwargs,
         )
     elif array_names is None:
         it = iter(inputs)
@@ -67,7 +76,7 @@ def map_unordered(
                 break
             _map_unordered_batch(
                 executor, function, batch, retries, use_backups,
-                callbacks, array_name, None, **kwargs,
+                callbacks, array_name, None, executor_name, **kwargs,
             )
     else:
         for start in range(0, len(inputs), batch_size):
@@ -80,6 +89,7 @@ def map_unordered(
                 callbacks,
                 array_name,
                 array_names[start : start + batch_size],
+                executor_name,
                 **kwargs,
             )
 
@@ -93,22 +103,47 @@ def _map_unordered_batch(
     callbacks,
     array_name,
     array_names: Optional[list] = None,
+    executor_name: Optional[str] = None,
     **kwargs,
 ) -> None:
+    metrics = get_registry()
     attempts: Dict[int, int] = {i: 0 for i in range(len(inputs))}
     start_times: Dict[object, float] = {}
     end_times: Dict[object, float] = {}
     create_times: Dict[int, float] = {}
-    # future -> (input index, is_backup)
-    pending: Dict[concurrent.futures.Future, tuple[int, bool]] = {}
+    # future -> (input index, is_backup, attempt number it was submitted as)
+    pending: Dict[concurrent.futures.Future, tuple[int, bool, int]] = {}
     backups: Dict[int, list[concurrent.futures.Future]] = {}
     done_inputs: set[int] = set()
 
+    key_cache: Dict[int, str] = {}
+
+    def op_of(i: int) -> str:
+        return array_names[i] if array_names is not None else array_name
+
+    def key_of(i: int) -> str:
+        # one str() per input, shared by the start event, retries/backups,
+        # and the end event — chunk keys are stable per task
+        key = key_cache.get(i)
+        if key is None:
+            # interleaved-generation items are (op_name, task_input) pairs
+            m = inputs[i][1] if array_names is not None else inputs[i]
+            key = key_cache[i] = chunk_key(m)
+        return key
+
     def submit(i: int, is_backup: bool = False):
         create_times.setdefault(i, time.time())
+        fire_task_start(
+            callbacks, op_of(i), key_fn=lambda: key_of(i),
+            attempt=attempts[i], backup=is_backup,
+        )
         fut = executor.submit(execute_with_stats, function, inputs[i], **kwargs)
         start_times[fut] = time.time()
-        pending[fut] = (i, is_backup)
+        # the submit-time attempt rides with the future so the end event
+        # reports the attempt that actually produced the result (a backup
+        # submitted as attempt 0 can win after the original fails and bumps
+        # attempts[i])
+        pending[fut] = (i, is_backup, attempts[i])
         if is_backup:
             backups.setdefault(i, []).append(fut)
         return fut
@@ -116,53 +151,69 @@ def _map_unordered_batch(
     for i in range(len(inputs)):
         submit(i)
 
-    while pending:
-        done, _ = concurrent.futures.wait(
-            list(pending), timeout=2, return_when=concurrent.futures.FIRST_COMPLETED
-        )
-        now = time.time()
-        for fut in done:
-            i, is_backup = pending.pop(fut)
-            end_times[fut] = now
-            if i in done_inputs:
-                continue  # a twin already won
-            try:
-                _, stats = fut.result()
-            except Exception:
-                attempts[i] += 1
-                # suppress if a backup twin is still running
-                twins = [f for f in pending if pending[f][0] == i]
-                if twins:
-                    continue
-                if attempts[i] > retries:
-                    # cancel all remaining work and re-raise
-                    for f in pending:
-                        f.cancel()
-                    raise
-                logger.info("retrying input %s (attempt %d)", i, attempts[i] + 1)
-                submit(i)
-                continue
-            done_inputs.add(i)
-            # cancel the losing twin(s)
-            for f in list(pending):
-                if pending[f][0] == i:
-                    f.cancel()
-                    del pending[f]
-            handle_callbacks(
-                callbacks,
-                dict(
-                    stats,
-                    array_name=array_names[i] if array_names is not None else array_name,
-                    task_create_tstamp=create_times[i],
-                ),
+    try:
+        while pending:
+            metrics.gauge("queue_depth").set(len(pending))
+            done, _ = concurrent.futures.wait(
+                list(pending), timeout=2, return_when=concurrent.futures.FIRST_COMPLETED
             )
-        if use_backups:
-            for fut, (i, is_backup) in list(pending.items()):
-                if is_backup or i in done_inputs or i in backups:
+            now = time.time()
+            for fut in done:
+                entry = pending.pop(fut, None)
+                if entry is None:
+                    # a twin that completed in the same wait batch as its
+                    # winner: the winner's cancel loop already removed it
                     continue
-                if should_launch_backup(fut, now, start_times, end_times):
-                    logger.info("launching backup for input %s", i)
-                    submit(i, is_backup=True)
+                i, is_backup, attempt = entry
+                end_times[fut] = now
+                if i in done_inputs:
+                    continue  # a twin already won
+                try:
+                    _, stats = fut.result()
+                except Exception:
+                    attempts[i] += 1
+                    # suppress if a backup twin is still running
+                    twins = [f for f in pending if pending[f][0] == i]
+                    if twins:
+                        continue
+                    if attempts[i] > retries:
+                        # cancel all remaining work and re-raise
+                        for f in pending:
+                            f.cancel()
+                        raise
+                    logger.info("retrying input %s (attempt %d)", i, attempts[i] + 1)
+                    metrics.counter("task_retries").inc()
+                    submit(i)
+                    continue
+                done_inputs.add(i)
+                # cancel the losing twin(s)
+                for f in list(pending):
+                    if pending[f][0] == i:
+                        f.cancel()
+                        del pending[f]
+                handle_callbacks(
+                    callbacks,
+                    dict(
+                        stats,
+                        array_name=op_of(i),
+                        task_create_tstamp=create_times[i],
+                        chunk_key=key_of(i),
+                        attempt=attempt,
+                        executor=executor_name,
+                    ),
+                )
+            if use_backups:
+                for fut, (i, is_backup, _attempt) in list(pending.items()):
+                    if is_backup or i in done_inputs or i in backups:
+                        continue
+                    if should_launch_backup(fut, now, start_times, end_times):
+                        logger.info("launching backup for input %s", i)
+                        metrics.counter("speculative_backups").inc()
+                        submit(i, is_backup=True)
+    finally:
+        # reset even when retries are exhausted mid-loop: a stale nonzero
+        # queue_depth would read as phantom in-flight tasks forever after
+        metrics.gauge("queue_depth").set(0)
 
 
 class AsyncPythonDagExecutor(DagExecutor):
@@ -218,6 +269,7 @@ class AsyncPythonDagExecutor(DagExecutor):
                         pool, merged, pipelines, retries, use_backups,
                         batch_size, callbacks,
                     )
+                    end_generation(generation, callbacks)
             else:
                 for name, node in visit_nodes(dag, resume=resume):
                     primitive_op = node["primitive_op"]
@@ -235,7 +287,12 @@ class AsyncPythonDagExecutor(DagExecutor):
                         batch_size=batch_size,
                         callbacks=callbacks,
                         array_name=name,
+                        executor_name=self.name,
                         config=pipeline.config,
+                    )
+                    callbacks_on(
+                        callbacks, "on_operation_end",
+                        OperationEndEvent(name, primitive_op.num_tasks),
                     )
 
     def _run_tasks(
@@ -255,4 +312,5 @@ class AsyncPythonDagExecutor(DagExecutor):
             batch_size=batch_size,
             callbacks=callbacks,
             array_names=[name for name, _ in merged],
+            executor_name=self.name,
         )

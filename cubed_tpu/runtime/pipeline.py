@@ -35,6 +35,15 @@ def already_computed(name, dag, nodes: dict, resume: bool | None) -> bool:
     return False
 
 
+def iter_op_nodes(dag) -> Iterator[tuple[str, dict]]:
+    """Yield (name, node-data) for every op node carrying a primitive_op —
+    the one predicate for 'this node represents real work', shared by the
+    observability callbacks and anything else scanning the plan."""
+    for name, d in dag.nodes(data=True):
+        if d.get("type") == "op" and d.get("primitive_op") is not None:
+            yield name, d
+
+
 def visit_nodes(dag, resume: bool | None = None) -> Iterator[tuple[str, dict]]:
     """Yield (name, node-data) for op nodes in topological order."""
     nodes = dict(dag.nodes(data=True))

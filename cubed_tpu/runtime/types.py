@@ -1,12 +1,26 @@
 """Executor protocol and observability event types.
 
-Reference parity: cubed/runtime/types.py:9-88.
+The full callback lifecycle, fired consistently by every executor:
+
+    on_compute_start(ComputeStartEvent)
+      on_operation_start(OperationStartEvent)      # per op
+        on_task_start(TaskStartEvent)              # per task (attempt)
+        on_task_end(TaskEndEvent)                  # per completed task
+      on_operation_end(OperationEndEvent)          # per op
+    on_compute_end(ComputeEndEvent)                # carries executor_stats
+
+Reference parity: cubed/runtime/types.py:9-88, extended with task-start and
+operation-end events plus task attribution fields (chunk key, attempt,
+executor, storage bytes) for the observability subsystem.
 """
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Optional, Sequence
+
+logger = logging.getLogger(__name__)
 
 
 class DagExecutor:
@@ -24,6 +38,20 @@ Executor = DagExecutor
 
 
 @dataclass
+class TaskStartEvent:
+    """A task (or a retry/backup attempt of one) has been submitted."""
+
+    array_name: str
+    num_tasks: int = 1
+    #: the task's chunk key (stringified mappable item), when known
+    chunk_key: Optional[str] = None
+    #: 0 for the first attempt, incremented per retry
+    attempt: int = 0
+    #: True when this is a speculative straggler backup of a running task
+    backup: bool = False
+
+
+@dataclass
 class TaskEndEvent:
     """Metrics for a completed task."""
 
@@ -35,19 +63,43 @@ class TaskEndEvent:
     task_result_tstamp: Optional[float] = None
     peak_measured_mem_start: Optional[int] = None
     peak_measured_mem_end: Optional[int] = None
+    #: the task's chunk key (stringified mappable item), when known
+    chunk_key: Optional[str] = None
+    #: which attempt produced this result (0 = first try)
+    attempt: int = 0
+    #: name of the executor that ran the task
+    executor: Optional[str] = None
+    #: storage bytes moved by THIS task, measured where it ran (worker-side
+    #: for remote executors) — see observability/accounting.py
+    bytes_read: Optional[int] = None
+    bytes_written: Optional[int] = None
+    chunks_read: Optional[int] = None
+    chunks_written: Optional[int] = None
+    #: logical bytes served by virtual (never-materialized) arrays — not IO
+    virtual_bytes_read: Optional[int] = None
 
 
 class Callback:
-    """Observer protocol for compute lifecycle events."""
+    """Observer protocol for compute lifecycle events.
+
+    Callback exceptions are swallowed and logged by ``callbacks_on`` — a
+    broken observer can never fail a compute.
+    """
 
     def on_compute_start(self, event) -> None:
         """Called when the computation is about to start; event has .dag, .resume."""
 
     def on_compute_end(self, event) -> None:
-        """Called when the computation has finished; event has .dag."""
+        """Called when the computation has finished; event has .dag, .executor_stats."""
 
     def on_operation_start(self, event) -> None:
         """Called when an op begins; event has .name and .num_tasks."""
+
+    def on_operation_end(self, event) -> None:
+        """Called when all of an op's tasks have finished."""
+
+    def on_task_start(self, event: TaskStartEvent) -> None:
+        """Called when a task attempt is submitted for execution."""
 
     def on_task_end(self, event: TaskEndEvent) -> None:
         """Called when one or more tasks of an op finish."""
@@ -62,8 +114,10 @@ class ComputeStartEvent:
 @dataclass
 class ComputeEndEvent:
     dag: object
-    #: execution-path counters from the executor (e.g. segments traced,
-    #: batched dispatches, eager fallbacks) — None if it reports none
+    #: merged stats for this compute: the executor's own execution-path
+    #: counters (e.g. segments traced, batched dispatches) plus the
+    #: observability metrics snapshot (task counters, bytes_read/written,
+    #: retries/timeouts/backups, per_op summary) — None if nothing reported
     executor_stats: Optional[dict] = None
 
 
@@ -73,7 +127,24 @@ class OperationStartEvent:
     num_tasks: int = 0
 
 
+@dataclass
+class OperationEndEvent:
+    name: str
+    num_tasks: int = 0
+
+
 def callbacks_on(callbacks: Optional[Sequence[Callback]], method: str, event) -> None:
-    if callbacks:
-        for cb in callbacks:
-            getattr(cb, method, lambda e: None)(event)
+    """Dispatch ``event`` to every callback's ``method``, swallowing (and
+    logging) observer exceptions so a broken callback can't fail a compute."""
+    if not callbacks:
+        return
+    for cb in callbacks:
+        fn = getattr(cb, method, None)
+        if fn is None:
+            continue
+        try:
+            fn(event)
+        except Exception:
+            logger.exception(
+                "callback %r raised in %s; continuing", cb, method
+            )

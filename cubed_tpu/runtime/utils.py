@@ -1,6 +1,10 @@
-"""Task execution instrumentation: wall time + peak host memory per task.
+"""Task execution instrumentation: wall time + peak host memory + storage
+bytes per task.
 
-Reference parity: cubed/runtime/utils.py:17-64.
+Reference parity: cubed/runtime/utils.py:17-64, extended with per-task
+storage byte accounting (observability/accounting.py) — the stats dict a
+task returns carries the bytes it moved, measured in whichever process ran
+it, so remote executors report IO accurately.
 """
 
 from __future__ import annotations
@@ -10,22 +14,33 @@ import time
 from functools import partial
 from typing import Iterable, Iterator, Optional, Sequence
 
+from ..observability.accounting import task_scope
+from ..observability.metrics import get_registry
 from ..utils import peak_measured_mem
-from .types import Callback, OperationStartEvent, TaskEndEvent, callbacks_on
+from .types import (
+    Callback,
+    OperationEndEvent,
+    OperationStartEvent,
+    TaskEndEvent,
+    TaskStartEvent,
+    callbacks_on,
+)
 
 
 def execute_with_stats(function, *args, **kwargs):
     """Run a task function, returning (result, stats-dict)."""
     peak_before = peak_measured_mem()
-    start = time.time()
-    result = function(*args, **kwargs)
-    end = time.time()
+    with task_scope() as scope:
+        start = time.time()
+        result = function(*args, **kwargs)
+        end = time.time()
     peak_after = peak_measured_mem()
     return result, dict(
         function_start_tstamp=start,
         function_end_tstamp=end,
         peak_measured_mem_start=peak_before,
         peak_measured_mem_end=peak_after,
+        **scope.stats(),
     )
 
 
@@ -40,8 +55,66 @@ def handle_callbacks(callbacks: Optional[Sequence[Callback]], stats: dict) -> No
     if "task_result_tstamp" not in stats:
         stats = dict(stats, task_result_tstamp=time.time())
     event = TaskEndEvent(**stats)
+    callbacks_on(callbacks, "on_task_end", event)
+
+
+def chunk_key(task_input) -> str:
+    """A short, human-readable key for a task's mappable item."""
+    try:
+        s = str(task_input)
+    except Exception:
+        s = object.__repr__(task_input)
+    return s if len(s) <= 120 else s[:117] + "..."
+
+
+def _wants_task_start(callbacks) -> bool:
+    """True if any callback actually overrides ``on_task_start`` (beyond the
+    base no-op) — lets hot loops skip event construction entirely."""
     for cb in callbacks:
-        cb.on_task_end(event)
+        fn = getattr(cb, "on_task_start", None)
+        if fn is None:
+            continue
+        if getattr(fn, "__func__", None) is not Callback.on_task_start:
+            return True
+    return False
+
+
+def fire_task_start(
+    callbacks,
+    array_name: str,
+    task_input=None,
+    attempt: int = 0,
+    backup: bool = False,
+    chunk_key_str: Optional[str] = None,
+    key_fn=None,
+    num_tasks: int = 1,
+) -> None:
+    """Count a submitted task attempt and fire ``on_task_start``.
+
+    The ``tasks_started`` metric is counted here (every executor funnels
+    submissions through this helper). The event itself — including the
+    chunk-key stringification, via ``chunk_key_str`` or a lazy ``key_fn`` —
+    is only built when some callback actually observes task starts, so the
+    per-task hot path pays nothing for it otherwise."""
+    get_registry().counter("tasks_started").inc(num_tasks)
+    if not callbacks or not _wants_task_start(callbacks):
+        return
+    if chunk_key_str is None:
+        if key_fn is not None:
+            chunk_key_str = key_fn()
+        elif task_input is not None:
+            chunk_key_str = chunk_key(task_input)
+    callbacks_on(
+        callbacks,
+        "on_task_start",
+        TaskStartEvent(
+            array_name=array_name,
+            num_tasks=num_tasks,
+            chunk_key=chunk_key_str,
+            attempt=attempt,
+            backup=backup,
+        ),
+    )
 
 
 def merge_generation(generation, callbacks) -> tuple[list, dict]:
@@ -66,6 +139,15 @@ def merge_generation(generation, callbacks) -> tuple[list, dict]:
         for m in primitive_op.pipeline.mappable:
             items.append((name, m))
     return items, pipelines
+
+
+def end_generation(generation, callbacks) -> None:
+    """Fire ``on_operation_end`` for every op of a completed generation."""
+    for name, node in generation:
+        callbacks_on(
+            callbacks, "on_operation_end",
+            OperationEndEvent(name, node["primitive_op"].num_tasks),
+        )
 
 
 def batched(iterable: Iterable, n: int) -> Iterator[list]:

@@ -26,6 +26,7 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from ..chunks import blockdims_from_blockshape
+from ..observability.accounting import record_bytes_read, record_bytes_written
 from ..utils import join_path
 
 _LOCAL_SCHEMES = ("", "file")
@@ -279,6 +280,9 @@ class ZarrV2Array:
         if not self._io.exists(key):
             return None
         data = self._io.read_bytes(key)
+        # IO bytes as stored (pre-decompression), attributed to the reading
+        # task's scope when one is active (observability/accounting.py)
+        record_bytes_read(self.store, len(data))
         if self._codec is not None:
             data = self._codec[1](data)
         arr = np.frombuffer(data, dtype=self.dtype)
@@ -290,6 +294,7 @@ class ZarrV2Array:
         if self._codec is not None:
             data = self._codec[0](data)
         self._io.write_bytes_atomic(self._chunk_key(idx), data)
+        record_bytes_written(self.store, len(data))
 
     def _empty_chunk(self) -> np.ndarray:
         fill = self.fill_value if self.fill_value is not None else 0

@@ -9,6 +9,7 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from ..chunks import blockdims_from_blockshape
+from ..observability.accounting import record_virtual_read
 from ..utils import broadcast_trick
 
 #: Arrays at or under this size may be kept in memory and shipped with the plan
@@ -67,7 +68,9 @@ class VirtualEmptyArray(_VirtualBase):
     def __getitem__(self, key) -> np.ndarray:
         sel = _normalize_key(key, self.shape)
         shape = tuple(max(0, s.stop - s.start) for s in sel)
-        return broadcast_trick(np.empty)(shape, dtype=self.dtype)
+        out = broadcast_trick(np.empty)(shape, dtype=self.dtype)
+        record_virtual_read(int(np.prod(shape or (1,))) * self.dtype.itemsize)
+        return out
 
 
 class VirtualFullArray(_VirtualBase):
@@ -82,7 +85,9 @@ class VirtualFullArray(_VirtualBase):
     def __getitem__(self, key) -> np.ndarray:
         sel = _normalize_key(key, self.shape)
         shape = tuple(max(0, s.stop - s.start) for s in sel)
-        return broadcast_trick(np.full)(shape, self.fill_value, dtype=self.dtype)
+        out = broadcast_trick(np.full)(shape, self.fill_value, dtype=self.dtype)
+        record_virtual_read(int(np.prod(shape or (1,))) * self.dtype.itemsize)
+        return out
 
 
 class VirtualOffsetsArray(_VirtualBase):
@@ -107,6 +112,7 @@ class VirtualOffsetsArray(_VirtualBase):
         if any(s.stop - s.start != 1 for s in sel):
             raise IndexError("VirtualOffsetsArray must be read one block at a time")
         offset = int(np.ravel_multi_index(idx, self.shape)) if self.shape else 0
+        record_virtual_read(self.dtype.itemsize)
         return np.full((1,) * len(self.shape), self.base + offset, dtype=self.dtype)
 
 
@@ -126,7 +132,9 @@ class VirtualInMemoryArray(_VirtualBase):
         self.chunks = tuple(int(c) for c in chunks) if self.shape else ()
 
     def __getitem__(self, key) -> np.ndarray:
-        return self.array[key]
+        out = self.array[key]
+        record_virtual_read(getattr(out, "nbytes", 0))
+        return out
 
     @property
     def oindex(self):

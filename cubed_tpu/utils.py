@@ -23,6 +23,29 @@ from urllib.parse import urlsplit, urlunsplit
 import numpy as np
 
 # ---------------------------------------------------------------------------
+# Plan-node name generation
+# ---------------------------------------------------------------------------
+
+#: process-global counter shared by every gensym'd plan identifier
+sym_counter = itertools.count()
+
+
+def gensym(name: str = "op") -> str:
+    """A unique plan-node identifier with a FIXED-WIDTH counter.
+
+    Fixed width matters beyond cosmetics: the JAX executor's structural
+    cache key canonicalizes these names inside the pickled payload BYTE
+    stream, where a name-length change (op-999 vs op-1000) also changes
+    pickle length-prefix bytes the rewrite can't see — so two structurally
+    identical plans built across a digit boundary would hash differently
+    and miss the cache. Nine digits pushes the first boundary past 10^9
+    plan nodes per process. One shared helper/counter so op and array node
+    name formats can never desynchronize.
+    """
+    return f"{name}-{next(sym_counter):09d}"
+
+
+# ---------------------------------------------------------------------------
 # Byte-size parsing and formatting
 # ---------------------------------------------------------------------------
 

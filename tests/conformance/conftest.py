@@ -9,19 +9,30 @@ import os
 import tempfile
 
 import pytest
-from hypothesis import HealthCheck, settings
 
-settings.register_profile(
-    "conformance",
-    # 10 keeps the per-function property coverage while holding the whole
-    # directory inside the default suite's 8-minute budget on one core;
-    # raise via CONFORMANCE_EXAMPLES for deep runs (the executor
-    # differential fuzzer provides the depth evidence either way)
-    max_examples=int(os.environ.get("CONFORMANCE_EXAMPLES", "10")),
-    deadline=None,
-    suppress_health_check=[HealthCheck.too_slow, HealthCheck.data_too_large],
-)
-settings.load_profile("conformance")
+# property tests need hypothesis; on minimal environments skip collecting
+# the test modules (they import hypothesis at module scope) instead of
+# erroring — tests/conftest.py also collect_ignores this whole directory
+# when pytest is invoked on the parent tests/ tree
+try:
+    from hypothesis import HealthCheck, settings
+except ImportError:
+    collect_ignore_glob = ["test_*.py"]
+else:
+    settings.register_profile(
+        "conformance",
+        # 10 keeps the per-function property coverage while holding the
+        # whole directory inside the default suite's 8-minute budget on one
+        # core; raise via CONFORMANCE_EXAMPLES for deep runs (the executor
+        # differential fuzzer provides the depth evidence either way)
+        max_examples=int(os.environ.get("CONFORMANCE_EXAMPLES", "10")),
+        deadline=None,
+        suppress_health_check=[
+            HealthCheck.too_slow,
+            HealthCheck.data_too_large,
+        ],
+    )
+    settings.load_profile("conformance")
 
 
 @pytest.fixture(scope="session")

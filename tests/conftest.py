@@ -37,6 +37,15 @@ import tempfile
 
 import pytest
 
+# the conformance suite is hypothesis-based property testing; on minimal
+# environments without hypothesis, skip collecting the whole directory
+# (including its conftest, which imports hypothesis at module scope) so
+# tier-1 collection stays clean
+try:
+    import hypothesis  # noqa: F401
+except ImportError:
+    collect_ignore = ["conformance"]
+
 
 @pytest.fixture
 def spec(tmp_path):

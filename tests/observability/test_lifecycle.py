@@ -1,0 +1,260 @@
+"""End-to-end observability tests: full callback lifecycle from executors,
+Perfetto-loadable traces with per-task attribution, executor_stats content,
+broken-observer isolation, and the history projected-vs-measured join.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.observability import TracingCallback
+from cubed_tpu.runtime.types import Callback
+
+
+@pytest.fixture
+def spec(tmp_path):
+    return ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB", reserved_mem=0)
+
+
+class LifecycleRecorder(Callback):
+    """Records every lifecycle event in order."""
+
+    def __init__(self):
+        self.calls = []
+
+    def on_compute_start(self, event):
+        self.calls.append(("compute_start", None))
+
+    def on_operation_start(self, event):
+        self.calls.append(("operation_start", event.name))
+
+    def on_task_start(self, event):
+        self.calls.append(("task_start", event.array_name))
+
+    def on_task_end(self, event):
+        self.calls.append(("task_end", event.array_name))
+
+    def on_operation_end(self, event):
+        self.calls.append(("operation_end", event.name))
+
+    def on_compute_end(self, event):
+        self.calls.append(("compute_end", None))
+        self.executor_stats = event.executor_stats
+
+
+def _two_op_pipeline(spec):
+    """A chain whose intermediate round-trips through zarr (unfused)."""
+    an = np.arange(64.0).reshape(8, 8)
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    return xp.add(xp.add(a, 1), 1), an + 2
+
+
+def _executors():
+    from cubed_tpu.runtime.executors.python import PythonDagExecutor
+    from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+
+    return [PythonDagExecutor(), AsyncPythonDagExecutor()]
+
+
+@pytest.mark.parametrize("executor", _executors(), ids=lambda e: e.name)
+def test_full_lifecycle_order_and_stats(spec, executor):
+    target, expected = _two_op_pipeline(spec)
+    rec = LifecycleRecorder()
+    result = target.compute(
+        callbacks=[rec], executor=executor, optimize_graph=False
+    )
+    np.testing.assert_allclose(result, expected)
+
+    kinds = [k for k, _ in rec.calls]
+    assert kinds[0] == "compute_start" and kinds[-1] == "compute_end"
+    # every op start has a matching end, and ends come after starts
+    starts = [n for k, n in rec.calls if k == "operation_start"]
+    ends = [n for k, n in rec.calls if k == "operation_end"]
+    assert sorted(starts) == sorted(ends) and len(starts) >= 3
+    for name in starts:
+        assert rec.calls.index(("operation_start", name)) < rec.calls.index(
+            ("operation_end", name)
+        )
+    # each completed task was started first
+    assert kinds.count("task_start") >= kinds.count("task_end") > 0
+
+    stats = rec.executor_stats
+    assert stats["tasks_completed"] > 0
+    assert stats["bytes_written"] > 0  # intermediate + output chunks
+    assert stats["bytes_read"] > 0  # second op reads the intermediate
+    assert "per_op" in stats
+    some_op = next(
+        v for k, v in stats["per_op"].items() if k != "create-arrays"
+    )
+    assert some_op["tasks"] > 0
+
+
+@pytest.mark.parametrize("executor", _executors(), ids=lambda e: e.name)
+def test_trace_json_loads_with_task_attribution(spec, executor, tmp_path):
+    target, expected = _two_op_pipeline(spec)
+    trace_path = str(tmp_path / "trace.json")
+    jsonl_path = str(tmp_path / "events.jsonl")
+    cb = TracingCallback(trace_path=trace_path, jsonl_path=jsonl_path)
+    result = target.compute(
+        callbacks=[cb], executor=executor, optimize_graph=False
+    )
+    np.testing.assert_allclose(result, expected)
+
+    doc = json.load(open(trace_path))
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    tasks = [e for e in events if e.get("cat") == "task"]
+    # one span per task with op/chunk/attempt/executor attribution
+    assert len(tasks) == cb.last_executor_stats["tasks_completed"]
+    for e in tasks:
+        assert e["ph"] == "X"
+        assert isinstance(e["ts"], (int, float)) and e["dur"] >= 0
+        assert e["args"]["op"]
+        assert e["args"]["chunk"] is not None
+        assert e["args"]["attempt"] == 0
+        assert e["args"]["executor"] == executor.name
+    # op spans and the compute span are present too
+    assert [e for e in events if e.get("cat") == "operation"]
+    assert [e for e in events if e.get("cat") == "compute"]
+    # the JSONL sink streamed the same spans
+    lines = [json.loads(l) for l in open(jsonl_path).read().splitlines()]
+    assert len([l for l in lines if l.get("cat") == "task"]) == len(tasks)
+
+
+def test_trace_and_stats_distributed_executor(spec, tmp_path):
+    """The acceptance round-trip: a distributed compute produces a valid
+    Chrome trace with per-task spans (worker-measured timestamps) and
+    executor_stats with nonzero byte/task counters from worker-side IO."""
+    from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+
+    target, expected = _two_op_pipeline(spec)
+    trace_path = str(tmp_path / "trace.json")
+    cb = TracingCallback(trace_path=trace_path)
+    with DistributedDagExecutor(n_local_workers=2) as ex:
+        result = target.compute(
+            callbacks=[cb], executor=ex, optimize_graph=False
+        )
+    np.testing.assert_allclose(result, expected)
+
+    stats = cb.last_executor_stats
+    assert stats["tasks_completed"] > 0
+    assert stats["bytes_read"] > 0 and stats["bytes_written"] > 0
+    assert stats["tasks_sent"] > 0  # coordinator counters merged in
+    assert stats["workers"]  # per-worker load snapshot
+    for w in stats["workers"].values():
+        assert w["tasks_sent"] >= 0 and "outstanding" in w
+
+    doc = json.load(open(trace_path))
+    tasks = [e for e in doc["traceEvents"] if e.get("cat") == "task"]
+    assert tasks
+    for e in tasks:
+        assert e["args"]["executor"] == "distributed"
+        assert e["args"]["chunk"] is not None
+
+
+def test_jax_executor_stats_include_metrics(spec):
+    from cubed_tpu.runtime.executors.jax import JaxExecutor
+
+    target, expected = _two_op_pipeline(spec)
+    rec = LifecycleRecorder()
+    result = target.compute(callbacks=[rec], executor=JaxExecutor())
+    np.testing.assert_allclose(result, expected)
+    stats = rec.executor_stats
+    # executor-specific counters and observability metrics in one dict
+    assert stats["segments_traced"] >= 1
+    assert stats["tasks_completed"] > 0
+    assert stats["bytes_written"] > 0  # final flush to the output store
+    kinds = [k for k, _ in rec.calls]
+    assert "operation_end" in kinds and "task_start" in kinds
+
+
+def test_reused_tracing_callback_starts_fresh_per_compute(spec, tmp_path):
+    """One TracingCallback across computes: each export holds only the
+    latest compute's spans (no accumulation, no stale t0)."""
+    trace_path = str(tmp_path / "trace.json")
+    cb = TracingCallback(trace_path=trace_path)
+    an = np.ones((4, 4))
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    float(xp.sum(a).compute(callbacks=[cb]))
+    b = ct.from_array(2 * an, chunks=(2, 2), spec=spec)
+    float(xp.sum(b).compute(callbacks=[cb]))
+    doc = json.load(open(trace_path))
+    tasks = [e for e in doc["traceEvents"] if e.get("cat") == "task"]
+    assert len(tasks) == cb.last_executor_stats["tasks_completed"]
+    assert len([e for e in doc["traceEvents"] if e.get("cat") == "compute"]) == 1
+
+
+def test_failed_compute_still_fires_compute_end_and_exports_trace(spec, tmp_path):
+    """on_compute_end (and the trace export) must fire for FAILED computes —
+    the trace of a partial run is when observability matters most."""
+    trace_path = str(tmp_path / "trace.json")
+    cb = TracingCallback(trace_path=trace_path)
+    a = ct.from_array(np.ones((4, 4)), chunks=(2, 2), spec=spec)
+
+    def boom(x):
+        raise ValueError("task failure")
+
+    r = ct.map_blocks(boom, a, dtype=np.float64)
+    with pytest.raises(ValueError, match="task failure"):
+        r.compute(callbacks=[cb])
+    assert cb.last_executor_stats is not None
+    doc = json.load(open(trace_path))
+    assert isinstance(doc["traceEvents"], list)
+
+
+def test_broken_callback_cannot_fail_compute(spec):
+    class Broken(Callback):
+        def on_operation_start(self, event):
+            raise RuntimeError("observer bug")
+
+        def on_task_end(self, event):
+            raise RuntimeError("observer bug")
+
+    an = np.ones((4, 4))
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    result = float(xp.sum(a).compute(callbacks=[Broken()]))
+    assert result == 16.0
+
+
+def test_history_projected_vs_measured_join_on_new_stream(spec, tmp_path):
+    from cubed_tpu.extensions.history import HistoryCallback
+    from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+
+    history = HistoryCallback(history_dir=str(tmp_path / "history"))
+    target, expected = _two_op_pipeline(spec)
+    result = target.compute(
+        callbacks=[history],
+        executor=AsyncPythonDagExecutor(),
+        optimize_graph=False,
+    )
+    np.testing.assert_allclose(result, expected)
+    rows = history.stats()
+    compute_rows = [r for r in rows if r["op_name"] not in ("create-arrays",)]
+    assert compute_rows
+    # the join: projections from the plan, peaks from the task event stream
+    for r in compute_rows:
+        assert r["projected_mem"] > 0
+        if r["op_name"] in ("add",):
+            assert r["peak_measured_mem"] is not None
+            assert r["projected_mem_utilization"] is not None
+    # op timings captured from operation start/end events
+    assert history.op_timings
+    assert any(
+        t.wall_clock is not None and t.wall_clock >= 0
+        for t in history.op_timings.values()
+    )
+
+
+def test_tqdm_progress_bars_open_and_close_per_op(spec, capsys):
+    from cubed_tpu.extensions.tqdm import TqdmProgressBar
+
+    bar = TqdmProgressBar(file=None, disable=True)
+    target, expected = _two_op_pipeline(spec)
+    result = target.compute(callbacks=[bar], optimize_graph=False)
+    np.testing.assert_allclose(result, expected)
+    assert len(bar.bars) >= 3  # create-arrays + two adds

@@ -1,0 +1,145 @@
+"""Metrics registry and byte-accounting unit tests."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cubed_tpu.observability.accounting import (
+    record_bytes_read,
+    record_bytes_written,
+    store_totals,
+    task_scope,
+)
+from cubed_tpu.observability.metrics import (
+    MetricsRegistry,
+    get_registry,
+    merge_snapshots,
+)
+
+
+def test_counter_gauge_histogram_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(4)
+    reg.gauge("g").set(7)
+    reg.gauge("g").set(3)
+    reg.histogram("h").observe(1.0)
+    reg.histogram("h").observe(3.0)
+    snap = reg.snapshot()
+    assert snap["c"] == 5
+    assert snap["g"] == 3 and snap["g_max"] == 7
+    assert snap["h"]["count"] == 2 and snap["h"]["sum"] == 4.0
+    assert snap["h"]["mean"] == 2.0 and snap["h"]["min"] == 1.0
+
+
+def test_snapshot_delta_windows_counters_and_high_water_marks():
+    reg = MetricsRegistry()
+    reg.counter("c").inc(10)
+    reg.gauge("g").set(5)
+    reg.histogram("h").observe(2.0)
+    before = reg.snapshot()
+    reg.counter("c").inc(3)
+    reg.gauge("g").set(9)
+    reg.histogram("h").observe(4.0)
+    delta = reg.snapshot_delta(before)
+    assert delta["c"] == 3
+    # a gauge's instantaneous value is not a per-window quantity: omitted
+    assert "g" not in delta
+    assert delta["g_max"] == 9  # this window raised the high-water mark
+    assert delta["h"]["count"] == 1 and delta["h"]["sum"] == 4.0
+    # lifetime extremes must not leak into a later window's delta
+    assert "min" not in delta["h"] and "max" not in delta["h"]
+    before2 = reg.snapshot()
+    reg.gauge("g").set(2)  # below the lifetime max of 9
+    assert "g_max" not in reg.snapshot_delta(before2)
+
+
+def test_merge_snapshots_adds_counters_folds_histograms_maxes_gauges():
+    a = {"c": 2, "g": 3, "g_max": 5, "h": {"count": 1, "sum": 1.0, "min": 1.0, "max": 1.0}}
+    b = {"c": 3, "g": 9, "g_max": 9, "h": {"count": 2, "sum": 7.0, "min": 2.0, "max": 5.0}}
+    m = merge_snapshots(a, b)
+    assert m["c"] == 5
+    # gauge readings (recognized by their _max sibling) are point-in-time:
+    # two workers each at queue_depth=3 is NOT queue_depth=6
+    assert m["g"] == 9
+    assert m["g_max"] == 9  # _max keys take the max, not the sum
+    assert m["h"]["count"] == 3 and m["h"]["sum"] == 8.0
+    assert m["h"]["min"] == 1.0 and m["h"]["max"] == 5.0
+
+
+def test_report_renders_all_metrics():
+    reg = MetricsRegistry()
+    reg.counter("tasks_completed").inc(12)
+    reg.histogram("op_wall_clock_s").observe(0.25)
+    text = reg.report()
+    assert "tasks_completed" in text and "12" in text
+    assert "op_wall_clock_s" in text and "count=1" in text
+
+
+def test_reset_clears_everything():
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# byte accounting
+# ---------------------------------------------------------------------------
+
+
+def test_task_scope_captures_bytes_and_registry_untouched():
+    before = get_registry().snapshot()
+    with task_scope() as scope:
+        record_bytes_read("s1", 100)
+        record_bytes_written("s1", 50)
+    assert scope.bytes_read == 100 and scope.chunks_read == 1
+    assert scope.bytes_written == 50 and scope.chunks_written == 1
+    delta = get_registry().snapshot_delta(before)
+    # scoped IO must NOT hit the global counters (the compute aggregator
+    # folds it in from task events instead — no double counting)
+    assert delta.get("bytes_read", 0) == 0
+    assert delta.get("bytes_written", 0) == 0
+
+
+def test_unscoped_io_goes_to_registry():
+    before = get_registry().snapshot()
+    record_bytes_read("s2", 30)
+    record_bytes_written("s2", 70)
+    delta = get_registry().snapshot_delta(before)
+    assert delta["bytes_read"] >= 30
+    assert delta["bytes_written"] >= 70
+
+
+def test_nested_scopes_attribute_to_innermost_only():
+    # bytes belong to the innermost scope (whose task event carries them);
+    # folding outward would double-count once both events are aggregated
+    with task_scope() as outer:
+        record_bytes_read("s", 10)
+        with task_scope() as inner:
+            record_bytes_read("s", 5)
+        assert inner.bytes_read == 5
+        record_bytes_read("s", 2)
+    assert outer.bytes_read == 12
+
+
+def test_zarr_store_read_write_accounted(tmp_path):
+    from cubed_tpu.storage.store import open_zarr_array
+
+    store = str(tmp_path / "a.zarr")
+    arr = open_zarr_array(store, mode="w", shape=(4, 4), dtype=np.float64, chunks=(2, 2))
+    before = get_registry().snapshot()
+    arr[:, :] = np.arange(16.0).reshape(4, 4)
+    out = arr[:, :]
+    np.testing.assert_array_equal(out, np.arange(16.0).reshape(4, 4))
+    delta = get_registry().snapshot_delta(before)
+    # 4 chunks x 2x2 f64 = 128 bytes each way (uncompressed store)
+    assert delta["bytes_written"] >= 128
+    assert delta["bytes_read"] >= 128
+    assert delta["chunks_written"] >= 4 and delta["chunks_read"] >= 4
+    totals = store_totals()
+    # after MAX_TRACKED_STORES distinct stores in a long process, per-store
+    # detail aggregates under "<other>"
+    entry = totals.get(store) or totals.get("<other>")
+    assert entry["bytes_written"] >= 128
+    assert entry["bytes_read"] >= 128

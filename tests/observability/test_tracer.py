@@ -1,0 +1,104 @@
+"""Tracer unit tests: span nesting, JSONL sink, Chrome-trace export format."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from cubed_tpu.observability.tracer import Tracer
+
+
+def test_span_nesting_records_parent_and_depth():
+    tr = Tracer()
+    with tr.span("outer", kind="test"):
+        with tr.span("inner"):
+            pass
+        with tr.span("inner2"):
+            pass
+    names = [e["name"] for e in tr.events]
+    # spans are recorded on exit: inner finishes before outer
+    assert names == ["inner", "inner2", "outer"]
+    by_name = {e["name"]: e for e in tr.events}
+    assert by_name["outer"]["args"]["depth"] == 0
+    assert "parent" not in by_name["outer"]["args"]
+    assert by_name["inner"]["args"]["parent"] == "outer"
+    assert by_name["inner"]["args"]["depth"] == 1
+    assert by_name["outer"]["args"]["kind"] == "test"
+    # timing: outer encloses inner
+    assert by_name["outer"]["ts"] <= by_name["inner"]["ts"]
+    assert by_name["outer"]["dur"] >= by_name["inner"]["dur"]
+
+
+def test_span_records_exception_and_does_not_swallow():
+    tr = Tracer()
+    try:
+        with tr.span("boom"):
+            raise ValueError("x")
+    except ValueError:
+        pass
+    else:  # pragma: no cover
+        raise AssertionError("span must not swallow exceptions")
+    assert tr.events[0]["args"]["error"] == "ValueError"
+
+
+def test_nesting_is_per_thread():
+    tr = Tracer()
+    seen = {}
+
+    def worker():
+        with tr.span("in-thread"):
+            seen["depth"] = len(tr._stack())
+
+    with tr.span("main-span"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    by_name = {e["name"]: e for e in tr.events}
+    # the other thread's span must NOT see this thread's stack as parent
+    assert "parent" not in by_name["in-thread"]["args"]
+    assert by_name["in-thread"]["args"]["depth"] == 0
+
+
+def test_jsonl_sink_streams_events(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    tr = Tracer(jsonl_path=path)
+    with tr.span("a", idx=1):
+        pass
+    tr.instant("marker", note="hi")
+    tr.close()
+    lines = [json.loads(l) for l in open(path).read().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["name"] == "a" and lines[0]["args"]["idx"] == 1
+    assert lines[1]["name"] == "marker" and lines[1]["ph"] == "i"
+
+
+def test_chrome_export_is_loadable_and_well_formed(tmp_path):
+    tr = Tracer()
+    with tr.span("alpha", lane="ops"):
+        with tr.span("beta", lane="ops"):
+            pass
+    tr.add_complete("task-0", 100.0, 100.5, lane="op:x", cat="task", chunk="(0,0)")
+    out = str(tmp_path / "trace.json")
+    tr.export_chrome(out)
+    doc = json.load(open(out))
+    assert isinstance(doc["traceEvents"], list)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    # every X event has the required chrome-trace fields, in microseconds
+    for e in xs:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    # each lane got a tid + thread_name metadata record
+    lanes = {m["args"]["name"] for m in metas if m["name"] == "thread_name"}
+    assert {"ops", "op:x"} <= lanes
+    task = next(e for e in xs if e["name"] == "task-0")
+    assert task["args"]["chunk"] == "(0,0)"
+    assert abs(task["dur"] - 0.5e6) < 1.0  # 0.5s in microseconds
+
+
+def test_max_events_bounds_memory():
+    tr = Tracer(max_events=3)
+    for i in range(10):
+        tr.instant(f"e{i}")
+    assert len(tr.events) == 3
+    assert tr.dropped == 7

@@ -99,6 +99,34 @@ def test_batch_streams_iterator_inputs():
     assert pulled == list(range(12))
 
 
+def test_backup_twin_completing_with_winner_same_batch(monkeypatch):
+    """A task and its speculative backup twin both land in one wait batch:
+    the winner's cancel loop removes the twin from pending, and the done
+    loop must skip it (regression: KeyError on pending.pop)."""
+    import cubed_tpu.runtime.executors.python_async as pa
+
+    monkeypatch.setattr(pa, "should_launch_backup", lambda *a: True)
+
+    class TwinPool:
+        """Futures stay pending until the backup is submitted, then BOTH
+        complete at once — guaranteeing they share a done batch."""
+
+        def __init__(self):
+            self.futs = []
+
+        def submit(self, fn, *args, **kwargs):
+            f = concurrent.futures.Future()
+            self.futs.append(f)
+            if len(self.futs) == 2:  # the backup twin just launched
+                for g in self.futs:
+                    g.set_result((None, {}))
+            return f
+
+    pool = TwinPool()
+    map_unordered(pool, lambda x: x, [0], use_backups=True, array_name="op")
+    assert len(pool.futs) == 2  # original + backup both ran
+
+
 def test_executor_end_to_end_with_failures(tmp_path, spec, monkeypatch):
     """Retries are exercised through a real plan execution."""
     import numpy as np

@@ -278,8 +278,13 @@ def test_stats_reported_via_compute_end_event(spec):
     a = ct.from_array(an, chunks=(2, 2), spec=spec)
     ex = JaxExecutor()
     xp.sum(a).compute(executor=ex, callbacks=[Capture()])
-    assert seen["stats"] is ex.stats
+    # executor_stats carries the executor's own counters merged with the
+    # per-compute observability metrics (task counters, per_op summary)
     assert seen["stats"]["segments_traced"] == 1
+    for key, val in ex.stats.items():
+        assert seen["stats"][key] == val
+    assert seen["stats"]["tasks_completed"] > 0
+    assert "per_op" in seen["stats"]
 
 
 # ---------------------------------------------------------------------------
@@ -306,6 +311,40 @@ def test_struct_cache_hit_skips_trace_and_rebinds_seed(spec):
     assert ex2.stats["segments_compiled"] == 0
     # both runs valid, and the DIFFERENT per-plan seed was rebound (the
     # cached program did not bake the first plan's randomness)
+    assert 0.4 < v1 / 1.618 < 0.6 and 0.4 < v2 / 1.618 < 0.6
+
+
+def test_struct_cache_stable_across_gensym_counter_positions(spec):
+    """Identical plans built at arbitrary points of the process-global
+    gensym counter must produce the SAME structural key. Regression: with
+    variable-width gensym names (%03d), crossing a digit boundary (999 →
+    1000) changed pickle string length-prefix bytes that the post-pickle
+    name canonicalization cannot rewrite, silently missing the cache."""
+    import itertools
+
+    import cubed_tpu.utils as utilsmod
+    from cubed_tpu.runtime.executors import jax as jxm
+
+    jxm._STRUCT_CACHE.clear()
+
+    def build():
+        r = cubed_tpu.random.random((12, 12), chunks=6, spec=spec)
+        return xp.mean(xp.multiply(r, 1.618))
+
+    # jump the shared gensym counter forward across what used to be the
+    # %03d boundary between the two builds (monotonically — never
+    # backwards, so node names stay unique within the process)
+    utilsmod.sym_counter = itertools.count(
+        max(995, next(utilsmod.sym_counter))
+    )
+    ex1, ex2 = JaxExecutor(), JaxExecutor()
+    v1 = float(build().compute(executor=ex1))
+    v2 = float(build().compute(executor=ex2))
+    assert ex1.stats["segments_traced"] == 1
+    assert ex2.stats["segment_struct_hits"] == 1, (
+        "structurally identical plan missed the struct cache across a "
+        "gensym counter digit boundary"
+    )
     assert 0.4 < v1 / 1.618 < 0.6 and 0.4 < v2 / 1.618 < 0.6
     assert v1 != v2
 

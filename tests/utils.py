@@ -53,24 +53,41 @@ def main_executors():
 
 
 class TaskCounter(Callback):
+    """Counts completed tasks and validates event timestamp ordering.
+
+    Callback exceptions are swallowed by ``callbacks_on`` (a broken observer
+    must never fail a compute), so ordering violations are recorded and
+    re-raised when ``value`` is read instead of asserted inline.
+    """
+
     def __init__(self):
-        self.value = 0
+        self._value = 0
         self.events = []
+        self.violations = []
 
     def on_compute_start(self, event):
-        self.value = 0
+        self._value = 0
 
     def on_task_end(self, event):
         self.events.append(event)
         if event.task_create_tstamp is not None:
-            assert (
+            ok = (
                 event.task_result_tstamp
                 >= event.function_end_tstamp
                 >= event.function_start_tstamp
                 >= event.task_create_tstamp
                 > 0
             )
-        self.value += event.num_tasks
+            if not ok:
+                self.violations.append(event)
+        self._value += event.num_tasks
+
+    @property
+    def value(self):
+        assert not self.violations, (
+            f"task events with out-of-order timestamps: {self.violations}"
+        )
+        return self._value
 
 
 def execute_pipeline(primitive_op, executor=None):
