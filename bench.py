@@ -547,10 +547,16 @@ def main() -> None:
         base = baselines.get(BASELINE_KEY.get(workload, workload))
         emit(metric + sfx, res, base, work, unit=unit)
         if res is not None:
+            stats = res.get("executor_stats") or {}
             metrics_record[metric + sfx] = {
                 "elapsed": res.get("elapsed"),
                 "value": res.get("value"),
-                "executor_stats": res.get("executor_stats"),
+                # resilience trajectory: retry overhead and injected faults
+                # ride alongside the perf numbers so a regression in either
+                # is visible from BENCH_METRICS.json history alone
+                "task_retries": stats.get("task_retries", 0),
+                "faults_injected": stats.get("faults_injected", 0),
+                "executor_stats": stats or None,
             }
 
     # per-op timing / IO-byte trajectories ride alongside the headline

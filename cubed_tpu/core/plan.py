@@ -238,14 +238,24 @@ class Plan:
 
         callbacks_on(all_callbacks, "on_compute_start", ComputeStartEvent(dag, resume))
         try:
-            executor.execute_dag(
-                dag,
-                callbacks=all_callbacks,
-                array_names=array_names,
-                resume=resume,
-                spec=spec,
-                **kwargs,
-            )
+            # Spec-level chaos config arms fault injection for this
+            # compute's duration (exported to the env so spawned workers
+            # inherit it); a None config makes this a no-op. Arming is
+            # process-global while active — same caveat as the metrics
+            # registry below: concurrent computes in one process share it
+            from ..runtime import faults
+
+            with faults.scoped(
+                getattr(spec, "fault_injection", None), export_env=True
+            ):
+                executor.execute_dag(
+                    dag,
+                    callbacks=all_callbacks,
+                    array_names=array_names,
+                    resume=resume,
+                    spec=spec,
+                    **kwargs,
+                )
         finally:
             # on_compute_end fires even when the compute FAILS: that is when
             # a trace of the partial run (TracingCallback's trace.json) and

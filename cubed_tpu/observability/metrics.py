@@ -12,6 +12,13 @@ The canonical metric names used across the codebase:
 - ``tasks_completed`` / ``tasks_started`` — task lifecycle counts
 - ``task_retries`` / ``task_timeouts`` / ``speculative_backups`` /
   ``workers_lost`` — the reliability machinery's counters
+- ``task_failfast`` / ``worker_loss_requeues`` / ``retry_budget_exhausted``
+  / ``pool_rebuilds`` / ``storage_read_retries`` — the resilience layer's
+  classified-failure counters (``runtime/resilience.py``)
+- ``retry_backoff_s`` — histogram of backoff delays scheduled before retries
+- ``faults_injected`` (+ ``faults_injected_<site>``) /
+  ``orphan_tmps_swept`` — chaos-testing fault injection
+  (``runtime/faults.py``) and crash-litter hygiene
 - ``bytes_read`` / ``bytes_written`` / ``chunks_read`` / ``chunks_written``
   — Zarr store IO (see ``accounting.py``)
 - ``virtual_bytes_read`` — reads served by virtual (never-materialized) arrays

@@ -72,7 +72,13 @@ class TaskTimeoutError(RuntimeError):
 
 
 class RemoteTaskError(RuntimeError):
-    """A task raised on a worker; carries the remote traceback text."""
+    """A task raised on a worker; carries the remote traceback text plus the
+    root exception's class name (``remote_type``) so the retry policy can
+    classify remote programming errors as fail-fast without a shared type."""
+
+    def __init__(self, message: str = "", remote_type: Optional[str] = None):
+        super().__init__(message)
+        self.remote_type = remote_type
 
 
 class NoWorkersError(RuntimeError):
@@ -177,6 +183,10 @@ class Coordinator:
         self._server.settimeout(0.2)
         self.address = self._server.getsockname()[:2]
         self._workers: list[_WorkerConn] = []
+        #: lifetime count of workers that ever joined (diagnostics: a
+        #: zero-worker submit reads very differently when 4 joined and died
+        #: vs when nothing ever connected)
+        self._workers_ever = 0
         self._lock = threading.Lock()
         self._next_task_id = 0
         self._closed = threading.Event()
@@ -231,6 +241,7 @@ class Coordinator:
             conn = _WorkerConn(sock, addr, hello)
             with self._lock:
                 self._workers.append(conn)
+                self._workers_ever += 1
                 self._worker_joined.notify_all()
             threading.Thread(
                 target=self._recv_loop,
@@ -247,8 +258,16 @@ class Coordinator:
                 timeout=timeout,
             )
         if not ok:
+            host, port = self.address
+            with self._lock:
+                ever = self._workers_ever
             raise TimeoutError(
-                f"only {self.n_workers} of {count} workers joined within {timeout}s"
+                f"only {self.n_workers} of {count} workers joined the "
+                f"coordinator at {host}:{port} within {timeout}s "
+                f"({ever} ever joined, {self.stats['workers_lost']} lost); "
+                "start workers with 'python -m cubed_tpu.runtime.worker "
+                f"{host}:{port}' on each host, or raise "
+                "worker_start_timeout if they are still booting"
             )
 
     @property
@@ -313,7 +332,12 @@ class Coordinator:
                         except Exception:
                             pass  # cancelled concurrently (losing twin)
                     else:
-                        _fail_future(fut, RemoteTaskError(msg.get("error", "")))
+                        _fail_future(
+                            fut,
+                            RemoteTaskError(
+                                msg.get("error", ""), msg.get("error_type")
+                            ),
+                        )
                 elif mtype == "started":
                     # execution begins now: restart the timeout clock and
                     # make a subsequent timeout count as a real hang
@@ -424,7 +448,28 @@ class Coordinator:
             with self._lock:
                 live = [w for w in self._workers if w.alive]
                 if not live:
-                    raise NoWorkersError("no live workers connected")
+                    host, port = self.address
+                    ever = self._workers_ever
+                    lost = self.stats["workers_lost"]
+                    if ever == 0:
+                        hint = (
+                            "no worker ever connected — start workers with "
+                            "'python -m cubed_tpu.runtime.worker "
+                            f"{host}:{port}' on each host (or use "
+                            "n_local_workers/min_workers so the executor "
+                            "waits for them before submitting)"
+                        )
+                    else:
+                        hint = (
+                            f"{ever} worker(s) joined over this "
+                            f"coordinator's lifetime and {lost} were lost "
+                            "(crash/hang/shutdown) — check worker logs, "
+                            "task_timeout, and host health"
+                        )
+                    raise NoWorkersError(
+                        f"cannot submit task: no live workers connected to "
+                        f"coordinator {host}:{port}; {hint}"
+                    )
                 conn = min(
                     live,
                     key=lambda w: (len(w.outstanding) + len(w.ghost_ids))
@@ -442,6 +487,8 @@ class Coordinator:
                     conn.deadlines[task_id] = [
                         time.monotonic() + self.task_timeout, False
                     ]
+            from .faults import wire_config
+
             msg = {
                 "type": "task",
                 "task_id": task_id,
@@ -450,6 +497,11 @@ class Coordinator:
                 "input": task_input,
                 # ack execution start only when someone is watching the clock
                 "ack": self.task_timeout is not None,
+                # the client's fault-injection arming state rides with every
+                # task: workers mirror it exactly (pre-started fleets still
+                # inject; disarming propagates instead of lingering in
+                # spawn-time env), see faults.wire_config
+                "faults": wire_config(),
             }
             try:
                 send_frame(conn.sock, msg, conn.send_lock)
@@ -529,17 +581,19 @@ def run_worker(
     import cloudpickle
     from concurrent.futures import ThreadPoolExecutor
 
+    from .faults import arm_from_wire, get_injector
     from .utils import execute_with_stats
 
     host, _, port = coordinator.rpartition(":")
     sock = socket.create_connection((host or "127.0.0.1", int(port)))
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     send_lock = threading.Lock()
+    wname = name or f"{socket.gethostname()}:{os.getpid()}"
     send_frame(
         sock,
         {
             "type": "hello",
-            "name": name or f"{socket.gethostname()}:{os.getpid()}",
+            "name": wname,
             "nthreads": nthreads,
             "pid": os.getpid(),
         },
@@ -565,6 +619,23 @@ def run_worker(
     def run_task(msg: dict) -> None:
         task_id = msg["task_id"]
         try:
+            # chaos hook: a named worker hard-exits or wedges when its
+            # executed-task count reaches the configured threshold —
+            # modelling OOM-kills and hung hosts. The task message carries
+            # the client's arming state (mirrored here, None = disarm);
+            # messages from an old coordinator fall back to the spawn env
+            if "faults" in msg:
+                injector = arm_from_wire(msg.get("faults"))
+            else:
+                injector = get_injector()
+            if injector is not None:
+                action = injector.worker_task_tick(wname)
+                if action == "crash":
+                    logger.warning("worker %s: injected crash", wname)
+                    os._exit(137)
+                elif action == "hang":
+                    logger.warning("worker %s: injected hang", wname)
+                    time.sleep(injector.config.worker_hang_s)
             blob_id = msg["blob_id"]
             # decode under a lock (concurrent same-blob tasks must not race
             # the decode/pop), inside the task try: an undeserializable op
@@ -663,12 +734,15 @@ def run_worker(
                      "stats": stats},
                     send_lock,
                 )
-        except Exception:
+        except Exception as e:
             try:
                 send_frame(
                     sock,
                     {"type": "error", "task_id": task_id,
-                     "error": traceback.format_exc()},
+                     "error": traceback.format_exc(),
+                     # root class name rides along so the coordinator-side
+                     # retry policy can classify remote programming errors
+                     "error_type": type(e).__name__},
                     send_lock,
                 )
             except (ConnectionError, OSError):

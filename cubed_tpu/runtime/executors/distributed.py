@@ -30,8 +30,9 @@ import subprocess
 import sys
 from typing import Optional
 
-from ..distributed import Coordinator
+from ..distributed import Coordinator, NoWorkersError
 from ..pipeline import visit_node_generations, visit_nodes
+from ..resilience import DEFAULT_RETRIES, RetryPolicy, resolve_policy
 from ..types import (
     DagExecutor,
     OperationEndEvent,
@@ -40,7 +41,7 @@ from ..types import (
 )
 from ..utils import end_generation, merge_generation
 from .multiprocess import _PLUGIN_ENV_PREFIXES
-from .python_async import DEFAULT_RETRIES, map_unordered
+from .python_async import compute_retry_budget, map_unordered
 
 logger = logging.getLogger(__name__)
 
@@ -79,6 +80,7 @@ class DistributedDagExecutor(DagExecutor):
         use_backups: bool = True,
         batch_size: Optional[int] = None,
         compute_arrays_in_parallel: bool = False,
+        retry_policy: Optional[RetryPolicy] = None,
         **kwargs,
     ):
         if n_local_workers is None and listen is None:
@@ -96,6 +98,7 @@ class DistributedDagExecutor(DagExecutor):
         self.use_backups = use_backups
         self.batch_size = batch_size
         self.compute_arrays_in_parallel = compute_arrays_in_parallel
+        self.retry_policy = retry_policy
         self.kwargs = kwargs
         self._coordinator: Optional[Coordinator] = None
         self._procs: list[subprocess.Popen] = []
@@ -212,6 +215,7 @@ class DistributedDagExecutor(DagExecutor):
         use_backups: Optional[bool] = None,
         batch_size: Optional[int] = None,
         compute_arrays_in_parallel: Optional[bool] = None,
+        retry_policy: Optional[RetryPolicy] = None,
         **kwargs,
     ) -> None:
         retries = self.retries if retries is None else retries
@@ -219,8 +223,22 @@ class DistributedDagExecutor(DagExecutor):
         batch_size = self.batch_size if batch_size is None else batch_size
         if compute_arrays_in_parallel is None:
             compute_arrays_in_parallel = self.compute_arrays_in_parallel
+        policy = resolve_policy(retry_policy or self.retry_policy, retries)
+        budget = compute_retry_budget(policy, dag)
 
         coord = self._ensure_fleet()
+        if coord.n_workers == 0:
+            # fail fast with a diagnostic instead of letting the first
+            # submit discover it mid-plan (min_workers=0 configurations
+            # sail past wait_for_workers without anyone ever joining)
+            host, port = coord.address
+            raise NoWorkersError(
+                f"compute submitted with zero live workers (coordinator "
+                f"{host}:{port}, min_workers={self.min_workers}); start "
+                "workers with 'python -m cubed_tpu.runtime.worker "
+                f"{host}:{port}' or configure n_local_workers/min_workers "
+                "so the fleet is populated before computing"
+            )
 
         if compute_arrays_in_parallel:
             for generation in visit_node_generations(dag, resume=resume):
@@ -232,7 +250,8 @@ class DistributedDagExecutor(DagExecutor):
                     _InterleavedPool(coord, pipelines),
                     None,
                     merged,
-                    retries=retries,
+                    retry_policy=policy,
+                    retry_budget=budget,
                     use_backups=use_backups,
                     batch_size=batch_size,
                     callbacks=callbacks,
@@ -252,7 +271,8 @@ class DistributedDagExecutor(DagExecutor):
                     _OpPool(coord, pipeline),
                     pipeline.function,
                     pipeline.mappable,
-                    retries=retries,
+                    retry_policy=policy,
+                    retry_budget=budget,
                     use_backups=use_backups,
                     batch_size=batch_size,
                     callbacks=callbacks,

@@ -29,7 +29,14 @@ import logging
 import os
 from typing import Optional
 
+from ...observability.metrics import get_registry
 from ..pipeline import visit_node_generations, visit_nodes
+from ..resilience import (
+    DEFAULT_RETRIES,
+    RetryPolicy,
+    budget_exhausted_error,
+    resolve_policy,
+)
 from ..types import (
     DagExecutor,
     OperationEndEvent,
@@ -37,7 +44,7 @@ from ..types import (
     callbacks_on,
 )
 from ..utils import end_generation, merge_generation
-from .python_async import DEFAULT_RETRIES, map_unordered
+from .python_async import compute_retry_budget, map_unordered
 
 logger = logging.getLogger(__name__)
 
@@ -110,6 +117,7 @@ class MultiprocessDagExecutor(DagExecutor):
         use_backups: bool = False,
         batch_size: Optional[int] = None,
         compute_arrays_in_parallel: bool = False,
+        retry_policy: Optional[RetryPolicy] = None,
         **kwargs,
     ):
         self.max_workers = max_workers or os.cpu_count() or 1
@@ -117,6 +125,7 @@ class MultiprocessDagExecutor(DagExecutor):
         self.use_backups = use_backups
         self.batch_size = batch_size
         self.compute_arrays_in_parallel = compute_arrays_in_parallel
+        self.retry_policy = retry_policy
         self.kwargs = kwargs
 
     @property
@@ -134,6 +143,7 @@ class MultiprocessDagExecutor(DagExecutor):
         use_backups: Optional[bool] = None,
         batch_size: Optional[int] = None,
         compute_arrays_in_parallel: Optional[bool] = None,
+        retry_policy: Optional[RetryPolicy] = None,
         **kwargs,
     ) -> None:
         retries = self.retries if retries is None else retries
@@ -141,6 +151,8 @@ class MultiprocessDagExecutor(DagExecutor):
         batch_size = self.batch_size if batch_size is None else batch_size
         if compute_arrays_in_parallel is None:
             compute_arrays_in_parallel = self.compute_arrays_in_parallel
+        policy = resolve_policy(retry_policy or self.retry_policy, retries)
+        budget = compute_retry_budget(policy, dag)
 
         # spawn (not fork): workers must not inherit live device handles or
         # jax state — same as a cloud worker booting from a clean image
@@ -167,7 +179,8 @@ class MultiprocessDagExecutor(DagExecutor):
                         ctx,
                         _GenerationTask(runners),
                         merged,
-                        retries=retries,
+                        policy=policy,
+                        budget=budget,
                         use_backups=use_backups,
                         batch_size=batch_size,
                         callbacks=callbacks,
@@ -188,7 +201,8 @@ class MultiprocessDagExecutor(DagExecutor):
                         ctx,
                         _ProcessTaskRunner(pipeline.function, pipeline.config),
                         list(pipeline.mappable),
-                        retries=retries,
+                        policy=policy,
+                        budget=budget,
                         use_backups=use_backups,
                         batch_size=batch_size,
                         callbacks=callbacks,
@@ -204,36 +218,58 @@ class MultiprocessDagExecutor(DagExecutor):
             stack.close()
 
     def _map_surviving_pool_crash(
-        self, pool, ctx, fn, inputs, *, retries, **map_kwargs
+        self, pool, ctx, fn, inputs, *, policy=None, budget=None,
+        retries=None, **map_kwargs,
     ):
         """map_unordered, rebuilding the pool when a worker death breaks it.
 
         A dead worker (OOM-kill, segfault) permanently breaks a stdlib
         ProcessPoolExecutor; every op task is an idempotent whole-chunk
         write, so the whole op is safely re-run on a fresh pool. Returns the
-        (possibly new) pool for subsequent ops.
+        (possibly new) pool for subsequent ops. Pool rebuilds follow the
+        retry policy: they are infrastructure failures, so each rebuild
+        waits out a backoff delay (a crashing-on-load input would otherwise
+        respawn the pool in a tight loop) and draws on the compute's retry
+        budget so systemic crash loops abort promptly.
 
         Note: a re-run fires ``on_task_end`` again for tasks that completed
         before the crash, so progress/history counters can exceed num_tasks
         across pool-crash retries — the same at-least-once event semantics a
         cloud executor's speculative backups have.
         """
+        import time
+
         from concurrent.futures.process import BrokenProcessPool
 
+        policy = resolve_policy(policy, retries)
+        if budget is None:
+            budget = policy.new_budget(len(inputs))
+        retries = policy.retries
         for attempt in range(retries + 1):
             try:
-                map_unordered(pool, fn, inputs, retries=retries, **map_kwargs)
+                map_unordered(
+                    pool, fn, inputs, retry_policy=policy,
+                    retry_budget=budget, **map_kwargs,
+                )
                 return pool
-            except BrokenProcessPool:
+            except BrokenProcessPool as exc:
                 pool.shutdown(wait=False, cancel_futures=True)
                 if attempt == retries:
                     raise  # caller's finally shuts down this (dead) pool
+                if not budget.consume():
+                    raise budget_exhausted_error(exc, budget) from exc
+                delay = policy.backoff_delay(attempt + 1)
+                get_registry().counter("pool_rebuilds").inc()
+                get_registry().histogram("retry_backoff_s").observe(delay)
+                logger.warning(
+                    "worker process died; rebuilding pool in %.3fs, "
+                    "re-running op (attempt %d/%d)",
+                    delay, attempt + 2, retries + 1,
+                )
+                if delay > 0:
+                    time.sleep(delay)
                 pool = concurrent.futures.ProcessPoolExecutor(
                     max_workers=self.max_workers, mp_context=ctx
-                )
-                logger.warning(
-                    "worker process died; rebuilt pool, re-running op "
-                    "(attempt %d/%d)", attempt + 2, retries + 1,
                 )
         return pool
 

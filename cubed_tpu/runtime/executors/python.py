@@ -1,14 +1,26 @@
 """Sequential in-process executor — the correctness oracle.
 
 Reference parity: cubed/runtime/executors/python.py:14-32, extended with the
-full callback lifecycle (task start / operation end).
+full callback lifecycle (task start / operation end) and opt-in classified
+retries (``retries=0`` by default: the oracle surfaces a task's first
+failure undisturbed unless asked otherwise).
 """
 
 from __future__ import annotations
 
+import logging
 import time
+from typing import Optional
 
+from ...observability.metrics import get_registry
 from ..pipeline import visit_nodes
+from ..resilience import (
+    Classification,
+    RetryPolicy,
+    budget_exhausted_error,
+    compute_retry_budget,
+    resolve_policy,
+)
 from ..types import (
     DagExecutor,
     OperationEndEvent,
@@ -17,18 +29,40 @@ from ..types import (
 )
 from ..utils import chunk_key, execute_with_stats, fire_task_start, handle_callbacks
 
+logger = logging.getLogger(__name__)
+
 
 class PythonDagExecutor(DagExecutor):
     """For each op in topological order, run its tasks one by one in-process."""
 
-    def __init__(self, **kwargs):
+    def __init__(
+        self,
+        retries: int = 0,
+        retry_policy: Optional[RetryPolicy] = None,
+        **kwargs,
+    ):
+        self.retries = retries
+        self.retry_policy = retry_policy
         self.kwargs = kwargs
 
     @property
     def name(self) -> str:
         return "single-threaded"
 
-    def execute_dag(self, dag, callbacks=None, resume=None, spec=None, **kwargs) -> None:
+    def execute_dag(
+        self,
+        dag,
+        callbacks=None,
+        resume=None,
+        spec=None,
+        retries: Optional[int] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        **kwargs,
+    ) -> None:
+        retries = self.retries if retries is None else retries
+        policy = resolve_policy(retry_policy or self.retry_policy, retries)
+        budget = compute_retry_budget(policy, dag)
+        metrics = get_registry()
         for name, node in visit_nodes(dag, resume=resume):
             primitive_op = node["primitive_op"]
             pipeline = primitive_op.pipeline
@@ -39,8 +73,36 @@ class PythonDagExecutor(DagExecutor):
             for m in pipeline.mappable:
                 created = time.time()
                 key = chunk_key(m)
-                fire_task_start(callbacks, name, chunk_key_str=key)
-                _, stats = execute_with_stats(pipeline.function, m, config=pipeline.config)
+                failures = 0
+                while True:
+                    fire_task_start(
+                        callbacks, name, chunk_key_str=key, attempt=failures
+                    )
+                    try:
+                        _, stats = execute_with_stats(
+                            pipeline.function, m, config=pipeline.config
+                        )
+                        break
+                    except Exception as exc:
+                        cls = policy.classify(exc)
+                        failures += 1
+                        # REQUEUE cannot arise in-process; treat it as RETRY
+                        if cls is Classification.FAIL_FAST:
+                            metrics.counter("task_failfast").inc()
+                            raise
+                        if failures > policy.retries:
+                            raise
+                        if not budget.consume():
+                            raise budget_exhausted_error(exc, budget) from exc
+                        delay = policy.backoff_delay(failures)
+                        logger.info(
+                            "retrying task %s (attempt %d) in %.3fs",
+                            key, failures + 1, delay,
+                        )
+                        metrics.counter("task_retries").inc()
+                        metrics.histogram("retry_backoff_s").observe(delay)
+                        if delay > 0:
+                            time.sleep(delay)
                 handle_callbacks(
                     callbacks,
                     dict(
@@ -48,6 +110,7 @@ class PythonDagExecutor(DagExecutor):
                         array_name=name,
                         task_create_tstamp=created,
                         chunk_key=key,
+                        attempt=failures,
                         executor=self.name,
                     ),
                 )

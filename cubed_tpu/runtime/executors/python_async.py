@@ -1,14 +1,22 @@
-"""Threaded async executor: completion-ordered fan-out with retries,
-speculative straggler backups, and optional batched submission.
+"""Threaded async executor: completion-ordered fan-out with classified
+retries, exponential backoff, speculative straggler backups, and optional
+batched submission.
 
 Reference parity: cubed/runtime/executors/python_async.py and the generic
 async_map_unordered core (cubed/runtime/executors/asyncio.py:11-102),
-reimplemented on concurrent.futures without aiostream.
+reimplemented on concurrent.futures without aiostream. Failure handling
+goes beyond the reference's flat immediate retries: exceptions are
+classified (``runtime/resilience.py``) — programming errors fail fast with
+exactly one attempt, transient errors resubmit after an exponential-backoff
+delay (scheduled, never blocking the completion loop), worker loss requeues
+for free — and every consumed retry draws from a compute-wide budget so a
+systemic outage aborts promptly.
 """
 
 from __future__ import annotations
 
 import concurrent.futures
+import heapq
 import itertools
 import logging
 import time
@@ -17,6 +25,15 @@ from typing import Callable, Dict, Iterable, Optional
 from ...observability.metrics import get_registry
 from ..backup import should_launch_backup
 from ..pipeline import visit_node_generations, visit_nodes
+from ..resilience import (
+    DEFAULT_RETRIES,
+    Classification,
+    RetryBudget,
+    RetryPolicy,
+    budget_exhausted_error,
+    compute_retry_budget,  # noqa: F401  (re-export for the other executors)
+    resolve_policy,
+)
 from ..types import (
     DagExecutor,
     OperationEndEvent,
@@ -34,9 +51,6 @@ from ..utils import (
 
 logger = logging.getLogger(__name__)
 
-#: reference default: 2 retries = 3 attempts (cubed/runtime/executors/python_async.py:30)
-DEFAULT_RETRIES = 2
-
 
 def map_unordered(
     executor: concurrent.futures.Executor,
@@ -49,6 +63,8 @@ def map_unordered(
     array_name: Optional[str] = None,
     array_names: Optional[list] = None,
     executor_name: Optional[str] = None,
+    retry_policy: Optional[RetryPolicy] = None,
+    retry_budget: Optional[RetryBudget] = None,
     **kwargs,
 ) -> None:
     """Run function over inputs, handling completion order, retries, backups.
@@ -59,14 +75,22 @@ def map_unordered(
     With ``batch_size`` set and no ``array_names``, inputs are consumed
     lazily batch by batch — large task grids never materialize in memory
     (that bounded-submission streaming is what ``batch_size`` is for).
+
+    ``retry_policy`` governs failure classification and backoff; when absent
+    a default policy is built around the ``retries`` int (which an explicit
+    policy overrides). ``retry_budget`` shares one circuit-breaker allowance
+    across several maps (a whole compute); when absent each batch gets its
+    own, sized to its task count.
     """
+    policy = resolve_policy(retry_policy, retries)
     if array_names is not None:
         inputs = list(inputs)
         assert len(array_names) == len(inputs)
     if batch_size is None:
         _map_unordered_batch(
-            executor, function, list(inputs), retries, use_backups,
-            callbacks, array_name, array_names, executor_name, **kwargs,
+            executor, function, list(inputs), policy, retry_budget,
+            use_backups, callbacks, array_name, array_names, executor_name,
+            **kwargs,
         )
     elif array_names is None:
         it = iter(inputs)
@@ -75,8 +99,9 @@ def map_unordered(
             if not batch:
                 break
             _map_unordered_batch(
-                executor, function, batch, retries, use_backups,
-                callbacks, array_name, None, executor_name, **kwargs,
+                executor, function, batch, policy, retry_budget,
+                use_backups, callbacks, array_name, None, executor_name,
+                **kwargs,
             )
     else:
         for start in range(0, len(inputs), batch_size):
@@ -84,7 +109,8 @@ def map_unordered(
                 executor,
                 function,
                 inputs[start : start + batch_size],
-                retries,
+                policy,
+                retry_budget,
                 use_backups,
                 callbacks,
                 array_name,
@@ -98,7 +124,8 @@ def _map_unordered_batch(
     executor,
     function,
     inputs: list,
-    retries: int,
+    policy: RetryPolicy,
+    budget: Optional[RetryBudget],
     use_backups: bool,
     callbacks,
     array_name,
@@ -107,7 +134,14 @@ def _map_unordered_batch(
     **kwargs,
 ) -> None:
     metrics = get_registry()
+    retries = policy.retries
+    if budget is None:
+        budget = policy.new_budget(len(inputs))
     attempts: Dict[int, int] = {i: 0 for i in range(len(inputs))}
+    #: free worker-loss reroutes consumed per input (capped by the policy)
+    requeues: Dict[int, int] = {}
+    #: min-heap of (due time, input index) retries awaiting their backoff
+    delayed: list[tuple[float, int]] = []
     start_times: Dict[object, float] = {}
     end_times: Dict[object, float] = {}
     create_times: Dict[int, float] = {}
@@ -148,14 +182,42 @@ def _map_unordered_batch(
             backups.setdefault(i, []).append(fut)
         return fut
 
+    def cancel_pending() -> None:
+        for f in pending:
+            f.cancel()
+
+    def resubmit(i: int) -> None:
+        # a raising submit (e.g. NoWorkersError from a dead fleet) must not
+        # leave the rest of the map running detached
+        try:
+            submit(i)
+        except Exception:
+            cancel_pending()
+            raise
+
     for i in range(len(inputs)):
         submit(i)
 
     try:
-        while pending:
+        while pending or delayed:
+            now = time.time()
+            # launch retries whose backoff has elapsed
+            while delayed and delayed[0][0] <= now:
+                _, i = heapq.heappop(delayed)
+                if i not in done_inputs:
+                    resubmit(i)
             metrics.gauge("queue_depth").set(len(pending))
+            if not pending:
+                # nothing in flight: sleep until the next retry is due
+                if delayed:
+                    time.sleep(max(0.0, min(delayed[0][0] - time.time(), 0.25)))
+                continue
+            timeout = 2.0
+            if delayed:
+                timeout = max(0.01, min(timeout, delayed[0][0] - now))
             done, _ = concurrent.futures.wait(
-                list(pending), timeout=2, return_when=concurrent.futures.FIRST_COMPLETED
+                list(pending), timeout=timeout,
+                return_when=concurrent.futures.FIRST_COMPLETED,
             )
             now = time.time()
             for fut in done:
@@ -168,23 +230,55 @@ def _map_unordered_batch(
                 end_times[fut] = now
                 if i in done_inputs:
                     continue  # a twin already won
-                try:
-                    _, stats = fut.result()
-                except Exception:
+                exc = fut.exception()
+                if exc is not None:
+                    twins = [f for f in pending if pending[f][0] == i]
+                    cls = policy.classify(exc)
+                    if (
+                        cls is Classification.REQUEUE
+                        and requeues.get(i, 0) < policy.max_requeues
+                    ):
+                        # the worker died, not the task: reroute to a
+                        # survivor without consuming a user-visible retry
+                        requeues[i] = requeues.get(i, 0) + 1
+                        metrics.counter("worker_loss_requeues").inc()
+                        logger.info(
+                            "requeueing input %s after worker loss "
+                            "(requeue %d/%d)", i, requeues[i],
+                            policy.max_requeues,
+                        )
+                        if not twins:
+                            resubmit(i)
+                        continue
                     attempts[i] += 1
                     # suppress if a backup twin is still running
-                    twins = [f for f in pending if pending[f][0] == i]
                     if twins:
                         continue
+                    if cls is Classification.FAIL_FAST:
+                        # deterministic programming error: retrying cannot
+                        # change the outcome — one attempt, no backoff
+                        metrics.counter("task_failfast").inc()
+                        cancel_pending()
+                        raise exc
                     if attempts[i] > retries:
-                        # cancel all remaining work and re-raise
-                        for f in pending:
-                            f.cancel()
-                        raise
-                    logger.info("retrying input %s (attempt %d)", i, attempts[i] + 1)
+                        cancel_pending()
+                        raise exc
+                    if not budget.consume():
+                        cancel_pending()
+                        raise budget_exhausted_error(exc, budget) from exc
+                    delay = policy.backoff_delay(attempts[i])
+                    logger.info(
+                        "retrying input %s (attempt %d) in %.3fs",
+                        i, attempts[i] + 1, delay,
+                    )
                     metrics.counter("task_retries").inc()
-                    submit(i)
+                    metrics.histogram("retry_backoff_s").observe(delay)
+                    if delay <= 0:
+                        resubmit(i)
+                    else:
+                        heapq.heappush(delayed, (now + delay, i))
                     continue
+                _, stats = fut.result()
                 done_inputs.add(i)
                 # cancel the losing twin(s)
                 for f in list(pending):
@@ -217,7 +311,8 @@ def _map_unordered_batch(
 
 
 class AsyncPythonDagExecutor(DagExecutor):
-    """ThreadPool executor with retries, backups and generation parallelism."""
+    """ThreadPool executor with classified retries, backups and generation
+    parallelism."""
 
     def __init__(
         self,
@@ -226,6 +321,7 @@ class AsyncPythonDagExecutor(DagExecutor):
         use_backups: bool = False,
         batch_size: Optional[int] = None,
         compute_arrays_in_parallel: bool = False,
+        retry_policy: Optional[RetryPolicy] = None,
         **kwargs,
     ):
         self.max_workers = max_workers
@@ -233,6 +329,7 @@ class AsyncPythonDagExecutor(DagExecutor):
         self.use_backups = use_backups
         self.batch_size = batch_size
         self.compute_arrays_in_parallel = compute_arrays_in_parallel
+        self.retry_policy = retry_policy
         self.kwargs = kwargs
 
     @property
@@ -250,6 +347,7 @@ class AsyncPythonDagExecutor(DagExecutor):
         use_backups: Optional[bool] = None,
         batch_size: Optional[int] = None,
         compute_arrays_in_parallel: Optional[bool] = None,
+        retry_policy: Optional[RetryPolicy] = None,
         **kwargs,
     ) -> None:
         retries = self.retries if retries is None else retries
@@ -257,6 +355,8 @@ class AsyncPythonDagExecutor(DagExecutor):
         batch_size = self.batch_size if batch_size is None else batch_size
         if compute_arrays_in_parallel is None:
             compute_arrays_in_parallel = self.compute_arrays_in_parallel
+        policy = resolve_policy(retry_policy or self.retry_policy, retries)
+        budget = compute_retry_budget(policy, dag)
 
         with concurrent.futures.ThreadPoolExecutor(
             max_workers=self.max_workers
@@ -266,7 +366,7 @@ class AsyncPythonDagExecutor(DagExecutor):
                 for generation in visit_node_generations(dag, resume=resume):
                     merged, pipelines = merge_generation(generation, callbacks)
                     self._run_tasks(
-                        pool, merged, pipelines, retries, use_backups,
+                        pool, merged, pipelines, policy, budget, use_backups,
                         batch_size, callbacks,
                     )
                     end_generation(generation, callbacks)
@@ -282,7 +382,8 @@ class AsyncPythonDagExecutor(DagExecutor):
                         pool,
                         pipeline.function,
                         pipeline.mappable,
-                        retries=retries,
+                        retry_policy=policy,
+                        retry_budget=budget,
                         use_backups=use_backups,
                         batch_size=batch_size,
                         callbacks=callbacks,
@@ -296,7 +397,8 @@ class AsyncPythonDagExecutor(DagExecutor):
                     )
 
     def _run_tasks(
-        self, pool, merged, pipelines, retries, use_backups, batch_size, callbacks
+        self, pool, merged, pipelines, policy, budget, use_backups,
+        batch_size, callbacks,
     ):
         def fn(item):
             name, m = item
@@ -307,7 +409,8 @@ class AsyncPythonDagExecutor(DagExecutor):
             pool,
             fn,
             merged,
-            retries=retries,
+            retry_policy=policy,
+            retry_budget=budget,
             use_backups=use_backups,
             batch_size=batch_size,
             callbacks=callbacks,

@@ -1,0 +1,358 @@
+"""Deterministic, seedable fault injection — off by default, on everywhere.
+
+Chaos testing the runtime needs failures that are (a) *representative* —
+storage read/write errors, task crashes, stragglers, worker loss — and
+(b) *reproducible*, so a failing chaos run replays. Decisions here are
+pure functions of ``(seed, site, key, nth-occurrence-in-this-process)``
+hashed through SHA-256, not draws from a shared RNG stream: the same
+chunk's first write attempt fails (or not) identically in every process
+that tries it, and a retry in the *same* process rolls a fresh decision —
+so an injected fault behaves transiently, which is exactly the class of
+failure the retry machinery exists for. The honest caveat: occurrence
+counters are per-process, so a retry that lands in a *different* process
+re-rolls that process's occurrence 0 and repeats the original decision;
+counters still advance wherever attempts land, so retries converge, but
+exact bit-for-bit replay holds only within one process — multi-process
+chaos runs are deterministic per (process, occurrence), not per global
+attempt order. Size retry counts accordingly (the chaos suite uses
+``retries=6`` against ~10-20% rates).
+
+Activation (everything defaults to off):
+
+- ``activate(FaultConfig(...))`` / ``deactivate()`` — programmatic,
+  process-local.
+- ``Spec(fault_injection={...})`` — ``Plan.execute`` activates for the
+  duration of that compute (via ``scoped``).
+- env ``CUBED_TPU_FAULTS='{"seed": 42, "storage_write_failure_rate": 0.1}'``
+  — a JSON ``FaultConfig``; this is how injection crosses process
+  boundaries: multiprocess pool workers and distributed fleet workers
+  inherit the environment, so one env var arms the whole fleet.
+
+Injection sites (each counted in the metrics registry under
+``faults_injected`` plus a per-site counter):
+
+- storage chunk reads/writes (``storage/store.py``) — raises
+  ``FaultInjectedIOError`` (an ``OSError``: classified transient). Only
+  fires inside a task scope, so plan-construction metadata IO and
+  client-side result fetches are never poisoned — the same places real
+  task-level retry protection exists. A failed local write can first
+  litter a partial ``.tmp`` file (``storage_write_leaves_tmp``), modelling
+  a task killed mid-write.
+- task bodies (``runtime/utils.execute_with_stats``) — raises
+  ``FaultInjectedTaskError`` (transient) or sleeps ``straggler_delay_s``
+  (what speculative backups exist for).
+- the distributed worker loop (``runtime/distributed.run_worker``) — a
+  named worker hard-exits (``os._exit``) or hangs after its nth task,
+  modelling OOM-kills and wedged hosts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+from ..observability.accounting import current_scope
+from ..observability.metrics import get_registry
+
+#: env var carrying a JSON FaultConfig into every child process
+FAULTS_ENV_VAR = "CUBED_TPU_FAULTS"
+
+
+class FaultInjectedError(Exception):
+    """Base for injected faults (never raised itself)."""
+
+
+class FaultInjectedIOError(FaultInjectedError, OSError):
+    """An injected storage failure — an OSError, classified transient."""
+
+
+class FaultInjectedTaskError(FaultInjectedError, RuntimeError):
+    """An injected task-body crash — classified transient."""
+
+
+@dataclass(frozen=True)
+class FaultConfig:
+    """What to break, how often. All rates are probabilities in [0, 1]."""
+
+    seed: int = 0
+    #: chunk read/write failure probability (inside task scopes only)
+    storage_read_failure_rate: float = 0.0
+    storage_write_failure_rate: float = 0.0
+    #: a failed local write first leaves a partial .tmp file behind
+    storage_write_leaves_tmp: bool = True
+    #: task body raises before running
+    task_failure_rate: float = 0.0
+    #: task body sleeps straggler_delay_s before running
+    straggler_rate: float = 0.0
+    straggler_delay_s: float = 0.25
+    #: distributed workers (by --name) that hard-exit / hang when their
+    #: per-process executed-task count reaches worker_*_after_tasks (>=1)
+    worker_crash_names: tuple = field(default_factory=tuple)
+    worker_crash_after_tasks: int = 0
+    worker_hang_names: tuple = field(default_factory=tuple)
+    worker_hang_after_tasks: int = 0
+    worker_hang_s: float = 3600.0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultConfig":
+        known = {f.name for f in fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown FaultConfig fields {sorted(unknown)}; "
+                f"known: {sorted(known)}"
+            )
+        d = dict(d)
+        for k in ("worker_crash_names", "worker_hang_names"):
+            if k in d:
+                d[k] = tuple(d[k])
+        return cls(**d)
+
+    def to_env_json(self) -> str:
+        out = {}
+        for f in fields(self):
+            v = getattr(self, f.name)
+            out[f.name] = list(v) if isinstance(v, tuple) else v
+        return json.dumps(out)
+
+    @property
+    def any_enabled(self) -> bool:
+        return bool(
+            self.storage_read_failure_rate
+            or self.storage_write_failure_rate
+            or self.task_failure_rate
+            or self.straggler_rate
+            or (self.worker_crash_names and self.worker_crash_after_tasks)
+            or (self.worker_hang_names and self.worker_hang_after_tasks)
+        )
+
+
+class FaultInjector:
+    """Seeded decision engine; one instance per process while active."""
+
+    def __init__(self, config: FaultConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        #: (site, key) -> occurrence count; the count is part of the hash
+        #: input, so a retry of the same operation rolls a fresh decision
+        self._counts: dict = {}
+
+    # -- the decision function ------------------------------------------
+
+    def _roll(self, site: str, key: str) -> float:
+        with self._lock:
+            n = self._counts.get((site, key), 0)
+            self._counts[(site, key)] = n + 1
+        digest = hashlib.sha256(
+            f"{self.config.seed}:{site}:{key}:{n}".encode()
+        ).digest()
+        return int.from_bytes(digest[:8], "big") / 2**64
+
+    def _hit(self, site: str, key: str, rate: float) -> bool:
+        if rate <= 0.0:
+            return False
+        if self._roll(site, key) >= rate:
+            return False
+        reg = get_registry()
+        reg.counter("faults_injected").inc()
+        reg.counter(f"faults_injected_{site}").inc()
+        return True
+
+    # -- storage --------------------------------------------------------
+
+    def storage_read_fault(self, key: str) -> bool:
+        """True -> the caller should raise FaultInjectedIOError. Only fires
+        inside a task scope (see module docstring)."""
+        if current_scope() is None:
+            return False
+        return self._hit("storage_read", key, self.config.storage_read_failure_rate)
+
+    def storage_write_fault(self, key: str) -> bool:
+        if current_scope() is None:
+            return False
+        return self._hit("storage_write", key, self.config.storage_write_failure_rate)
+
+    # -- task bodies ----------------------------------------------------
+
+    def task_fault(self, key: str) -> None:
+        """Raise an injected task failure and/or sleep a straggler delay."""
+        if self._hit("straggler", key, self.config.straggler_rate):
+            import time
+
+            time.sleep(self.config.straggler_delay_s)
+        if self._hit("task", key, self.config.task_failure_rate):
+            raise FaultInjectedTaskError(
+                f"injected task failure (seed={self.config.seed}, key={key!r})"
+            )
+
+    # -- distributed workers --------------------------------------------
+
+    def worker_task_tick(self, worker_name: str) -> Optional[str]:
+        """Called once per executed task on a fleet worker; returns
+        ``"crash"``/``"hang"`` exactly when this worker's per-process task
+        count reaches the configured threshold (one-shot per process)."""
+        cfg = self.config
+        if not (
+            (cfg.worker_crash_names and cfg.worker_crash_after_tasks)
+            or (cfg.worker_hang_names and cfg.worker_hang_after_tasks)
+        ):
+            return None
+        with self._lock:
+            n = self._counts.get(("worker_tick", worker_name), 0) + 1
+            self._counts[("worker_tick", worker_name)] = n
+        if (
+            worker_name in cfg.worker_crash_names
+            and n == cfg.worker_crash_after_tasks
+        ):
+            reg = get_registry()
+            reg.counter("faults_injected").inc()
+            reg.counter("faults_injected_worker_crash").inc()
+            return "crash"
+        if (
+            worker_name in cfg.worker_hang_names
+            and n == cfg.worker_hang_after_tasks
+        ):
+            reg = get_registry()
+            reg.counter("faults_injected").inc()
+            reg.counter("faults_injected_worker_hang").inc()
+            return "hang"
+        return None
+
+
+# ----------------------------------------------------------------------
+# process-level activation
+# ----------------------------------------------------------------------
+
+_lock = threading.Lock()
+_active: Optional[FaultInjector] = None
+#: (raw env string, injector built from it) — env parsing is cached per
+#: value so the per-IO fast path is a dict lookup + string compare
+_env_cache: tuple = (None, None)
+
+
+def _coerce(config) -> FaultConfig:
+    if isinstance(config, FaultConfig):
+        return config
+    if isinstance(config, dict):
+        return FaultConfig.from_dict(config)
+    raise TypeError(f"expected FaultConfig or dict, got {type(config).__name__}")
+
+
+def activate(config, export_env: bool = False) -> FaultInjector:
+    """Arm fault injection in this process (and, with ``export_env``, in
+    every child process spawned afterwards)."""
+    global _active
+    cfg = _coerce(config)
+    inj = FaultInjector(cfg)
+    with _lock:
+        _active = inj
+    if export_env:
+        os.environ[FAULTS_ENV_VAR] = cfg.to_env_json()
+    return inj
+
+
+def deactivate() -> None:
+    """Disarm, including any env-var activation exported by this process."""
+    global _active, _env_cache
+    with _lock:
+        _active = None
+        _env_cache = (None, None)
+    os.environ.pop(FAULTS_ENV_VAR, None)
+
+
+def get_injector() -> Optional[FaultInjector]:
+    """The active injector, or None (the common, fast case).
+
+    Programmatic activation wins; otherwise the env var is consulted so
+    spawned workers self-arm. A malformed env value raises loudly — silent
+    no-fault chaos runs would be worse than an error.
+    """
+    global _env_cache
+    if _active is not None:
+        return _active
+    raw = os.environ.get(FAULTS_ENV_VAR)
+    if not raw:
+        return None
+    cached_raw, cached_inj = _env_cache
+    if raw == cached_raw:
+        return cached_inj
+    cfg = FaultConfig.from_dict(json.loads(raw))
+    inj = FaultInjector(cfg) if cfg.any_enabled else None
+    with _lock:
+        _env_cache = (raw, inj)
+    return inj
+
+
+def wire_config() -> Optional[str]:
+    """The client's current arming state, serialized for task messages
+    (``None`` = unarmed). The distributed coordinator attaches this to
+    every task so fleet workers mirror the client exactly — workers that
+    joined before arming still inject, and disarming propagates instead of
+    leaving stale spawn-time env state behind."""
+    inj = get_injector()
+    return inj.config.to_env_json() if inj is not None else None
+
+
+#: (raw wire string, injector) — the worker-side mirror persists across
+#: tasks with the same config so occurrence counters advance
+_wire_cache: tuple = (None, None)
+
+
+def arm_from_wire(raw: Optional[str]) -> Optional[FaultInjector]:
+    """Fleet-worker side: adopt the arming state a task message carried.
+
+    ``None`` disarms (the client says no injection — overriding any stale
+    env the worker process was spawned with)."""
+    global _active, _wire_cache
+    if raw is None:
+        with _lock:
+            _active = None
+        return None
+    cached_raw, cached_inj = _wire_cache
+    if raw != cached_raw:
+        cfg = FaultConfig.from_dict(json.loads(raw))
+        cached_inj = FaultInjector(cfg) if cfg.any_enabled else None
+    with _lock:
+        _wire_cache = (raw, cached_inj)
+        _active = cached_inj
+    return cached_inj
+
+
+class scoped:
+    """Context manager arming injection for the duration of a ``with``
+    block (used by ``Plan.execute`` for ``Spec(fault_injection=...)``).
+    ``None`` config is a no-op, so callers need no conditional.
+
+    Arming is process-global for that duration — it must be: tasks run on
+    arbitrary pool threads, so a thread-local injector would never fire.
+    Consequently a compute running CONCURRENTLY in the same process during
+    an armed block sees the same injector (the same known limitation the
+    process-global metrics registry has — see ``Plan.execute``); chaos
+    testing and concurrent production computes don't mix in one process."""
+
+    def __init__(self, config=None, export_env: bool = False):
+        self._config = config
+        self._export_env = export_env
+
+    def __enter__(self):
+        if self._config is None:
+            return None
+        self._prev = _active
+        self._prev_env = os.environ.get(FAULTS_ENV_VAR)
+        return activate(self._config, export_env=self._export_env)
+
+    def __exit__(self, *exc) -> None:
+        if self._config is None:
+            return
+        global _active
+        with _lock:
+            _active = self._prev
+        if self._export_env:
+            if self._prev_env is None:
+                os.environ.pop(FAULTS_ENV_VAR, None)
+            else:
+                os.environ[FAULTS_ENV_VAR] = self._prev_env

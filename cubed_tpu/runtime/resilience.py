@@ -1,0 +1,286 @@
+"""Classified retries with exponential backoff and a per-compute budget.
+
+The execution model (SURVEY §2, docs/reliability.md) rests on idempotent,
+stateless tasks whose whole-chunk Zarr writes are atomic — any task may
+safely run more than once. This module decides *when* running it again is
+worth anything:
+
+- **Classification.** A ``TypeError`` thrown by user code is deterministic:
+  retrying it burns time and then fails identically. A dropped TCP
+  connection, a timed-out task, an fsspec read error are load- or
+  infrastructure-dependent: retrying them is the whole point of idempotent
+  tasks. ``RetryPolicy.classify`` splits exceptions into ``FAIL_FAST``
+  (programming errors: one attempt, no backoff), ``RETRY`` (transient:
+  backoff then re-run, consuming one of the task's ``retries``), and
+  ``REQUEUE`` (infrastructure took the *worker*, not the task —
+  ``WorkerLostError`` — so the task reroutes to a survivor without
+  consuming a user-visible retry). Unknown exception types default to
+  ``RETRY``: user task code raises arbitrary types and the reference
+  runtime retries everything, so the deny-list fails fast only on types
+  that are near-certainly deterministic.
+
+- **Backoff with full jitter.** ``backoff_delay(failure_n)`` grows
+  ``backoff_base * backoff_multiplier**(failure_n-1)`` capped at
+  ``backoff_max``; with ``jitter="full"`` the actual delay is uniform in
+  ``[0, that]`` (the AWS architecture-blog full-jitter scheme — it
+  decorrelates retry herds after a shared blip, e.g. every task of an op
+  hitting one flaky store). ``jitter="none"`` keeps the deterministic
+  ceiling, which chaos tests use to assert spacing. The RNG is seeded per
+  policy so a seeded run is reproducible.
+
+- **Retry budget (circuit breaker).** Per-task retries compose badly under
+  a systemic outage: N_tasks x retries attempts before anyone admits the
+  store is down. ``RetryPolicy.new_budget(n_tasks)`` returns a compute-wide
+  allowance (``max(budget_min, budget_factor * n_tasks * retries)``);
+  every consumed retry draws from it and exhaustion aborts the compute
+  promptly with ``RetryBudgetExceededError`` chaining the last real error.
+
+All executors share this policy object: ``map_unordered`` (threads,
+processes, distributed fleet) schedules delayed resubmission without
+blocking its completion loop, the sequential oracle sleeps inline, the
+multiprocess pool-crash path spaces pool rebuilds, and the storage layer
+reuses a small read-retry policy for transient chunk-read failures.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+import random
+import threading
+from typing import Optional
+
+from ..observability.metrics import get_registry
+
+#: reference default: 2 retries = 3 attempts per task
+DEFAULT_RETRIES = 2
+
+
+class Classification(enum.Enum):
+    """What a failure means for the task that raised it."""
+
+    RETRY = "retry"  #: transient — backoff, consume one retry, re-run
+    FAIL_FAST = "fail_fast"  #: deterministic — one attempt, no backoff
+    REQUEUE = "requeue"  #: the worker died, not the task — free reroute
+
+
+class RetryBudgetExceededError(RuntimeError):
+    """The compute-wide retry budget is spent: failures are systemic, not
+    per-task noise. Carries the triggering task error as ``__cause__``."""
+
+
+#: exception type names that are near-certainly deterministic programming
+#: errors when raised by a task body: re-running the same idempotent task on
+#: the same input reproduces them bit-for-bit. Matched by name so remote
+#: errors (RemoteTaskError.remote_type, a string crossing the wire) share
+#: one table with local ones.
+FAIL_FAST_TYPE_NAMES = frozenset(
+    {
+        "TypeError",
+        "AssertionError",
+        "AttributeError",
+        "NameError",
+        "UnboundLocalError",
+        "IndexError",
+        "KeyError",
+        "ValueError",
+        "ZeroDivisionError",
+        "NotImplementedError",
+        "ImportError",
+        "ModuleNotFoundError",
+        "SyntaxError",
+        "RecursionError",
+    }
+)
+
+
+def _fail_fast_by_mro(exc: BaseException) -> bool:
+    """True if any class in the exception's MRO is deny-listed (so a user
+    subclass of ValueError fails fast like ValueError itself)."""
+    return any(
+        c.__name__ in FAIL_FAST_TYPE_NAMES for c in type(exc).__mro__
+    )
+
+
+class RetryPolicy:
+    """Classification + backoff + budget, shared by every executor.
+
+    Parameters
+    ----------
+    retries:
+        Per-task transient-failure retries (attempts = retries + 1).
+    backoff_base / backoff_multiplier / backoff_max:
+        Exponential backoff ceiling for the nth failure:
+        ``min(backoff_max, backoff_base * backoff_multiplier**(n-1))``.
+    jitter:
+        ``"full"`` (delay uniform in [0, ceiling]) or ``"none"``
+        (deterministic ceiling — what chaos tests assert spacing against).
+    seed:
+        Seeds the jitter RNG for reproducible delay sequences.
+    max_requeues:
+        Per-task cap on free ``REQUEUE`` reroutes (worker loss); beyond it
+        a lost worker's task failure consumes a normal retry, so a fleet
+        that keeps eating workers cannot loop forever.
+    budget_factor / budget_min:
+        Sizing for ``new_budget``: the compute-wide retry allowance is
+        ``max(budget_min, ceil(budget_factor * n_tasks * retries))``.
+        ``budget_factor=None`` disables the circuit breaker.
+    """
+
+    def __init__(
+        self,
+        retries: int = DEFAULT_RETRIES,
+        backoff_base: float = 0.05,
+        backoff_multiplier: float = 2.0,
+        backoff_max: float = 5.0,
+        jitter: str = "full",
+        seed: Optional[int] = None,
+        max_requeues: int = 3,
+        budget_factor: Optional[float] = 0.5,
+        budget_min: int = 8,
+    ):
+        if jitter not in ("full", "none"):
+            raise ValueError(f"jitter must be 'full' or 'none', got {jitter!r}")
+        self.retries = int(retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_multiplier = float(backoff_multiplier)
+        self.backoff_max = float(backoff_max)
+        self.jitter = jitter
+        self.seed = seed
+        self.max_requeues = int(max_requeues)
+        self.budget_factor = budget_factor
+        self.budget_min = int(budget_min)
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+
+    # -- classification -------------------------------------------------
+
+    def classify(self, exc: BaseException) -> Classification:
+        # local imports: distributed pulls in sockets/threading machinery
+        # that pure-local executors never need at import time
+        from concurrent.futures import BrokenExecutor
+
+        from .distributed import RemoteTaskError, WorkerLostError
+
+        if isinstance(exc, (WorkerLostError, BrokenExecutor)):
+            # the worker (or the whole pool) died, not the task. For a
+            # broken pool every in-flight future fails with the same
+            # BrokenExecutor; REQUEUE keeps those from draining the budget
+            # and attempts max_workers times per crash — the first
+            # resubmission onto the dead pool raises, escapes to the
+            # pool-rebuild path, and THAT single event pays one budget unit
+            return Classification.REQUEUE
+        if isinstance(exc, RemoteTaskError):
+            # the worker ships the root exception's class name alongside
+            # the traceback text; unknown/absent -> transient default.
+            # Import errors are excluded from remote fail-fast: on a
+            # heterogeneous fleet a missing module is a property of ONE
+            # host's environment, and a retry may route to a correctly
+            # provisioned worker (locally they stay fail-fast — there is
+            # only one environment to be missing from)
+            rtype = getattr(exc, "remote_type", None)
+            if rtype in FAIL_FAST_TYPE_NAMES and rtype not in (
+                "ImportError", "ModuleNotFoundError"
+            ):
+                return Classification.FAIL_FAST
+            return Classification.RETRY
+        if _fail_fast_by_mro(exc):
+            return Classification.FAIL_FAST
+        # everything else — OSError and friends, TimeoutError,
+        # TaskTimeoutError, BrokenProcessPool, MemoryError (load-dependent),
+        # plain RuntimeError from user code — is worth another attempt
+        return Classification.RETRY
+
+    # -- backoff --------------------------------------------------------
+
+    def backoff_ceiling(self, failure_n: int) -> float:
+        """Deterministic delay ceiling for the nth failure (1-based)."""
+        n = max(1, int(failure_n))
+        return min(
+            self.backoff_max,
+            self.backoff_base * self.backoff_multiplier ** (n - 1),
+        )
+
+    def backoff_delay(self, failure_n: int) -> float:
+        """The delay to wait before re-running after the nth failure."""
+        ceiling = self.backoff_ceiling(failure_n)
+        if self.jitter == "none":
+            return ceiling
+        with self._rng_lock:
+            return self._rng.uniform(0.0, ceiling)
+
+    # -- budget ---------------------------------------------------------
+
+    def new_budget(self, n_tasks: Optional[int] = None) -> "RetryBudget":
+        """A compute-wide retry allowance sized to the task count."""
+        if self.budget_factor is None or self.retries <= 0:
+            return RetryBudget(None)
+        limit = max(
+            self.budget_min,
+            math.ceil(self.budget_factor * max(0, n_tasks or 0) * self.retries),
+        )
+        return RetryBudget(limit)
+
+    def __repr__(self) -> str:
+        return (
+            f"RetryPolicy(retries={self.retries}, "
+            f"backoff={self.backoff_base}x{self.backoff_multiplier}"
+            f"<= {self.backoff_max}, jitter={self.jitter!r}, "
+            f"max_requeues={self.max_requeues})"
+        )
+
+
+class RetryBudget:
+    """Thread-safe compute-wide retry allowance. ``limit=None`` = unbounded."""
+
+    def __init__(self, limit: Optional[int]):
+        self.limit = limit
+        self.spent = 0
+        self._lock = threading.Lock()
+
+    def consume(self, n: int = 1) -> bool:
+        """Draw *n* retries; False (nothing drawn) once the budget is spent."""
+        with self._lock:
+            if self.limit is not None and self.spent + n > self.limit:
+                return False
+            self.spent += n
+            return True
+
+    @property
+    def remaining(self) -> Optional[int]:
+        with self._lock:
+            return None if self.limit is None else self.limit - self.spent
+
+    def __repr__(self) -> str:
+        return f"RetryBudget(spent={self.spent}, limit={self.limit})"
+
+
+def resolve_policy(
+    retry_policy: Optional[RetryPolicy], retries: Optional[int]
+) -> RetryPolicy:
+    """One rule for every executor: an explicit policy wins; otherwise a
+    default policy built around the ``retries`` int (the pre-policy API,
+    kept working everywhere)."""
+    if retry_policy is not None:
+        return retry_policy
+    return RetryPolicy(retries=DEFAULT_RETRIES if retries is None else retries)
+
+
+def budget_exhausted_error(exc: BaseException, budget: RetryBudget):
+    """Uniform circuit-breaker trip: counted, logged, chained."""
+    get_registry().counter("retry_budget_exhausted").inc()
+    return RetryBudgetExceededError(
+        f"compute-wide retry budget exhausted ({budget.spent} retries "
+        f"consumed, limit {budget.limit}): failures are systemic, not "
+        f"per-task noise; last task error: {exc!r}"
+    )
+
+
+def compute_retry_budget(policy: RetryPolicy, dag) -> RetryBudget:
+    """One circuit-breaker allowance for a whole compute, sized to the
+    plan's total task count — the single sizing rule shared by every
+    executor that drives a DAG."""
+    from .pipeline import iter_op_nodes
+
+    total = sum(d["primitive_op"].num_tasks for _, d in iter_op_nodes(dag))
+    return policy.new_budget(total)

@@ -28,9 +28,22 @@ from .types import (
 
 
 def execute_with_stats(function, *args, **kwargs):
-    """Run a task function, returning (result, stats-dict)."""
+    """Run a task function, returning (result, stats-dict).
+
+    This wrapper runs wherever the task runs (client thread, pool process,
+    fleet worker), which makes it the one chokepoint where chaos testing can
+    inject task-level faults: an armed ``FaultInjector`` may sleep an
+    artificial straggler delay or raise a (transient-classified) injected
+    failure before the body runs — inside the task scope, so the retry
+    machinery sees it exactly like a real task failure.
+    """
+    from .faults import get_injector
+
     peak_before = peak_measured_mem()
     with task_scope() as scope:
+        injector = get_injector()
+        if injector is not None:
+            injector.task_fault(chunk_key(args[0]) if args else "")
         start = time.time()
         result = function(*args, **kwargs)
         end = time.time()

@@ -30,6 +30,7 @@ class Spec:
         mesh_shape: Optional[tuple] = None,
         executor_name: Optional[str] = None,
         executor_options: Optional[dict] = None,
+        fault_injection: Optional[Any] = None,
     ):
         self._work_dir = work_dir
         self._reserved_mem = convert_to_bytes(reserved_mem or 0)
@@ -43,6 +44,7 @@ class Spec:
         self._storage_options = storage_options
         self._device_mem = convert_to_bytes(device_mem) if device_mem is not None else None
         self._mesh_shape = mesh_shape
+        self._fault_injection = fault_injection
 
     @property
     def work_dir(self) -> Optional[str]:
@@ -83,6 +85,13 @@ class Spec:
     @property
     def mesh_shape(self) -> Optional[tuple]:
         return self._mesh_shape
+
+    @property
+    def fault_injection(self) -> Optional[Any]:
+        """Chaos-testing fault config (a ``runtime.faults.FaultConfig`` or
+        plain dict); ``Plan.execute`` arms it for the compute's duration.
+        ``None`` (the default) means no injection."""
+        return self._fault_injection
 
     def __repr__(self) -> str:
         return (

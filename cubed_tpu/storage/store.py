@@ -6,7 +6,7 @@ one raw (uncompressed, C-order) file per chunk, named with ``.``-separated
 chunk indices — the standard Zarr v2 on-disk layout, readable by any Zarr
 implementation. Chunk writes are atomic (temp file + rename), which is what
 makes duplicate/backup tasks and retries safe, matching the reference's
-object-storage semantics (reference docs/user-guide/reliability.md).
+object-storage semantics (reference docs/reliability.md).
 
 Local paths use direct file IO; other URLs go through fsspec.
 
@@ -17,8 +17,10 @@ Reference parity: the role of the zarr-python dependency in cubed
 from __future__ import annotations
 
 import json
+import logging
 import os
 import tempfile
+import time
 import uuid
 from math import prod
 from typing import Any, Optional, Sequence
@@ -27,9 +29,38 @@ import numpy as np
 
 from ..chunks import blockdims_from_blockshape
 from ..observability.accounting import record_bytes_read, record_bytes_written
+from ..observability.metrics import get_registry
+from ..runtime.faults import FaultInjectedIOError, get_injector
+from ..runtime.resilience import RetryPolicy
 from ..utils import join_path
 
+logger = logging.getLogger(__name__)
+
 _LOCAL_SCHEMES = ("", "file")
+
+#: a crashed writer's orphaned ``.tmp`` is only swept once it is at least
+#: this old — a LIVE writer's temp file (written then atomically renamed
+#: within milliseconds) must never be yanked out from under it
+ORPHAN_TMP_MAX_AGE_S = 60.0
+
+#: (raw env value, policy) — chunk-read retries for transient IO errors,
+#: tunable via CUBED_TPU_STORAGE_READ_RETRIES (0 disables)
+_read_policy_cache: tuple = (None, None)
+
+
+def _read_retry_policy() -> RetryPolicy:
+    global _read_policy_cache
+    raw = os.environ.get("CUBED_TPU_STORAGE_READ_RETRIES", "2")
+    cached_raw, cached = _read_policy_cache
+    if raw == cached_raw:
+        return cached
+    try:
+        retries = max(0, int(raw))
+    except ValueError:
+        retries = 2
+    policy = RetryPolicy(retries=retries, backoff_base=0.02, backoff_max=0.5)
+    _read_policy_cache = (raw, policy)
+    return policy
 
 
 def _is_local(path: str) -> bool:
@@ -55,12 +86,28 @@ class _LocalIO:
         return os.path.exists(os.path.join(self.root, name))
 
     def read_bytes(self, name: str) -> bytes:
+        injector = get_injector()
+        if injector is not None and injector.storage_read_fault(
+            _fault_key(self.root, name)
+        ):
+            raise FaultInjectedIOError(f"injected read failure: {name}")
         with open(os.path.join(self.root, name), "rb") as f:
             return f.read()
 
     def write_bytes_atomic(self, name: str, data: bytes) -> None:
         path = os.path.join(self.root, name)
         tmp = path + f".{uuid.uuid4().hex[:8]}.tmp"
+        injector = get_injector()
+        if injector is not None and injector.storage_write_fault(
+            _fault_key(self.root, name)
+        ):
+            if injector.config.storage_write_leaves_tmp:
+                # model a writer killed mid-write: a partial temp file is
+                # left behind, the chunk itself stays untouched (exactly
+                # what the orphan sweep + resume must tolerate)
+                with open(tmp, "wb") as f:
+                    f.write(data[: max(1, len(data) // 2)])
+            raise FaultInjectedIOError(f"injected write failure: {name}")
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, path)  # atomic on POSIX: concurrent duplicate tasks are safe
@@ -70,6 +117,35 @@ class _LocalIO:
             return os.listdir(self.root)
         except FileNotFoundError:
             return []
+
+    def sweep_tmp(self, max_age_s: float = ORPHAN_TMP_MAX_AGE_S) -> int:
+        """Remove orphaned ``*.tmp`` files left by crashed writers.
+
+        Only files older than *max_age_s* go: a temp file that young may
+        belong to a live writer about to ``os.replace`` it. Returns the
+        number removed. Missing files (a concurrent sweeper or the writer's
+        rename) are skipped silently — the sweep is best-effort hygiene,
+        never load-bearing (readers and ``nchunks_initialized`` already
+        ignore ``.tmp`` names)."""
+        removed = 0
+        now = time.time()
+        for name in self.list_names():
+            if not name.endswith(".tmp"):
+                continue
+            path = os.path.join(self.root, name)
+            try:
+                if now - os.path.getmtime(path) < max_age_s:
+                    continue
+                os.unlink(path)
+                removed += 1
+            except OSError:
+                continue
+        if removed:
+            get_registry().counter("orphan_tmps_swept").inc(removed)
+            logger.info(
+                "swept %d orphaned tmp file(s) from %s", removed, self.root
+            )
+        return removed
 
 
 class _FsspecIO:
@@ -87,10 +163,21 @@ class _FsspecIO:
         return self.fs.exists(f"{self.root}/{name}")
 
     def read_bytes(self, name: str) -> bytes:
+        injector = get_injector()
+        if injector is not None and injector.storage_read_fault(
+            _fault_key(self.root, name)
+        ):
+            raise FaultInjectedIOError(f"injected read failure: {name}")
         with self.fs.open(f"{self.root}/{name}", "rb") as f:
             return f.read()
 
     def write_bytes_atomic(self, name: str, data: bytes) -> None:
+        injector = get_injector()
+        if injector is not None and injector.storage_write_fault(
+            _fault_key(self.root, name)
+        ):
+            # whole-object PUTs can't leave partial objects; just fail
+            raise FaultInjectedIOError(f"injected write failure: {name}")
         # object stores have atomic whole-object PUTs
         with self.fs.open(f"{self.root}/{name}", "wb") as f:
             f.write(data)
@@ -100,6 +187,19 @@ class _FsspecIO:
             return [p.rsplit("/", 1)[-1] for p in self.fs.ls(self.root, detail=False)]
         except FileNotFoundError:
             return []
+
+    def sweep_tmp(self, max_age_s: float = ORPHAN_TMP_MAX_AGE_S) -> int:
+        """Object-store writes are whole-object PUTs — no temp files to
+        sweep (a crashed PUT leaves nothing)."""
+        return 0
+
+
+def _fault_key(root: str, name: str) -> str:
+    """Injection-decision key: array dirname + chunk name, NOT the full
+    path. Work dirs are per-run temp paths; hashing them would make a
+    seeded chaos run non-reproducible, while the array's own name (the
+    plan's stable op naming) plus the chunk index replays identically."""
+    return f"{os.path.basename(str(root).rstrip('/'))}/{name}"
 
 
 def _make_io(store: str, storage_options: Optional[dict] = None):
@@ -279,7 +379,7 @@ class ZarrV2Array:
         key = self._chunk_key(idx)
         if not self._io.exists(key):
             return None
-        data = self._io.read_bytes(key)
+        data = self._read_bytes_with_retries(key)
         # IO bytes as stored (pre-decompression), attributed to the reading
         # task's scope when one is active (observability/accounting.py)
         record_bytes_read(self.store, len(data))
@@ -287,6 +387,37 @@ class ZarrV2Array:
             data = self._codec[1](data)
         arr = np.frombuffer(data, dtype=self.dtype)
         return arr.reshape(self.chunks if self.shape else ())
+
+    def _read_bytes_with_retries(self, key: str) -> bytes:
+        """Chunk reads retry transient IO errors at the storage layer.
+
+        A flaky read inside a task would otherwise burn a whole task retry
+        (re-running every read and the compute the task already did); two
+        cheap in-place retries with short backoff absorb the common blip.
+        ``FileNotFoundError`` after a successful exists() is an anomaly
+        (chunks are write-once; the sweep only touches ``.tmp`` names), so
+        it retries like any OSError — an eventually-consistent store heals,
+        anything else fails the task loudly. It must NOT read as "absent":
+        silently substituting fill values for real data would complete the
+        compute with wrong results.
+        """
+        policy = _read_retry_policy()
+        failures = 0
+        while True:
+            try:
+                return self._io.read_bytes(key)
+            except OSError as exc:
+                failures += 1
+                if failures > policy.retries:
+                    raise
+                delay = policy.backoff_delay(failures)
+                logger.info(
+                    "retrying chunk read %s/%s (attempt %d) in %.3fs: %s",
+                    self.store, key, failures + 1, delay, exc,
+                )
+                get_registry().counter("storage_read_retries").inc()
+                if delay > 0:
+                    time.sleep(delay)
 
     def _write_chunk(self, idx: tuple[int, ...], arr: np.ndarray) -> None:
         arr = np.ascontiguousarray(arr, dtype=self.dtype)
@@ -509,6 +640,11 @@ def open_zarr_array(
     ``a`` so resumed runs don't clobber; reference cubed/core/plan.py:430-432).
     """
     io = _make_io(store, storage_options)
+    if mode != "r":
+        # writer-mode opens (the create-arrays op at compute start, resume
+        # re-opens) sweep orphaned .tmp litter from previously crashed
+        # writers; read opens skip the listdir (readers ignore .tmp anyway)
+        io.sweep_tmp()
     meta_exists = io.exists(".zarray")
     if mode == "r" or (mode == "a" and meta_exists):
         if not meta_exists:

@@ -62,6 +62,10 @@ def pytest_addoption(parser):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: mark test as slow to run")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection chaos tests (seeded, tier-1)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,6 +1,11 @@
 """Straggler-backup policy unit tests. Reference parity:
-cubed/tests/runtime/test_backup.py."""
+cubed/tests/runtime/test_backup.py, extended with edge cases (zero-duration
+tasks, single-task ops, already-backed-up tasks) and the
+``speculative_backups`` metrics contract."""
 
+import concurrent.futures
+
+from cubed_tpu.observability.metrics import get_registry
 from cubed_tpu.runtime.backup import should_launch_backup
 
 
@@ -27,3 +32,72 @@ def test_backup_launched_for_straggler():
     start = {i: 0.0 for i in range(20)}
     end = {i: 1.0 for i in range(15)}
     assert should_launch_backup(19, 3.5, start, end)
+
+
+def test_zero_duration_tasks_make_any_elapsed_task_a_straggler():
+    """All completed tasks took ~0s -> the median is 0, so 3x the median is
+    0 and any task that has been running a measurable time is an outlier.
+    That is the intended reading: against instant peers, a runner IS slow."""
+    start = {i: 0.0 for i in range(20)}
+    end = {i: 0.0 for i in range(15)}  # zero-duration completions
+    assert should_launch_backup(19, 0.001, start, end)
+    # but a task with zero elapsed time is not (0 > 3*0 is false)
+    assert not should_launch_backup(19, 0.0, start, end)
+
+
+def test_single_task_op_never_launches_backup():
+    """A 1-task op can't establish a median; the min-started floor keeps
+    the policy silent rather than duplicating the only task."""
+    assert not should_launch_backup(0, 1e9, {0: 0.0}, {})
+    assert not should_launch_backup(0, 1e9, {0: 0.0}, {0: 5.0})
+
+
+def test_no_completed_durations_never_launches_backup():
+    """Enough tasks started but nothing finished: no duration distribution
+    to call anyone an outlier against (also guards the empty-median path)."""
+    start = {i: 0.0 for i in range(20)}
+    assert not should_launch_backup(19, 1e9, start, {})
+
+
+def test_end_times_without_start_times_ignored():
+    """Durations only count tasks present in BOTH maps (a backup twin's end
+    can outlive its original's bookkeeping)."""
+    start = {i: 0.0 for i in range(20)}
+    end = {i: 1.0 for i in range(15)}
+    end[99] = 0.0  # no matching start: must not poison the median
+    assert should_launch_backup(19, 3.5, start, end)
+
+
+def test_map_unordered_backs_up_each_task_at_most_once(monkeypatch):
+    """Once a task has a backup twin, the policy is not consulted again for
+    it — 'all tasks already backed up' launches nothing new — and every
+    launch increments the speculative_backups counter."""
+    import cubed_tpu.runtime.executors.python_async as pa
+
+    monkeypatch.setattr(pa, "should_launch_backup", lambda *a: True)
+
+    class SlowThenDonePool:
+        """First submission per input stays pending long enough for several
+        backup-scan rounds; everything completes once backups exist."""
+
+        def __init__(self):
+            self.futs = []
+
+        def submit(self, fn, *args, **kwargs):
+            f = concurrent.futures.Future()
+            self.futs.append(f)
+            if len(self.futs) >= 4:  # 2 originals + 2 backups
+                for g in self.futs:
+                    if not g.done():
+                        g.set_result((None, {}))
+            return f
+
+    before = get_registry().snapshot()
+    pool = SlowThenDonePool()
+    pa.map_unordered(
+        pool, lambda x: x, [0, 1], use_backups=True, array_name="op"
+    )
+    # exactly one backup per input despite the always-yes policy
+    assert len(pool.futs) == 4
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("speculative_backups", 0) == 2

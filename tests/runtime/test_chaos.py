@@ -1,0 +1,379 @@
+"""Chaos suite: end-to-end computes must survive injected storage
+flakiness, task crashes, stragglers, and mid-compute worker loss — with
+bitwise-correct results and bounded attempt counts — on every executor.
+
+All tests run a seeded deterministic ``FaultInjector``
+(``cubed_tpu/runtime/faults.py``); none touch the network beyond
+localhost. Marked ``chaos`` (registered in conftest; tier-1, not slow).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime import faults
+from cubed_tpu.runtime.executors.python import PythonDagExecutor
+from cubed_tpu.runtime.executors.python_async import (
+    AsyncPythonDagExecutor,
+    map_unordered,
+)
+from cubed_tpu.runtime.resilience import RetryBudgetExceededError, RetryPolicy
+
+pytestmark = pytest.mark.chaos
+
+#: the acceptance-criteria storage chaos profile: ~10% write flakiness plus
+#: some read flakiness and task crashes; a seeded run replays identically
+CHAOS_STORAGE = dict(
+    seed=42,
+    storage_read_failure_rate=0.1,
+    storage_write_failure_rate=0.15,
+    task_failure_rate=0.1,
+)
+
+
+class _StatsCapture:
+    stats: dict = {}
+
+    def on_compute_end(self, event):
+        self.stats = event.executor_stats or {}
+
+
+def _spec(tmp_path, **fault_kwargs):
+    return ct.Spec(
+        work_dir=str(tmp_path),
+        allowed_mem="500MB",
+        fault_injection=fault_kwargs or None,
+    )
+
+
+# -- end-to-end under storage flakiness, per executor --------------------
+
+
+def test_chaos_threaded_storage_flakiness_bitwise_correct(tmp_path):
+    spec = _spec(tmp_path, **CHAOS_STORAGE)
+    an = np.arange(400, dtype=np.float64).reshape(20, 20)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 100 chunks
+    b = xp.add(a, 1.0)
+    cap = _StatsCapture()
+    result = b.compute(
+        executor=AsyncPythonDagExecutor(
+            retry_policy=RetryPolicy(retries=6, backoff_base=0.01, seed=0)
+        ),
+        callbacks=[cap],
+    )
+    np.testing.assert_array_equal(result, an + 1.0)  # bitwise-correct
+    # every injection and every retry shows up in the metrics snapshot
+    assert cap.stats.get("faults_injected", 0) > 0, cap.stats
+    assert cap.stats.get("task_retries", 0) > 0, cap.stats
+    bo = cap.stats.get("retry_backoff_s") or {}
+    assert bo.get("count", 0) == cap.stats["task_retries"]
+
+
+def test_chaos_sequential_storage_flakiness(tmp_path):
+    spec = _spec(tmp_path, **CHAOS_STORAGE)
+    an = np.arange(144, dtype=np.float64).reshape(12, 12)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 36 chunks
+    cap = _StatsCapture()
+    result = xp.multiply(a, 2.0).compute(
+        executor=PythonDagExecutor(
+            retry_policy=RetryPolicy(retries=6, backoff_base=0.01, seed=0)
+        ),
+        callbacks=[cap],
+    )
+    np.testing.assert_array_equal(result, an * 2.0)
+    assert cap.stats.get("faults_injected", 0) > 0, cap.stats
+    assert cap.stats.get("task_retries", 0) > 0, cap.stats
+
+
+def test_chaos_multiprocess_storage_flakiness(tmp_path, monkeypatch):
+    # env-var activation: spawned pool workers inherit the armed injector
+    monkeypatch.setenv(
+        faults.FAULTS_ENV_VAR,
+        faults.FaultConfig(
+            seed=42, storage_write_failure_rate=0.2
+        ).to_env_json(),
+    )
+    from cubed_tpu.runtime.executors.multiprocess import MultiprocessDagExecutor
+
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+    an = np.arange(100, dtype=np.float64).reshape(10, 10)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 25 chunks
+    cap = _StatsCapture()
+    result = xp.add(a, 3.0).compute(
+        executor=MultiprocessDagExecutor(
+            max_workers=2,
+            retry_policy=RetryPolicy(retries=6, backoff_base=0.01, seed=0),
+        ),
+        callbacks=[cap],
+    )
+    np.testing.assert_array_equal(result, an + 3.0)
+    # injections happen worker-side; the retries they force are client-side
+    assert cap.stats.get("task_retries", 0) > 0, cap.stats
+
+
+def test_chaos_distributed_worker_crash_mid_compute(tmp_path, monkeypatch):
+    """Storage flakiness plus one injected worker hard-exit: in-flight tasks
+    fail with WorkerLostError and requeue onto the survivor for free, task
+    faults burn normal retries, and the result is still bitwise-correct."""
+    from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+
+    monkeypatch.setenv(
+        faults.FAULTS_ENV_VAR,
+        faults.FaultConfig(
+            seed=7,
+            storage_write_failure_rate=0.1,
+            # locally spawned workers are named local-0/local-1; the
+            # injector (armed in each worker via the inherited env) crashes
+            # local-0 when it starts its 2nd task
+            worker_crash_names=("local-0",),
+            worker_crash_after_tasks=2,
+        ).to_env_json(),
+    )
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+    an = np.arange(256, dtype=np.float64).reshape(16, 16)
+    before = get_registry().snapshot()
+    ex = DistributedDagExecutor(
+        n_local_workers=2,
+        retry_policy=RetryPolicy(retries=6, backoff_base=0.01, seed=0),
+    )
+    try:
+        ex._ensure_fleet()
+        a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 64 tasks per op
+        cap = _StatsCapture()
+        result = xp.add(a, 1.0).compute(executor=ex, callbacks=[cap])
+        np.testing.assert_array_equal(result, an + 1.0)
+        assert ex._coordinator.stats["workers_lost"] >= 1
+        assert ex._coordinator.n_workers >= 1  # the survivor carried it
+        delta = get_registry().snapshot_delta(before)
+        assert delta.get("worker_loss_requeues", 0) >= 1, delta
+    finally:
+        ex.close()
+
+
+# -- failure classification ----------------------------------------------
+
+
+def test_chaos_nonretryable_fails_fast_exactly_one_attempt():
+    """A deterministic programming error gets exactly 1 attempt: no retry,
+    no backoff, even with retries configured."""
+    calls = {}
+    lock = threading.Lock()
+
+    def boom(i, config=None):
+        with lock:
+            calls[i] = calls.get(i, 0) + 1
+        raise TypeError(f"deterministic bug on {i}")
+
+    before = get_registry().snapshot()
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as pool:
+        with pytest.raises(TypeError, match="deterministic bug"):
+            map_unordered(
+                pool, boom, [0],
+                retry_policy=RetryPolicy(retries=5, backoff_base=0.2),
+            )
+    assert calls == {0: 1}
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("task_failfast", 0) == 1
+    assert delta.get("task_retries", 0) == 0
+    assert (delta.get("retry_backoff_s") or {}).get("count", 0) == 0
+
+
+def test_chaos_nonretryable_fails_fast_end_to_end(tmp_path):
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+    an = np.ones((4, 4))
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    calls = {}
+    lock = threading.Lock()
+
+    def bad(x):
+        with lock:
+            calls["n"] = calls.get("n", 0) + 1
+        raise ValueError("wrong units")
+
+    r = ct.map_blocks(bad, a, dtype=np.float64)
+    with pytest.raises(ValueError, match="wrong units"):
+        r.compute(executor=AsyncPythonDagExecutor(retries=5))
+    # each of the 4 chunk tasks ran at most once; none was ever retried
+    assert calls["n"] <= 4
+
+
+def test_chaos_remote_programming_error_fails_fast(tmp_path):
+    """The distributed fleet ships the remote exception's class name, so a
+    remote TypeError fails fast instead of burning retries on reruns."""
+    from cubed_tpu.runtime.distributed import RemoteTaskError
+    from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+    a = ct.from_array(np.ones((4, 4)), chunks=(2, 2), spec=spec)
+    path = tmp_path / "counts"
+    path.mkdir()
+
+    with DistributedDagExecutor(n_local_workers=1) as ex:
+        r = ct.map_blocks(
+            _CountingTypeErrorTask(str(path)), a, dtype=np.float64
+        )
+        with pytest.raises(RemoteTaskError, match="TypeError"):
+            r.compute(executor=ex, retries=5)
+    from .utils import read_int_from_file
+
+    total = sum(
+        read_int_from_file(str(path / str(i))) for i in range(8)
+    )
+    assert 1 <= total <= 4  # at most once per chunk task, never retried
+
+
+class _CountingTypeErrorTask:
+    """Picklable task recording invocations in files, then raising a
+    deterministic programming error."""
+
+    def __init__(self, path):
+        self.path = path
+        self.n = 0
+
+    def __call__(self, x):
+        from .utils import read_int_from_file, write_int_to_file
+
+        f = os.path.join(self.path, str(os.getpid() % 8))
+        write_int_to_file(f, read_int_from_file(f) + 1)
+        raise TypeError("deterministic remote bug")
+
+
+# -- backoff spacing ------------------------------------------------------
+
+
+def test_chaos_retries_spaced_by_exponential_backoff():
+    times = []
+    lock = threading.Lock()
+
+    def flaky(i, config=None):
+        with lock:
+            times.append(time.monotonic())
+            n = len(times)
+        if n <= 2:
+            raise OSError(f"transient {n}")
+        return i
+
+    before = get_registry().snapshot()
+    policy = RetryPolicy(
+        retries=3, backoff_base=0.15, backoff_multiplier=2.0, jitter="none"
+    )
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as pool:
+        map_unordered(pool, flaky, [0], retry_policy=policy)
+    assert len(times) == 3
+    # failure 1 -> wait >= 0.15s; failure 2 -> wait >= 0.30s
+    assert times[1] - times[0] >= 0.15 - 0.01, times
+    assert times[2] - times[1] >= 0.30 - 0.01, times
+    delta = get_registry().snapshot_delta(before)
+    bo = delta.get("retry_backoff_s") or {}
+    assert bo.get("count") == 2
+    assert abs(bo.get("sum", 0) - 0.45) < 1e-6
+
+
+# -- circuit breaker ------------------------------------------------------
+
+
+def test_chaos_retry_budget_bounds_systemic_outage():
+    """Every task failing transiently (a dead store) must abort after the
+    compute-wide budget, not after n_tasks * retries attempts."""
+    calls = {"n": 0}
+    lock = threading.Lock()
+
+    def always_down(i, config=None):
+        with lock:
+            calls["n"] += 1
+        raise OSError("store is down")
+
+    n_tasks, retries = 12, 5
+    policy = RetryPolicy(
+        retries=retries, backoff_base=0.005, budget_factor=0.1, budget_min=4
+    )
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+        with pytest.raises(RetryBudgetExceededError, match="retry budget"):
+            map_unordered(
+                pool, always_down, list(range(n_tasks)), retry_policy=policy
+            )
+    budget_limit = policy.new_budget(n_tasks).limit  # max(4, ceil(.1*12*5))=6
+    # first attempts + budgeted retries (+ small in-flight slack), far
+    # below the un-breakered n_tasks * (retries + 1) = 72
+    assert calls["n"] <= n_tasks + budget_limit + 4, calls["n"]
+
+
+# -- stragglers -----------------------------------------------------------
+
+
+def test_chaos_injected_stragglers_complete(tmp_path):
+    spec = _spec(
+        tmp_path, seed=1, straggler_rate=0.3, straggler_delay_s=0.15
+    )
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 16 tasks
+    cap = _StatsCapture()
+    result = xp.add(a, 5.0).compute(
+        executor=AsyncPythonDagExecutor(use_backups=True), callbacks=[cap]
+    )
+    np.testing.assert_array_equal(result, an + 5.0)
+    assert cap.stats.get("faults_injected_straggler", 0) >= 1, cap.stats
+
+
+# -- storage-layer read retries -------------------------------------------
+
+
+def test_chaos_transient_chunk_read_retried_at_storage_layer(tmp_path):
+    """A flaky chunk read is absorbed by the storage layer's own retry
+    (cheap, in place) instead of failing the whole task."""
+    from cubed_tpu.observability.accounting import task_scope
+    from cubed_tpu.storage.store import open_zarr_array
+
+    store = str(tmp_path / "arr")
+    arr = open_zarr_array(store, mode="a", shape=(4,), dtype=np.float64, chunks=(4,))
+    arr[:] = np.arange(4.0)
+
+    before = get_registry().snapshot()
+    # seed 9: the first read of key "arr/0" is injected to fail, its first
+    # in-place retry succeeds (verified deterministic — see faults.py)
+    with faults.scoped({"seed": 9, "storage_read_failure_rate": 0.9}):
+        with task_scope():
+            out = arr[:]
+    np.testing.assert_array_equal(out, np.arange(4.0))
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("storage_read_retries", 0) >= 1, delta
+    assert delta.get("faults_injected_storage_read", 0) >= 1, delta
+
+
+def test_chaos_write_faults_leave_tmp_litter_that_resume_ignores(tmp_path):
+    """Injected write failures litter partial .tmp files (a writer killed
+    mid-write); resume accounting must not count them as chunks."""
+    spec = _spec(
+        tmp_path, seed=3, storage_write_failure_rate=0.3,
+        storage_write_leaves_tmp=True,
+    )
+    an = np.arange(100, dtype=np.float64).reshape(10, 10)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 25 chunks
+    result = xp.add(a, 1.0).compute(
+        executor=AsyncPythonDagExecutor(
+            retry_policy=RetryPolicy(retries=6, backoff_base=0.01, seed=0)
+        )
+    )
+    np.testing.assert_array_equal(result, an + 1.0)
+    # chaos left litter somewhere under the work dir...
+    tmps = [
+        f for root, _, names in os.walk(str(tmp_path))
+        for f in names if f.endswith(".tmp")
+    ]
+    assert tmps, "expected injected write failures to leave .tmp litter"
+    # ...and every store still reports only clean chunks
+    from cubed_tpu.storage.store import open_zarr_array
+
+    for root, _, names in os.walk(str(tmp_path)):
+        if ".zarray" in names:
+            arr = open_zarr_array(root, mode="r")
+            assert arr.nchunks_initialized <= arr.nchunks

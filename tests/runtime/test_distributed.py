@@ -451,3 +451,43 @@ def test_distributed_blob_eviction_self_heals(spec, monkeypatch):
     assert r1 == r4 == an.sum()
     assert r2 == (an + 1.0).sum()
     assert np.isclose(r3, (an * 2.0).mean())
+
+
+def test_no_workers_error_is_actionable():
+    """Satellite: zero-worker submits and worker-wait timeouts carry real
+    diagnostics — address, counts seen, timeout used, and a how-to hint —
+    instead of bare errors."""
+    coord = Coordinator("127.0.0.1", 0)
+    try:
+        with pytest.raises(NoWorkersError) as ei:
+            coord.submit(None, lambda x: x, 0)
+        msg = str(ei.value)
+        host, port = coord.address
+        assert "no live workers" in msg
+        assert f"{host}:{port}" in msg
+        assert "cubed_tpu.runtime.worker" in msg  # the how-to hint
+        assert "no worker ever connected" in msg  # ever-joined count seen
+
+        with pytest.raises(TimeoutError) as ei2:
+            coord.wait_for_workers(2, timeout=0.2)
+        m2 = str(ei2.value)
+        assert "0 of 2" in m2  # workers seen vs wanted
+        assert "0.2" in m2  # the timeout used
+        assert "0 ever joined" in m2
+        assert "cubed_tpu.runtime.worker" in m2
+    finally:
+        coord.close()
+
+
+def test_compute_with_zero_workers_fails_fast(spec):
+    """min_workers=0 sails past the startup wait; the compute itself must
+    fail fast with a clear diagnostic rather than mid-plan."""
+    ex = DistributedDagExecutor(
+        listen="127.0.0.1:0", n_local_workers=0, min_workers=0,
+    )
+    try:
+        a = ct.from_array(np.ones((4, 4)), chunks=(2, 2), spec=spec)
+        with pytest.raises(NoWorkersError, match="zero live workers"):
+            xp.sum(a).compute(executor=ex)
+    finally:
+        ex.close()

@@ -1,0 +1,278 @@
+"""Unit tests for the resilience layer: classification, backoff, budget,
+and the deterministic fault injector (docs/reliability.md)."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from cubed_tpu.observability.accounting import task_scope
+from cubed_tpu.runtime import faults
+from cubed_tpu.runtime.distributed import (
+    RemoteTaskError,
+    TaskTimeoutError,
+    WorkerLostError,
+)
+from cubed_tpu.runtime.faults import (
+    FaultConfig,
+    FaultInjectedIOError,
+    FaultInjectedTaskError,
+    FaultInjector,
+)
+from cubed_tpu.runtime.resilience import (
+    Classification,
+    RetryBudget,
+    RetryPolicy,
+    resolve_policy,
+)
+
+
+# -- classification ------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "exc",
+    [
+        TypeError("bad arg"),
+        AssertionError("invariant"),
+        ValueError("deterministic"),
+        KeyError("missing"),
+        IndexError("oob"),
+        ZeroDivisionError(),
+        NotImplementedError(),
+        AttributeError("nope"),
+    ],
+)
+def test_programming_errors_fail_fast(exc):
+    assert RetryPolicy().classify(exc) is Classification.FAIL_FAST
+
+
+@pytest.mark.parametrize(
+    "exc",
+    [
+        OSError("io blip"),
+        ConnectionResetError(),
+        TimeoutError("slow"),
+        TaskTimeoutError("task 3 exceeded 8s"),
+        MemoryError(),  # load-dependent, not deterministic
+        RuntimeError("unknown user error"),  # unknown types default to retry
+        FaultInjectedIOError("injected"),
+        FaultInjectedTaskError("injected"),
+    ],
+)
+def test_transient_errors_retry(exc):
+    assert RetryPolicy().classify(exc) is Classification.RETRY
+
+
+def test_worker_loss_requeues():
+    assert RetryPolicy().classify(WorkerLostError("gone")) is Classification.REQUEUE
+
+
+def test_broken_pool_requeues_not_retries():
+    """Every in-flight future of a crashed process pool fails with the same
+    BrokenProcessPool; classifying it RETRY would drain the budget
+    max_workers times per crash before the pool-rebuild path even runs."""
+    from concurrent.futures.process import BrokenProcessPool
+
+    assert (
+        RetryPolicy().classify(BrokenProcessPool("pool died"))
+        is Classification.REQUEUE
+    )
+
+
+def test_fail_fast_covers_subclasses():
+    class MyValueError(ValueError):
+        pass
+
+    assert RetryPolicy().classify(MyValueError()) is Classification.FAIL_FAST
+
+
+def test_remote_error_classified_by_shipped_type_name():
+    policy = RetryPolicy()
+    assert (
+        policy.classify(RemoteTaskError("tb text", "TypeError"))
+        is Classification.FAIL_FAST
+    )
+    assert (
+        policy.classify(RemoteTaskError("tb text", "OSError"))
+        is Classification.RETRY
+    )
+    # no type shipped (old worker) -> conservative transient default
+    assert policy.classify(RemoteTaskError("tb text")) is Classification.RETRY
+    # a module missing on ONE fleet host is that host's environment, not a
+    # deterministic task bug: retry so another worker can pick it up
+    assert (
+        policy.classify(RemoteTaskError("tb", "ModuleNotFoundError"))
+        is Classification.RETRY
+    )
+    assert (
+        policy.classify(RemoteTaskError("tb", "ImportError"))
+        is Classification.RETRY
+    )
+
+
+# -- backoff -------------------------------------------------------------
+
+
+def test_backoff_grows_exponentially_and_caps():
+    p = RetryPolicy(
+        backoff_base=0.1, backoff_multiplier=2.0, backoff_max=1.0, jitter="none"
+    )
+    assert [p.backoff_delay(n) for n in (1, 2, 3, 4, 5)] == [
+        0.1, 0.2, 0.4, 0.8, 1.0,
+    ]
+
+
+def test_full_jitter_bounded_and_seeded():
+    p1 = RetryPolicy(backoff_base=0.1, jitter="full", seed=7)
+    p2 = RetryPolicy(backoff_base=0.1, jitter="full", seed=7)
+    d1 = [p1.backoff_delay(3) for _ in range(20)]
+    d2 = [p2.backoff_delay(3) for _ in range(20)]
+    assert d1 == d2  # same seed, same delays
+    assert all(0.0 <= d <= p1.backoff_ceiling(3) for d in d1)
+    assert len(set(d1)) > 1  # actually jittered
+
+
+def test_bad_jitter_rejected():
+    with pytest.raises(ValueError, match="jitter"):
+        RetryPolicy(jitter="decorrelated")
+
+
+# -- budget --------------------------------------------------------------
+
+
+def test_budget_sizing_and_exhaustion():
+    p = RetryPolicy(retries=2, budget_factor=0.5, budget_min=3)
+    b = p.new_budget(100)
+    assert b.limit == 100  # 0.5 * 100 * 2
+    b2 = p.new_budget(1)
+    assert b2.limit == 3  # floor
+    assert all(b2.consume() for _ in range(3))
+    assert not b2.consume()
+    assert b2.remaining == 0
+
+
+def test_budget_disabled():
+    b = RetryPolicy(budget_factor=None).new_budget(1000)
+    assert b.limit is None
+    assert all(b.consume() for _ in range(10_000))
+
+
+def test_resolve_policy_prefers_explicit_policy():
+    p = RetryPolicy(retries=7)
+    assert resolve_policy(p, 1) is p
+    assert resolve_policy(None, 4).retries == 4
+    assert resolve_policy(None, None).retries == 2
+
+
+# -- fault injector ------------------------------------------------------
+
+
+def test_injector_deterministic_and_seed_sensitive():
+    cfg = FaultConfig(seed=3, storage_write_failure_rate=0.3)
+    with task_scope():
+        a = [FaultInjector(cfg).storage_write_fault("k") for _ in range(1)]
+        rolls1 = _roll_series(FaultInjector(cfg))
+        rolls2 = _roll_series(FaultInjector(cfg))
+        rolls_other_seed = _roll_series(
+            FaultInjector(FaultConfig(seed=4, storage_write_failure_rate=0.3))
+        )
+    assert rolls1 == rolls2
+    assert rolls1 != rolls_other_seed
+    assert a is not None
+
+
+def _roll_series(inj, n=32):
+    return [inj.storage_write_fault(f"chunk-{i}") for i in range(n)]
+
+
+def test_injector_retry_rolls_fresh_decision():
+    """The nth occurrence of the same (site, key) is part of the hash, so
+    an injected fault is transient by construction: some key that fails on
+    its first attempt passes on a later one."""
+    cfg = FaultConfig(seed=0, storage_write_failure_rate=0.5)
+    inj = FaultInjector(cfg)
+    with task_scope():
+        first = {k: inj.storage_write_fault(k) for k in map(str, range(64))}
+        failed = [k for k, hit in first.items() if hit]
+        assert failed  # at 50% some first attempts fail
+        # every failed key eventually passes within a few fresh rolls
+        for k in failed:
+            assert any(
+                not inj.storage_write_fault(k) for _ in range(8)
+            ), f"key {k} never recovered"
+
+
+def test_injector_inactive_outside_task_scope():
+    inj = FaultInjector(FaultConfig(seed=0, storage_write_failure_rate=1.0))
+    assert not inj.storage_write_fault("k")  # no scope, no injection
+    with task_scope():
+        assert inj.storage_write_fault("k")
+
+
+def test_env_activation_round_trip(monkeypatch):
+    cfg = FaultConfig(seed=9, task_failure_rate=0.25, worker_crash_names=("w0",))
+    monkeypatch.setenv(faults.FAULTS_ENV_VAR, cfg.to_env_json())
+    inj = faults.get_injector()
+    assert inj is not None
+    assert inj.config == cfg
+    monkeypatch.delenv(faults.FAULTS_ENV_VAR)
+    assert faults.get_injector() is None
+
+
+def test_env_all_rates_zero_is_inactive(monkeypatch):
+    monkeypatch.setenv(faults.FAULTS_ENV_VAR, FaultConfig(seed=1).to_env_json())
+    assert faults.get_injector() is None
+
+
+def test_unknown_config_field_rejected():
+    with pytest.raises(ValueError, match="unknown FaultConfig fields"):
+        FaultConfig.from_dict({"storge_write_failure_rate": 0.1})
+
+
+def test_scoped_activation_restores(monkeypatch):
+    monkeypatch.delenv(faults.FAULTS_ENV_VAR, raising=False)
+    assert faults.get_injector() is None
+    with faults.scoped({"seed": 1, "task_failure_rate": 0.5}, export_env=True):
+        assert faults.get_injector() is not None
+        assert os.environ.get(faults.FAULTS_ENV_VAR)
+    assert faults.get_injector() is None
+    assert faults.FAULTS_ENV_VAR not in os.environ
+
+
+def test_scoped_none_is_noop():
+    with faults.scoped(None) as inj:
+        assert inj is None
+
+
+def test_wire_config_round_trip(monkeypatch):
+    """Fleet workers mirror the client's arming state carried per task:
+    arm -> config rides the wire; disarm -> None disarms the worker side
+    even when stale spawn-time env is still present there."""
+    monkeypatch.delenv(faults.FAULTS_ENV_VAR, raising=False)
+    assert faults.wire_config() is None
+    cfg = FaultConfig(seed=5, task_failure_rate=0.5)
+    with faults.scoped(cfg):
+        raw = faults.wire_config()
+        assert raw is not None
+    # "worker side": stale env from spawn time...
+    monkeypatch.setenv(faults.FAULTS_ENV_VAR, cfg.to_env_json())
+    inj = faults.arm_from_wire(raw)
+    assert inj is not None and inj.config == cfg
+    assert faults.get_injector() is inj
+    # ...then a task from a disarmed client: None wins over the stale env
+    assert faults.arm_from_wire(None) is None
+    assert faults._active is None
+    faults.deactivate()
+
+
+def test_worker_tick_one_shot():
+    cfg = FaultConfig(
+        seed=0, worker_crash_names=("local-0",), worker_crash_after_tasks=3
+    )
+    inj = FaultInjector(cfg)
+    assert [inj.worker_task_tick("local-0") for _ in range(5)] == [
+        None, None, "crash", None, None,
+    ]
+    assert all(inj.worker_task_tick("local-1") is None for _ in range(5))

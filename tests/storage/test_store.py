@@ -381,3 +381,94 @@ def test_fsspec_memory_workdir_end_to_end():
     a = ct.from_array(an, chunks=(3, 3), spec=spec_)
     got = float(xp.sum(xp.multiply(a, 3.0)).compute())
     assert got == 3 * an.sum()
+
+
+# -- orphaned .tmp hygiene (crashed mid-write writers) --------------------
+
+
+def _litter_tmp(store: str, name: str, age_s: float = 120.0) -> str:
+    """Plant a stale partial temp file as a crashed writer would leave it."""
+    import time
+
+    path = os.path.join(store, name)
+    with open(path, "wb") as f:
+        f.write(b"\x00" * 7)  # partial payload: not a valid chunk
+    old = time.time() - age_s
+    os.utime(path, (old, old))
+    return path
+
+
+def test_orphaned_tmp_ignored_by_resume_counters(tmp_path):
+    """Regression: a crashed write's leftover .tmp next to chunks must not
+    count as an initialized chunk (it would fool resume into skipping an
+    op whose output is incomplete)."""
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(store, "w", shape=(4, 4), dtype=np.float64, chunks=(2, 2))
+    z[:2, :2] = np.ones((2, 2))  # 1 real chunk of 4
+    _litter_tmp(store, "1.1.deadbeef.tmp")
+    z2 = open_zarr_array(store, "r")
+    assert z2.nchunks_initialized == 1
+    # and reading the chunk the orphan shadows returns fill, not garbage
+    np.testing.assert_array_equal(z2[2:, 2:], np.zeros((2, 2)))
+
+
+def test_orphaned_tmp_swept_on_writer_open(tmp_path):
+    """Opening in a writer mode (what the create-arrays op and resume do)
+    sweeps stale orphans; fresh temp files — possibly a live writer mid
+    os.replace — are left alone."""
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(store, "w", shape=(4, 4), dtype=np.float64, chunks=(2, 2))
+    z[...] = np.arange(16.0).reshape(4, 4)
+    stale = _litter_tmp(store, "0.0.cafe0000.tmp", age_s=120.0)
+    fresh = _litter_tmp(store, "0.1.cafe0001.tmp", age_s=0.0)
+    os.utime(fresh)  # make it genuinely fresh
+    z2 = open_zarr_array(store, "a")  # resume-style reopen
+    assert not os.path.exists(stale), "stale orphan should be swept"
+    assert os.path.exists(fresh), "a live writer's temp must survive"
+    np.testing.assert_array_equal(z2[...], np.arange(16.0).reshape(4, 4))
+
+
+def test_orphaned_tmp_not_swept_on_read_open(tmp_path):
+    """Read opens (every task opening an input) skip the sweep — hygiene
+    belongs to the op-start writer open, not the hot read path."""
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(store, "w", shape=(2,), dtype=np.float64, chunks=(2,))
+    z[...] = np.arange(2.0)
+    stale = _litter_tmp(store, "0.feed0000.tmp", age_s=120.0)
+    open_zarr_array(store, "r")
+    assert os.path.exists(stale)
+
+
+def test_sweep_counts_metric(tmp_path):
+    from cubed_tpu.observability.metrics import get_registry
+    from cubed_tpu.storage.store import _LocalIO
+
+    store = str(tmp_path / "a.zarr")
+    os.makedirs(store)
+    _litter_tmp(store, "0.0.aa.tmp")
+    _litter_tmp(store, "0.1.bb.tmp")
+    before = get_registry().snapshot()
+    removed = _LocalIO(store).sweep_tmp()
+    assert removed == 2
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("orphan_tmps_swept", 0) == 2
+
+
+def test_vanished_chunk_read_fails_loudly_not_fill(tmp_path, monkeypatch):
+    """A FileNotFoundError AFTER a successful exists() is an anomaly
+    (chunks are write-once); it must raise — not silently read as an
+    absent chunk and substitute fill values for real data."""
+    from cubed_tpu.storage.store import _LocalIO
+
+    store = str(tmp_path / "a.zarr")
+    z = open_zarr_array(store, "w", shape=(2,), dtype=np.float64, chunks=(2,))
+    z[...] = np.arange(2.0)
+
+    monkeypatch.setenv("CUBED_TPU_STORAGE_READ_RETRIES", "1")
+
+    def gone(self, name):
+        raise FileNotFoundError(name)
+
+    monkeypatch.setattr(_LocalIO, "read_bytes", gone)
+    with pytest.raises(FileNotFoundError):
+        z[...]
