@@ -556,6 +556,13 @@ def main() -> None:
                 # is visible from BENCH_METRICS.json history alone
                 "task_retries": stats.get("task_retries", 0),
                 "faults_injected": stats.get("faults_injected", 0),
+                # integrity trajectory: verification volume, detected
+                # corruption, and resume's chunk-granular skips
+                "chunks_verified": stats.get("chunks_verified", 0),
+                "chunks_corrupt_detected": stats.get(
+                    "chunks_corrupt_detected", 0
+                ),
+                "tasks_skipped_resume": stats.get("tasks_skipped_resume", 0),
                 "executor_stats": stats or None,
             }
 

@@ -21,7 +21,12 @@ from typing import Any, Callable, Optional, Sequence
 import networkx as nx
 
 from ..primitive.types import CubedPipeline, PrimitiveOperation
-from ..runtime.pipeline import already_computed, iter_op_nodes
+from ..runtime.pipeline import (
+    ResumeState,
+    already_computed,
+    iter_op_nodes,
+    pending_mappable,
+)
 from ..runtime.types import (
     ComputeEndEvent,
     ComputeStartEvent,
@@ -244,9 +249,15 @@ class Plan:
             # process-global while active — same caveat as the metrics
             # registry below: concurrent computes in one process share it
             from ..runtime import faults
+            from ..storage import integrity
 
             with faults.scoped(
                 getattr(spec, "fault_injection", None), export_env=True
+            ), integrity.scoped(
+                # Spec-level integrity mode, armed (and exported to the env,
+                # so spawned pool/fleet workers inherit it) for this
+                # compute's duration; None defers to env/default
+                getattr(spec, "integrity", None), export_env=True
             ):
                 executor.execute_dag(
                     dag,
@@ -340,12 +351,25 @@ class FinalizedPlan:
         self.dag = dag
 
     def num_tasks(self, resume=None) -> int:
+        """Task count, chunk-granular under ``resume``: a partially-complete
+        blockwise op contributes only its still-pending tasks — the same
+        per-task skip the executors apply, so this number matches what a
+        resumed compute actually runs. The scan is read-only (no
+        quarantining, no metrics)."""
         nodes = dict(self.dag.nodes(data=True))
+        state = ResumeState(count=False) if resume else None
         total = 0
         for name in nx.topological_sort(self.dag):
-            if already_computed(name, self.dag, nodes, resume):
+            if already_computed(name, self.dag, nodes, resume, state):
                 continue
-            total += nodes[name]["primitive_op"].num_tasks
+            node = nodes[name]
+            if resume:
+                _, skipped = pending_mappable(
+                    name, node, resume, state, record=False
+                )
+                total += node["primitive_op"].num_tasks - skipped
+            else:
+                total += node["primitive_op"].num_tasks
         return total
 
     def num_arrays(self) -> int:
@@ -355,11 +379,15 @@ class FinalizedPlan:
         return sum(1 for _ in iter_op_nodes(self.dag))
 
     def max_projected_mem(self, resume=None) -> int:
+        """Peak projected memory over the ops a compute would actually run;
+        under ``resume`` an op skipped (all outputs checksum-valid) drops
+        out, exactly mirroring the executors' skip decision."""
         nodes = dict(self.dag.nodes(data=True))
+        state = ResumeState(count=False) if resume else None
         mems = [
             nodes[name]["primitive_op"].projected_mem
             for name in nx.topological_sort(self.dag)
-            if not already_computed(name, self.dag, nodes, resume)
+            if not already_computed(name, self.dag, nodes, resume, state)
         ]
         return max(mems) if mems else 0
 

@@ -38,7 +38,7 @@ _store_totals: Dict[str, list] = {}
 
 
 class TaskScope:
-    """Accumulates IO attributed to one task body."""
+    """Accumulates IO (and named event counts) attributed to one task body."""
 
     __slots__ = (
         "bytes_read",
@@ -46,6 +46,7 @@ class TaskScope:
         "chunks_read",
         "chunks_written",
         "virtual_bytes_read",
+        "counters",
     )
 
     def __init__(self):
@@ -54,6 +55,10 @@ class TaskScope:
         self.chunks_read = 0
         self.chunks_written = 0
         self.virtual_bytes_read = 0
+        #: named counts (integrity verifications/corruption/quarantines)
+        #: recorded inside this scope — riding the stats dict across process
+        #: boundaries exactly like the byte counters
+        self.counters: Dict[str, int] = {}
 
     def stats(self) -> dict:
         return {
@@ -62,6 +67,7 @@ class TaskScope:
             "chunks_read": self.chunks_read,
             "chunks_written": self.chunks_written,
             "virtual_bytes_read": self.virtual_bytes_read,
+            "counters": dict(self.counters),
         }
 
 
@@ -128,6 +134,21 @@ def record_bytes_written(store: str, n: int) -> None:
         reg.counter("bytes_written").inc(n)
         reg.counter("chunks_written").inc()
     _track_store(store, 0, n)
+
+
+def record_scoped_counter(name: str, n: int = 1) -> None:
+    """Count a named event with per-task attribution.
+
+    Inside a task scope the count rides the task's stats dict back to the
+    client (surviving process/fleet boundaries) and the compute aggregator
+    folds it into the client registry; outside any scope it goes straight
+    to the process registry. Used by the integrity layer so worker-side
+    verification/corruption/quarantine counts reach compute stats."""
+    scope = current_scope()
+    if scope is not None:
+        scope.counters[name] = scope.counters.get(name, 0) + n
+    else:
+        get_registry().counter(name).inc(n)
 
 
 def record_virtual_read(n: int) -> None:

@@ -186,6 +186,12 @@ class _ComputeAggregator(EventLogCallback):
             reg.counter("chunks_written").inc(event.chunks_written)
         if event.virtual_bytes_read:
             reg.counter("virtual_bytes_read").inc(event.virtual_bytes_read)
+        if event.counters:
+            # named scope counts (integrity verifications, corruption,
+            # quarantines) measured where the task ran
+            for cname, n in event.counters.items():
+                if n:
+                    reg.counter(cname).inc(n)
         if event.peak_measured_mem_end is not None:
             self._peaks[name] = max(
                 self._peaks.get(name, 0), event.peak_measured_mem_end

@@ -19,6 +19,12 @@ The canonical metric names used across the codebase:
 - ``faults_injected`` (+ ``faults_injected_<site>``) /
   ``orphan_tmps_swept`` — chaos-testing fault injection
   (``runtime/faults.py``) and crash-litter hygiene
+- ``chunks_verified`` / ``chunks_corrupt_detected`` /
+  ``chunks_quarantined`` / ``chunks_recomputed`` /
+  ``tasks_skipped_resume`` / ``zarray_meta_recreated`` — the chunk
+  integrity layer (``storage/integrity.py``): checksum verifications,
+  detected corruption, quarantined files, upstream-task recomputes, and
+  the tasks a chunk-granular resume proved already done
 - ``bytes_read`` / ``bytes_written`` / ``chunks_read`` / ``chunks_written``
   — Zarr store IO (see ``accounting.py``)
 - ``virtual_bytes_read`` — reads served by virtual (never-materialized) arrays

@@ -74,11 +74,20 @@ class TaskTimeoutError(RuntimeError):
 class RemoteTaskError(RuntimeError):
     """A task raised on a worker; carries the remote traceback text plus the
     root exception's class name (``remote_type``) so the retry policy can
-    classify remote programming errors as fail-fast without a shared type."""
+    classify remote programming errors as fail-fast without a shared type.
+    ``remote_payload`` is the root exception's structured wire payload when
+    it has one (``ChunkIntegrityError.wire_payload``: the corrupt chunk's
+    store + key, what the client-side RECOMPUTE repair needs)."""
 
-    def __init__(self, message: str = "", remote_type: Optional[str] = None):
+    def __init__(
+        self,
+        message: str = "",
+        remote_type: Optional[str] = None,
+        remote_payload: Optional[dict] = None,
+    ):
         super().__init__(message)
         self.remote_type = remote_type
+        self.remote_payload = remote_payload
 
 
 class NoWorkersError(RuntimeError):
@@ -335,7 +344,9 @@ class Coordinator:
                         _fail_future(
                             fut,
                             RemoteTaskError(
-                                msg.get("error", ""), msg.get("error_type")
+                                msg.get("error", ""),
+                                msg.get("error_type"),
+                                msg.get("error_payload"),
                             ),
                         )
                 elif mtype == "started":
@@ -487,6 +498,7 @@ class Coordinator:
                     conn.deadlines[task_id] = [
                         time.monotonic() + self.task_timeout, False
                     ]
+            from ..storage import integrity
             from .faults import wire_config
 
             msg = {
@@ -502,6 +514,10 @@ class Coordinator:
                 # inject; disarming propagates instead of lingering in
                 # spawn-time env), see faults.wire_config
                 "faults": wire_config(),
+                # the client's integrity mode rides the same way, so a
+                # pre-started fleet verifies (or not) exactly as the client
+                # asked for THIS compute
+                "integrity": integrity.wire_mode(),
             }
             try:
                 send_frame(conn.sock, msg, conn.send_lock)
@@ -581,6 +597,7 @@ def run_worker(
     import cloudpickle
     from concurrent.futures import ThreadPoolExecutor
 
+    from ..storage import integrity
     from .faults import arm_from_wire, get_injector
     from .utils import execute_with_stats
 
@@ -628,6 +645,8 @@ def run_worker(
                 injector = arm_from_wire(msg.get("faults"))
             else:
                 injector = get_injector()
+            if "integrity" in msg:
+                integrity.arm_from_wire(msg.get("integrity"))
             if injector is not None:
                 action = injector.worker_task_tick(wname)
                 if action == "crash":
@@ -742,7 +761,10 @@ def run_worker(
                      "error": traceback.format_exc(),
                      # root class name rides along so the coordinator-side
                      # retry policy can classify remote programming errors
-                     "error_type": type(e).__name__},
+                     "error_type": type(e).__name__,
+                     # structured payload (ChunkIntegrityError: the corrupt
+                     # chunk's store/key) for coordinator-side repair
+                     "error_payload": getattr(e, "wire_payload", None)},
                     send_lock,
                 )
             except (ConnectionError, OSError):

@@ -31,7 +31,13 @@ import sys
 from typing import Optional
 
 from ..distributed import Coordinator, NoWorkersError
-from ..pipeline import visit_node_generations, visit_nodes
+from ..pipeline import (
+    RecomputeResolver,
+    ResumeState,
+    pending_mappable,
+    visit_node_generations,
+    visit_nodes,
+)
 from ..resilience import DEFAULT_RETRIES, RetryPolicy, resolve_policy
 from ..types import (
     DagExecutor,
@@ -240,9 +246,18 @@ class DistributedDagExecutor(DagExecutor):
                 "so the fleet is populated before computing"
             )
 
+        state = ResumeState(quarantine=True) if resume else None
+        # integrity failures cross the wire as RemoteTaskError carrying the
+        # corrupt chunk's (store, key); the repair task runs client-side
+        # against the shared store the whole fleet reads
+        resolver = RecomputeResolver(dag)
         if compute_arrays_in_parallel:
-            for generation in visit_node_generations(dag, resume=resume):
-                merged, pipelines = merge_generation(generation, callbacks)
+            for generation in visit_node_generations(
+                dag, resume=resume, state=state
+            ):
+                merged, pipelines = merge_generation(
+                    generation, callbacks, resume=resume, resume_state=state
+                )
                 if not merged:
                     end_generation(generation, callbacks)
                     continue
@@ -257,20 +272,22 @@ class DistributedDagExecutor(DagExecutor):
                     callbacks=callbacks,
                     array_names=[name for name, _ in merged],
                     executor_name=self.name,
+                    recompute_resolver=resolver,
                 )
                 end_generation(generation, callbacks)
         else:
-            for name, node in visit_nodes(dag, resume=resume):
+            for name, node in visit_nodes(dag, resume=resume, state=state):
                 primitive_op = node["primitive_op"]
                 pipeline = primitive_op.pipeline
                 callbacks_on(
                     callbacks, "on_operation_start",
                     OperationStartEvent(name, primitive_op.num_tasks),
                 )
+                mappable, _ = pending_mappable(name, node, resume, state)
                 map_unordered(
                     _OpPool(coord, pipeline),
                     pipeline.function,
-                    pipeline.mappable,
+                    mappable,
                     retry_policy=policy,
                     retry_budget=budget,
                     use_backups=use_backups,
@@ -278,6 +295,7 @@ class DistributedDagExecutor(DagExecutor):
                     callbacks=callbacks,
                     array_name=name,
                     executor_name=self.name,
+                    recompute_resolver=resolver,
                     config=pipeline.config,
                 )
                 callbacks_on(

@@ -55,7 +55,7 @@ from ...storage.virtual import (
 )
 from ...storage.zarr import LazyZarrArray
 from ...utils import get_item
-from ..pipeline import visit_nodes
+from ..pipeline import ResumeState, visit_nodes
 from ..types import (
     Callback,
     DagExecutor,
@@ -427,7 +427,12 @@ class JaxExecutor(DagExecutor):
                 OperationEndEvent(name, primitive_op.num_tasks),
             )
 
-        for name, node in visit_nodes(dag, resume=resume):
+        # resume is op-granular here (segments run as whole-array device
+        # programs, so per-task skip doesn't apply), but the skip decision
+        # is still checksum-verified: a corrupt persisted output re-runs
+        # (and is quarantined by the scan) instead of being trusted
+        resume_state = ResumeState(quarantine=True) if resume else None
+        for name, node in visit_nodes(dag, resume=resume, state=resume_state):
             primitive_op = node["primitive_op"]
             kind = self._classify(primitive_op) if self.fuse_plan else "eager"
             if kind == "trace":

@@ -30,7 +30,13 @@ import os
 from typing import Optional
 
 from ...observability.metrics import get_registry
-from ..pipeline import visit_node_generations, visit_nodes
+from ..pipeline import (
+    RecomputeResolver,
+    ResumeState,
+    pending_mappable,
+    visit_node_generations,
+    visit_nodes,
+)
 from ..resilience import (
     DEFAULT_RETRIES,
     RetryPolicy,
@@ -153,6 +159,11 @@ class MultiprocessDagExecutor(DagExecutor):
             compute_arrays_in_parallel = self.compute_arrays_in_parallel
         policy = resolve_policy(retry_policy or self.retry_policy, retries)
         budget = compute_retry_budget(policy, dag)
+        state = ResumeState(quarantine=True) if resume else None
+        # integrity failures detected worker-side arrive pickled; the repair
+        # (re-running the producing task) runs client-side against the
+        # shared store, which is valid for any executor
+        resolver = RecomputeResolver(dag)
 
         # spawn (not fork): workers must not inherit live device handles or
         # jax state — same as a cloud worker booting from a clean image
@@ -166,8 +177,12 @@ class MultiprocessDagExecutor(DagExecutor):
         )
         try:
             if compute_arrays_in_parallel:
-                for generation in visit_node_generations(dag, resume=resume):
-                    merged, pipelines = merge_generation(generation, callbacks)
+                for generation in visit_node_generations(
+                    dag, resume=resume, state=state
+                ):
+                    merged, pipelines = merge_generation(
+                        generation, callbacks, resume=resume, resume_state=state
+                    )
                     runners = {
                         name: _ProcessTaskRunner(p.function, p.config)
                         for name, p in pipelines.items()
@@ -186,21 +201,23 @@ class MultiprocessDagExecutor(DagExecutor):
                         callbacks=callbacks,
                         array_names=[m[0] for m in merged],
                         executor_name=self.name,
+                        recompute_resolver=resolver,
                     )
                     end_generation(generation, callbacks)
             else:
-                for name, node in visit_nodes(dag, resume=resume):
+                for name, node in visit_nodes(dag, resume=resume, state=state):
                     primitive_op = node["primitive_op"]
                     pipeline = primitive_op.pipeline
                     callbacks_on(
                         callbacks, "on_operation_start",
                         OperationStartEvent(name, primitive_op.num_tasks),
                     )
+                    mappable, _ = pending_mappable(name, node, resume, state)
                     pool = self._map_surviving_pool_crash(
                         pool,
                         ctx,
                         _ProcessTaskRunner(pipeline.function, pipeline.config),
-                        list(pipeline.mappable),
+                        list(mappable),
                         policy=policy,
                         budget=budget,
                         use_backups=use_backups,
@@ -208,6 +225,7 @@ class MultiprocessDagExecutor(DagExecutor):
                         callbacks=callbacks,
                         array_name=name,
                         executor_name=self.name,
+                        recompute_resolver=resolver,
                     )
                     callbacks_on(
                         callbacks, "on_operation_end",

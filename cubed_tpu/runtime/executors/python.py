@@ -13,12 +13,18 @@ import time
 from typing import Optional
 
 from ...observability.metrics import get_registry
-from ..pipeline import visit_nodes
+from ..pipeline import (
+    RecomputeResolver,
+    ResumeState,
+    pending_mappable,
+    visit_nodes,
+)
 from ..resilience import (
     Classification,
     RetryPolicy,
     budget_exhausted_error,
     compute_retry_budget,
+    integrity_payload,
     resolve_policy,
 )
 from ..types import (
@@ -63,14 +69,17 @@ class PythonDagExecutor(DagExecutor):
         policy = resolve_policy(retry_policy or self.retry_policy, retries)
         budget = compute_retry_budget(policy, dag)
         metrics = get_registry()
-        for name, node in visit_nodes(dag, resume=resume):
+        state = ResumeState(quarantine=True) if resume else None
+        resolver = RecomputeResolver(dag)
+        for name, node in visit_nodes(dag, resume=resume, state=state):
             primitive_op = node["primitive_op"]
             pipeline = primitive_op.pipeline
             callbacks_on(
                 callbacks, "on_operation_start",
                 OperationStartEvent(name, primitive_op.num_tasks),
             )
-            for m in pipeline.mappable:
+            mappable, _ = pending_mappable(name, node, resume, state)
+            for m in mappable:
                 created = time.time()
                 key = chunk_key(m)
                 failures = 0
@@ -85,6 +94,10 @@ class PythonDagExecutor(DagExecutor):
                         break
                     except Exception as exc:
                         cls = policy.classify(exc)
+                        if cls is Classification.RECOMPUTE:
+                            from .python_async import _count_integrity_failure
+
+                            _count_integrity_failure(metrics, exc)
                         failures += 1
                         # REQUEUE cannot arise in-process; treat it as RETRY
                         if cls is Classification.FAIL_FAST:
@@ -94,6 +107,21 @@ class PythonDagExecutor(DagExecutor):
                             raise
                         if not budget.consume():
                             raise budget_exhausted_error(exc, budget) from exc
+                        if cls is Classification.RECOMPUTE:
+                            # a corrupt (quarantined) input chunk: re-run
+                            # its producing task, then retry this one with
+                            # no extra backoff
+                            repair = resolver.resolve(integrity_payload(exc))
+                            if repair is not None:
+                                try:
+                                    repair()
+                                    continue
+                                except Exception:
+                                    logger.exception(
+                                        "upstream recompute for task %s "
+                                        "failed; falling back to a backoff "
+                                        "retry", key,
+                                    )
                         delay = policy.backoff_delay(failures)
                         logger.info(
                             "retrying task %s (attempt %d) in %.3fs",

@@ -24,7 +24,13 @@ from typing import Callable, Dict, Iterable, Optional
 
 from ...observability.metrics import get_registry
 from ..backup import should_launch_backup
-from ..pipeline import visit_node_generations, visit_nodes
+from ..pipeline import (
+    RecomputeResolver,
+    ResumeState,
+    pending_mappable,
+    visit_node_generations,
+    visit_nodes,
+)
 from ..resilience import (
     DEFAULT_RETRIES,
     Classification,
@@ -32,6 +38,7 @@ from ..resilience import (
     RetryPolicy,
     budget_exhausted_error,
     compute_retry_budget,  # noqa: F401  (re-export for the other executors)
+    integrity_payload,
     resolve_policy,
 )
 from ..types import (
@@ -52,6 +59,21 @@ from ..utils import (
 logger = logging.getLogger(__name__)
 
 
+def _count_integrity_failure(metrics, exc) -> None:
+    """Count a surfaced chunk-integrity failure client-side.
+
+    The detecting task's scope (where the raising site recorded its counts)
+    is discarded when the task fails, so detection/quarantine are counted
+    here — once per failure reaching the completion loop, for every
+    executor (local raise, pickled from a pool worker, or a RemoteTaskError
+    off the fleet wire). A ``checksum``-kind failure quarantined its file;
+    a ``missing``-kind one found it already gone."""
+    metrics.counter("chunks_corrupt_detected").inc()
+    payload = integrity_payload(exc)
+    if payload and payload.get("kind") == "checksum":
+        metrics.counter("chunks_quarantined").inc()
+
+
 def map_unordered(
     executor: concurrent.futures.Executor,
     function: Callable,
@@ -65,6 +87,7 @@ def map_unordered(
     executor_name: Optional[str] = None,
     retry_policy: Optional[RetryPolicy] = None,
     retry_budget: Optional[RetryBudget] = None,
+    recompute_resolver=None,
     **kwargs,
 ) -> None:
     """Run function over inputs, handling completion order, retries, backups.
@@ -81,6 +104,13 @@ def map_unordered(
     policy overrides). ``retry_budget`` shares one circuit-breaker allowance
     across several maps (a whole compute); when absent each batch gets its
     own, sized to its task count.
+
+    ``recompute_resolver`` (a ``pipeline.RecomputeResolver``) handles
+    RECOMPUTE-classified failures — a task that read a corrupt (now
+    quarantined) input chunk: the resolver's thunk re-runs the producing
+    op's task for exactly that chunk, then the reader resubmits. Each
+    repair consumes one retry and one budget unit, so corruption storms
+    abort promptly instead of looping.
     """
     policy = resolve_policy(retry_policy, retries)
     if array_names is not None:
@@ -90,6 +120,7 @@ def map_unordered(
         _map_unordered_batch(
             executor, function, list(inputs), policy, retry_budget,
             use_backups, callbacks, array_name, array_names, executor_name,
+            recompute_resolver,
             **kwargs,
         )
     elif array_names is None:
@@ -101,6 +132,7 @@ def map_unordered(
             _map_unordered_batch(
                 executor, function, batch, policy, retry_budget,
                 use_backups, callbacks, array_name, None, executor_name,
+                recompute_resolver,
                 **kwargs,
             )
     else:
@@ -116,6 +148,7 @@ def map_unordered(
                 array_name,
                 array_names[start : start + batch_size],
                 executor_name,
+                recompute_resolver,
                 **kwargs,
             )
 
@@ -131,6 +164,7 @@ def _map_unordered_batch(
     array_name,
     array_names: Optional[list] = None,
     executor_name: Optional[str] = None,
+    recompute_resolver=None,
     **kwargs,
 ) -> None:
     metrics = get_registry()
@@ -149,6 +183,11 @@ def _map_unordered_batch(
     pending: Dict[concurrent.futures.Future, tuple[int, bool, int]] = {}
     backups: Dict[int, list[concurrent.futures.Future]] = {}
     done_inputs: set[int] = set()
+    #: input index -> in-flight upstream repair (RECOMPUTE): repairs run on
+    #: a small side pool so a full producing-task re-run never stalls the
+    #: completion loop (the same never-block rule backoff retries follow)
+    repairing: Dict[int, concurrent.futures.Future] = {}
+    repair_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
 
     key_cache: Dict[int, str] = {}
 
@@ -199,22 +238,46 @@ def _map_unordered_batch(
         submit(i)
 
     try:
-        while pending or delayed:
+        while pending or delayed or repairing:
             now = time.time()
             # launch retries whose backoff has elapsed
             while delayed and delayed[0][0] <= now:
                 _, i = heapq.heappop(delayed)
                 if i not in done_inputs:
                     resubmit(i)
+            # resubmit readers whose upstream repair finished; a failed
+            # repair falls back to a backoff retry (next attempt re-triggers
+            # the repair — bounded, since each drew retries/budget already)
+            for ri, rfut in [(k, f) for k, f in repairing.items() if f.done()]:
+                del repairing[ri]
+                if ri in done_inputs:
+                    continue
+                rexc = rfut.exception()
+                if rexc is None:
+                    resubmit(ri)
+                else:
+                    rdelay = policy.backoff_delay(attempts[ri])
+                    logger.warning(
+                        "upstream recompute for input %s failed (%r); "
+                        "retrying the reader in %.3fs", ri, rexc, rdelay,
+                    )
+                    heapq.heappush(delayed, (now + rdelay, ri))
             metrics.gauge("queue_depth").set(len(pending))
             if not pending:
-                # nothing in flight: sleep until the next retry is due
+                # nothing in flight: sleep until the next retry is due or
+                # an in-flight repair completes
                 if delayed:
                     time.sleep(max(0.0, min(delayed[0][0] - time.time(), 0.25)))
+                elif repairing:
+                    concurrent.futures.wait(
+                        list(repairing.values()), timeout=0.25
+                    )
                 continue
             timeout = 2.0
             if delayed:
                 timeout = max(0.01, min(timeout, delayed[0][0] - now))
+            if repairing:
+                timeout = min(timeout, 0.05)  # notice repair completions fast
             done, _ = concurrent.futures.wait(
                 list(pending), timeout=timeout,
                 return_when=concurrent.futures.FIRST_COMPLETED,
@@ -254,6 +317,10 @@ def _map_unordered_batch(
                     # suppress if a backup twin is still running
                     if twins:
                         continue
+                    if cls is Classification.RECOMPUTE:
+                        # counted after twin suppression, so a backup pair
+                        # failing on one corrupt chunk reports one defect
+                        _count_integrity_failure(metrics, exc)
                     if cls is Classification.FAIL_FAST:
                         # deterministic programming error: retrying cannot
                         # change the outcome — one attempt, no backoff
@@ -266,6 +333,31 @@ def _map_unordered_batch(
                     if not budget.consume():
                         cancel_pending()
                         raise budget_exhausted_error(exc, budget) from exc
+                    if cls is Classification.RECOMPUTE:
+                        repair = (
+                            recompute_resolver.resolve(integrity_payload(exc))
+                            if recompute_resolver is not None
+                            else None
+                        )
+                        if repair is not None:
+                            # re-run the producing task for the corrupt
+                            # chunk on the side pool; the reader resubmits
+                            # when the repair lands (no extra backoff — the
+                            # repair itself costs the wall clock one would)
+                            if repair_pool is None:
+                                repair_pool = (
+                                    concurrent.futures.ThreadPoolExecutor(
+                                        max_workers=2,
+                                        thread_name_prefix="chunk-repair",
+                                    )
+                                )
+                            repairing[i] = repair_pool.submit(repair)
+                            continue
+                        logger.warning(
+                            "corrupt chunk with no recompute path "
+                            "(input %s): retrying blind — will fail "
+                            "loudly if the corruption cannot heal", i,
+                        )
                     delay = policy.backoff_delay(attempts[i])
                     logger.info(
                         "retrying input %s (attempt %d) in %.3fs",
@@ -308,6 +400,8 @@ def _map_unordered_batch(
         # reset even when retries are exhausted mid-loop: a stale nonzero
         # queue_depth would read as phantom in-flight tasks forever after
         metrics.gauge("queue_depth").set(0)
+        if repair_pool is not None:
+            repair_pool.shutdown(wait=False, cancel_futures=True)
 
 
 class AsyncPythonDagExecutor(DagExecutor):
@@ -357,31 +451,41 @@ class AsyncPythonDagExecutor(DagExecutor):
             compute_arrays_in_parallel = self.compute_arrays_in_parallel
         policy = resolve_policy(retry_policy or self.retry_policy, retries)
         budget = compute_retry_budget(policy, dag)
+        # chunk-granular resume: one checksum-verified scan per store, shared
+        # by the op-level and task-level skips; corrupt chunks found by the
+        # scan are quarantined so their tasks re-run
+        state = ResumeState(quarantine=True) if resume else None
+        resolver = RecomputeResolver(dag)
 
         with concurrent.futures.ThreadPoolExecutor(
             max_workers=self.max_workers
         ) as pool:
             if compute_arrays_in_parallel:
                 # ops in the same topological generation interleave their tasks
-                for generation in visit_node_generations(dag, resume=resume):
-                    merged, pipelines = merge_generation(generation, callbacks)
+                for generation in visit_node_generations(
+                    dag, resume=resume, state=state
+                ):
+                    merged, pipelines = merge_generation(
+                        generation, callbacks, resume=resume, resume_state=state
+                    )
                     self._run_tasks(
                         pool, merged, pipelines, policy, budget, use_backups,
-                        batch_size, callbacks,
+                        batch_size, callbacks, resolver,
                     )
                     end_generation(generation, callbacks)
             else:
-                for name, node in visit_nodes(dag, resume=resume):
+                for name, node in visit_nodes(dag, resume=resume, state=state):
                     primitive_op = node["primitive_op"]
                     pipeline = primitive_op.pipeline
                     callbacks_on(
                         callbacks, "on_operation_start",
                         OperationStartEvent(name, primitive_op.num_tasks),
                     )
+                    mappable, _ = pending_mappable(name, node, resume, state)
                     map_unordered(
                         pool,
                         pipeline.function,
-                        pipeline.mappable,
+                        mappable,
                         retry_policy=policy,
                         retry_budget=budget,
                         use_backups=use_backups,
@@ -389,6 +493,7 @@ class AsyncPythonDagExecutor(DagExecutor):
                         callbacks=callbacks,
                         array_name=name,
                         executor_name=self.name,
+                        recompute_resolver=resolver,
                         config=pipeline.config,
                     )
                     callbacks_on(
@@ -398,7 +503,7 @@ class AsyncPythonDagExecutor(DagExecutor):
 
     def _run_tasks(
         self, pool, merged, pipelines, policy, budget, use_backups,
-        batch_size, callbacks,
+        batch_size, callbacks, recompute_resolver=None,
     ):
         def fn(item):
             name, m = item
@@ -416,4 +521,5 @@ class AsyncPythonDagExecutor(DagExecutor):
             callbacks=callbacks,
             array_names=[name for name, _ in merged],
             executor_name=self.name,
+            recompute_resolver=recompute_resolver,
         )

@@ -37,7 +37,9 @@ Injection sites (each counted in the metrics registry under
   client-side result fetches are never poisoned — the same places real
   task-level retry protection exists. A failed local write can first
   litter a partial ``.tmp`` file (``storage_write_leaves_tmp``), modelling
-  a task killed mid-write.
+  a task killed mid-write. With ``storage_corrupt_rate`` a chunk write can
+  instead *succeed with wrong bytes* — a seeded bit-flip or truncation —
+  which only the checksum layer (``storage/integrity.py``) can catch.
 - task bodies (``runtime/utils.execute_with_stats``) — raises
   ``FaultInjectedTaskError`` (transient) or sleeps ``straggler_delay_s``
   (what speculative backups exist for).
@@ -84,6 +86,11 @@ class FaultConfig:
     storage_write_failure_rate: float = 0.0
     #: a failed local write first leaves a partial .tmp file behind
     storage_write_leaves_tmp: bool = True
+    #: probability a chunk write's bytes are silently corrupted in flight
+    #: (the write "succeeds"): seeded per-chunk choice between a single
+    #: bit-flip and a truncation to half length — the two shapes of real
+    #: corruption the checksum layer must catch
+    storage_corrupt_rate: float = 0.0
     #: task body raises before running
     task_failure_rate: float = 0.0
     #: task body sleeps straggler_delay_s before running
@@ -124,6 +131,7 @@ class FaultConfig:
         return bool(
             self.storage_read_failure_rate
             or self.storage_write_failure_rate
+            or self.storage_corrupt_rate
             or self.task_failure_rate
             or self.straggler_rate
             or (self.worker_crash_names and self.worker_crash_after_tasks)
@@ -175,6 +183,34 @@ class FaultInjector:
         if current_scope() is None:
             return False
         return self._hit("storage_write", key, self.config.storage_write_failure_rate)
+
+    def storage_corrupt_fault(self, key: str, data: bytes) -> Optional[bytes]:
+        """Corrupted bytes for this chunk write, or None to write faithfully.
+
+        The corruption itself is a pure function of ``(seed, key)`` — a
+        single bit-flip at a seeded position, or truncation to half length —
+        so a replayed chaos run corrupts identically; *whether* a given
+        write is corrupted rolls per occurrence like every other site."""
+        if not data or current_scope() is None:
+            return None
+        # corruption targets CHUNK files only (digit-dotted names): rotting
+        # .zarray/manifest sidecars models a different failure (covered by
+        # the metadata-tolerance paths), and would turn every subsequent
+        # open into a metadata error instead of exercising checksums
+        name = key.rsplit("/", 1)[-1]
+        if not all(p.lstrip("-").isdigit() for p in name.split(".")):
+            return None
+        if not self._hit("storage_corrupt", key, self.config.storage_corrupt_rate):
+            return None
+        digest = hashlib.sha256(
+            f"{self.config.seed}:corrupt:{key}".encode()
+        ).digest()
+        if digest[0] % 2 == 0:
+            pos = int.from_bytes(digest[1:5], "big") % len(data)
+            out = bytearray(data)
+            out[pos] ^= 1 << (digest[5] % 8)
+            return bytes(out)
+        return data[: len(data) // 2]
 
     # -- task bodies ----------------------------------------------------
 

@@ -1,38 +1,285 @@
-"""DAG traversal helpers shared by all executors.
+"""DAG traversal helpers shared by all executors, including trustworthy
+chunk-granular resume and the corrupt-chunk recompute resolver.
 
-Reference parity: cubed/runtime/pipeline.py:8-57.
+Reference parity: cubed/runtime/pipeline.py:8-57, extended well past it:
+the reference's resume skips an op when its outputs report all chunks
+*present*; here a resume scan verifies each chunk's recorded checksum
+(``storage/integrity.py``) so a corrupt or torn output re-runs instead of
+silently poisoning downstream ops, and partially-complete blockwise ops
+re-run only the tasks whose output chunks are missing or invalid
+(``pending_mappable``) rather than the whole op.
 """
 
 from __future__ import annotations
 
-from typing import Iterator
+import logging
+from typing import Iterator, Optional
 
 import networkx as nx
 
+from ..observability.metrics import get_registry
 
-def already_computed(name, dag, nodes: dict, resume: bool | None) -> bool:
+logger = logging.getLogger(__name__)
+
+
+class ResumeState:
+    """One resume scan's cache of per-store chunk validity.
+
+    Each target store is scanned (and checksum-verified) at most once per
+    traversal, shared between the op-level skip (``already_computed``) and
+    the task-level skip (``pending_mappable``). With ``quarantine=True``
+    (executors) corrupt chunks are renamed to ``*.quarantine.*`` as they
+    are found; introspection (``Plan.num_tasks``) scans read-only with
+    ``count=False`` so it neither mutates stores nor skews execution
+    metrics. When the effective integrity mode is ``"off"`` the scan is
+    existence-only — no byte reads, no verification, no quarantine — the
+    documented pre-integrity resume behavior.
+    """
+
+    def __init__(self, quarantine: bool = False, count: bool = True):
+        from ..storage import integrity
+
+        self.quarantine = quarantine
+        self.count = count
+        #: resolved once per scan: "off" must disable verification even
+        #: when manifest shards exist on disk
+        self.verify = integrity.current_mode() != "off"
+        #: store str -> set of valid chunk keys, or None when the target
+        #: is unreadable/uncreated (nothing trustworthy: run everything)
+        self._valid: dict = {}
+
+    def valid_chunks(self, target) -> Optional[set]:
+        """The set of verified-valid chunk keys of *target*'s store, or
+        None when chunk-level accounting is impossible (store missing,
+        metadata unreadable, or a target type without ``verify_chunks``)."""
+        store = str(getattr(target, "store", target))
+        if store in self._valid:
+            return self._valid[store]
+        valid: Optional[set]
+        try:
+            arr = target.open() if hasattr(target, "open") else target
+            if hasattr(arr, "verify_chunks"):
+                valid, corrupt, _verified = arr.verify_chunks(
+                    quarantine=self.quarantine and self.verify,
+                    verify=self.verify,
+                    count=self.count,
+                )
+                if corrupt:
+                    logger.warning(
+                        "resume scan: %d corrupt/untrusted chunk(s) in %s "
+                        "will recompute", len(corrupt), store,
+                    )
+            else:
+                valid = None
+        except FileNotFoundError:
+            valid = None
+        except (ValueError, KeyError, TypeError, OSError, UnicodeDecodeError):
+            # corrupt/truncated .zarray (or other undecodable metadata):
+            # treat as not-computed — the create-arrays op recreates the
+            # metadata and the op re-runs — instead of crashing the scan
+            logger.warning(
+                "resume scan: unreadable metadata at %s; treating as "
+                "not computed", store,
+            )
+            valid = None
+        self._valid[store] = valid
+        return valid
+
+    def target_complete(self, target) -> bool:
+        """True when every chunk of *target* is present and trustworthy."""
+        valid = self.valid_chunks(target)
+        if valid is not None:
+            return len(valid) >= _target_nchunks(target)
+        if hasattr(target, "verify_chunks") or hasattr(target, "open"):
+            # a Zarr target whose scan failed: genuinely not computed
+            return False
+        # a target type without chunk-level accounting at all: fall back to
+        # the pre-integrity existence counters when it has them
+        try:
+            return (
+                getattr(target, "nchunks_initialized", None) is not None
+                and target.nchunks_initialized == target.nchunks
+            )
+        except (ValueError, KeyError, TypeError, OSError):
+            return False
+
+
+def _target_nchunks(target) -> int:
+    """Total chunk count of a (lazy or concrete) Zarr target."""
+    nchunks = getattr(target, "nchunks", None)
+    if nchunks is not None:
+        return nchunks
+    shape = getattr(target, "shape", None)
+    chunks = getattr(target, "chunks", None)
+    if not shape:
+        return 1
+    total = 1
+    for s, c in zip(shape, chunks):
+        total *= max(1, -(-s // max(1, c)))
+    return total
+
+
+def _task_chunk_key(m) -> str:
+    """The output chunk key a blockwise task writes: mappable items are
+    ``(out_name, i, j, ...)`` out-keys, matching the store's dotted chunk
+    file names (scalar arrays write chunk ``"0"``)."""
+    return ".".join(str(i) for i in m[1:]) if len(m) > 1 else "0"
+
+
+def already_computed(
+    name, dag, nodes: dict, resume: bool | None,
+    state: Optional[ResumeState] = None,
+) -> bool:
     """True if this node's computation can be skipped.
 
     Nodes without a pipeline (array nodes) are always skipped. With
-    ``resume=True`` an op is skipped when every successor array's store reports
-    all chunks initialized (the op-granularity checkpoint).
+    ``resume=True`` an op is skipped when every successor array's chunks are
+    all present AND verify against the recorded checksum manifest — bare
+    existence is not proof of integrity (a corrupt `.zarray`, manifest, or
+    chunk file demotes the op to not-computed instead of crashing the scan
+    or silently trusting bad data). Arrays written with integrity ``off``
+    (no manifest) fall back to the existence-only check.
     """
     pipeline = nodes[name].get("primitive_op", None)
     if pipeline is None:
         return True
     if resume:
+        if state is None:
+            state = ResumeState()
         for succ in dag.successors(name):
             target = nodes[succ].get("target", None)
             if target is None:
                 return False
-            try:
-                arr = target.open() if hasattr(target, "open") else target
-                if arr.nchunks_initialized != arr.nchunks:
-                    return False
-            except FileNotFoundError:
+            if not state.target_complete(target):
                 return False
         return True
     return False
+
+
+def pending_mappable(
+    name, node, resume: bool | None,
+    state: Optional[ResumeState] = None,
+    record: bool = True,
+):
+    """An op's still-to-run tasks under chunk-granular resume.
+
+    Returns ``(mappable, n_skipped)``. For a blockwise op whose output
+    store is partially complete, only the tasks whose output chunk is
+    missing or failed verification remain — resuming an op with 999/1000
+    valid chunks re-runs 1 task, not 1000. Ops whose tasks don't map 1:1
+    to output chunks (create-arrays, rechunk copy regions) run in full.
+    Skips are counted in ``tasks_skipped_resume`` unless ``record=False``
+    (plan introspection must not bump execution metrics).
+    """
+    primitive_op = node["primitive_op"]
+    pipeline = primitive_op.pipeline
+    if not resume or state is None:
+        return pipeline.mappable, 0
+    from ..primitive.blockwise import apply_blockwise
+
+    if pipeline.function is not apply_blockwise:
+        return pipeline.mappable, 0
+    targets = primitive_op.target_arrays or (
+        [primitive_op.target_array]
+        if primitive_op.target_array is not None
+        else []
+    )
+    if not targets:
+        return pipeline.mappable, 0
+    valid_sets = []
+    for t in targets:
+        valid = state.valid_chunks(t)
+        if valid is None:
+            return pipeline.mappable, 0
+        valid_sets.append(valid)
+    pending = []
+    skipped = 0
+    for m in pipeline.mappable:
+        key = _task_chunk_key(m)
+        # a task is done only when EVERY output array has its chunk (a
+        # multi-output op with one corrupt side output re-runs the task)
+        if all(key in valid for valid in valid_sets):
+            skipped += 1
+        else:
+            pending.append(m)
+    if skipped and record:
+        get_registry().counter("tasks_skipped_resume").inc(skipped)
+        logger.info(
+            "resume: skipping %d/%d already-valid task(s) of %s",
+            skipped, primitive_op.num_tasks, name,
+        )
+    return pending, skipped
+
+
+class RecomputeResolver:
+    """Maps a corrupt chunk back to the blockwise task that produces it.
+
+    When a task-scope read raises ``ChunkIntegrityError`` (classified
+    RECOMPUTE), the executor asks this resolver for a thunk re-running the
+    producing op's task for exactly that chunk. The thunk runs client-side
+    against the shared store — valid for every executor, since tasks only
+    communicate through storage. Returns None when the store isn't one of
+    this plan's blockwise outputs (the failure then degrades to a plain
+    retry, which surfaces loudly once retries exhaust).
+    """
+
+    def __init__(self, dag):
+        self._by_store: dict = {}
+        for _name, d in iter_op_nodes(dag):
+            op = d["primitive_op"]
+            targets = op.target_arrays or (
+                [op.target_array] if op.target_array is not None else []
+            )
+            for t in targets:
+                store = str(getattr(t, "store", "") or "")
+                if store:
+                    self._by_store[store] = d
+
+    def resolve(self, payload: Optional[dict]):
+        if not payload:
+            return None
+        node = self._by_store.get(str(payload.get("store", "")))
+        if node is None:
+            return None
+        pipeline = node["primitive_op"].pipeline
+        from ..primitive.blockwise import apply_blockwise
+
+        if pipeline.function is not apply_blockwise:
+            return None
+        key = payload.get("chunk_key")
+        task_input = None
+        for m in pipeline.mappable:
+            if _task_chunk_key(m) == key:
+                task_input = m
+                break
+        if task_input is None:
+            return None
+
+        def recompute():
+            from ..observability.accounting import task_scope
+
+            logger.warning(
+                "recomputing corrupt chunk %s of %s (upstream task re-run)",
+                key, payload.get("store"),
+            )
+            # run inside a task scope: the repair is retry-protected work,
+            # so chaos injection and read verification apply to it exactly
+            # as they would to the original task (an unhealable corruption
+            # storm then exhausts the reader's retries instead of being
+            # silently laundered through an unverified side door)
+            with task_scope() as scope:
+                pipeline.function(task_input, config=pipeline.config)
+            reg = get_registry()
+            for sname, n in scope.stats().items():
+                if sname == "counters":
+                    for cname, cn in n.items():
+                        if cn:
+                            reg.counter(cname).inc(cn)
+                elif n:
+                    reg.counter(sname).inc(n)
+            reg.counter("chunks_recomputed").inc()
+
+        return recompute
 
 
 def iter_op_nodes(dag) -> Iterator[tuple[str, dict]]:
@@ -44,23 +291,31 @@ def iter_op_nodes(dag) -> Iterator[tuple[str, dict]]:
             yield name, d
 
 
-def visit_nodes(dag, resume: bool | None = None) -> Iterator[tuple[str, dict]]:
+def visit_nodes(
+    dag, resume: bool | None = None, state: Optional[ResumeState] = None,
+) -> Iterator[tuple[str, dict]]:
     """Yield (name, node-data) for op nodes in topological order."""
     nodes = dict(dag.nodes(data=True))
+    if resume and state is None:
+        state = ResumeState()
     for name in nx.topological_sort(dag):
-        if already_computed(name, dag, nodes, resume):
+        if already_computed(name, dag, nodes, resume, state):
             continue
         yield name, nodes[name]
 
 
-def visit_node_generations(dag, resume: bool | None = None) -> Iterator[list]:
+def visit_node_generations(
+    dag, resume: bool | None = None, state: Optional[ResumeState] = None,
+) -> Iterator[list]:
     """Yield lists of (name, node-data) for ops in the same topological generation."""
     nodes = dict(dag.nodes(data=True))
+    if resume and state is None:
+        state = ResumeState()
     for generation in nx.topological_generations(dag):
         gen = [
             (name, nodes[name])
             for name in generation
-            if not already_computed(name, dag, nodes, resume)
+            if not already_computed(name, dag, nodes, resume, state)
         ]
         if gen:
             yield gen

@@ -62,6 +62,11 @@ class Classification(enum.Enum):
     RETRY = "retry"  #: transient — backoff, consume one retry, re-run
     FAIL_FAST = "fail_fast"  #: deterministic — one attempt, no backoff
     REQUEUE = "requeue"  #: the worker died, not the task — free reroute
+    #: a stored input chunk failed integrity verification: blindly re-running
+    #: the same read hits the same (now quarantined) corruption; the
+    #: PRODUCING op's task for that chunk must re-run first, then the reader
+    #: retries — each repair drawing one unit of the compute's retry budget
+    RECOMPUTE = "recompute"
 
 
 class RetryBudgetExceededError(RuntimeError):
@@ -160,8 +165,15 @@ class RetryPolicy:
         # that pure-local executors never need at import time
         from concurrent.futures import BrokenExecutor
 
+        from ..storage.integrity import ChunkIntegrityError
         from .distributed import RemoteTaskError, WorkerLostError
 
+        if isinstance(exc, ChunkIntegrityError):
+            # a corrupt input chunk was detected (and quarantined): the
+            # upstream producer's task must re-run before this one retries.
+            # Not FAIL_FAST — the data is repairable, the code is fine; not
+            # plain RETRY — re-reading the quarantined chunk fails forever
+            return Classification.RECOMPUTE
         if isinstance(exc, (WorkerLostError, BrokenExecutor)):
             # the worker (or the whole pool) died, not the task. For a
             # broken pool every in-flight future fails with the same
@@ -173,6 +185,10 @@ class RetryPolicy:
         if isinstance(exc, RemoteTaskError):
             # the worker ships the root exception's class name alongside
             # the traceback text; unknown/absent -> transient default.
+            if getattr(exc, "remote_type", None) == "ChunkIntegrityError":
+                # integrity failures classify RECOMPUTE across the wire too
+                # (the structured payload rides in exc.remote_payload)
+                return Classification.RECOMPUTE
             # Import errors are excluded from remote fail-fast: on a
             # heterogeneous fleet a missing module is a property of ONE
             # host's environment, and a retry may route to a correctly
@@ -264,6 +280,17 @@ def resolve_policy(
     if retry_policy is not None:
         return retry_policy
     return RetryPolicy(retries=DEFAULT_RETRIES if retries is None else retries)
+
+
+def integrity_payload(exc: BaseException) -> Optional[dict]:
+    """The structured ``{store, chunk_key, ...}`` payload of an integrity
+    failure, whether it was raised locally (``ChunkIntegrityError``), arrived
+    pickled from a pool worker, or crossed the distributed wire as a
+    ``RemoteTaskError`` carrying ``remote_payload``. None for other errors."""
+    payload = getattr(exc, "wire_payload", None)
+    if payload:
+        return payload
+    return getattr(exc, "remote_payload", None)
 
 
 def budget_exhausted_error(exc: BaseException, budget: RetryBudget):

@@ -77,6 +77,11 @@ class TaskEndEvent:
     chunks_written: Optional[int] = None
     #: logical bytes served by virtual (never-materialized) arrays — not IO
     virtual_bytes_read: Optional[int] = None
+    #: named event counts recorded inside this task's scope (integrity
+    #: verifications, detected corruption, quarantines — see
+    #: observability/accounting.py ``record_scoped_counter``), measured
+    #: where the task ran and folded into the client registry like bytes
+    counters: Optional[dict] = None
 
 
 class Callback:

@@ -130,7 +130,9 @@ def fire_task_start(
     )
 
 
-def merge_generation(generation, callbacks) -> tuple[list, dict]:
+def merge_generation(
+    generation, callbacks, resume=None, resume_state=None
+) -> tuple[list, dict]:
     """Interleave one topological generation's tasks for a single map.
 
     Fires ``on_operation_start`` for every op in the generation and returns
@@ -138,8 +140,12 @@ def merge_generation(generation, callbacks) -> tuple[list, dict]:
     list and ``pipelines`` maps op name → its pipeline, so the caller can
     resolve each item's ``(function, config)``. Shared by every executor
     that supports ``compute_arrays_in_parallel`` (reference:
-    cubed/runtime/executors/python_async.py:93-114).
+    cubed/runtime/executors/python_async.py:93-114). With ``resume`` set,
+    tasks whose output chunks already verify against the checksum manifest
+    are dropped here (chunk-granular resume, ``pipeline.pending_mappable``).
     """
+    from .pipeline import pending_mappable
+
     items: list = []
     pipelines: dict = {}
     for name, node in generation:
@@ -149,7 +155,8 @@ def merge_generation(generation, callbacks) -> tuple[list, dict]:
             OperationStartEvent(name, primitive_op.num_tasks),
         )
         pipelines[name] = primitive_op.pipeline
-        for m in primitive_op.pipeline.mappable:
+        mappable, _skipped = pending_mappable(name, node, resume, resume_state)
+        for m in mappable:
             items.append((name, m))
     return items, pipelines
 

@@ -31,6 +31,7 @@ class Spec:
         executor_name: Optional[str] = None,
         executor_options: Optional[dict] = None,
         fault_injection: Optional[Any] = None,
+        integrity: Optional[str] = None,
     ):
         self._work_dir = work_dir
         self._reserved_mem = convert_to_bytes(reserved_mem or 0)
@@ -45,6 +46,15 @@ class Spec:
         self._device_mem = convert_to_bytes(device_mem) if device_mem is not None else None
         self._mesh_shape = mesh_shape
         self._fault_injection = fault_injection
+        if integrity is not None:
+            from .storage.integrity import MODES
+
+            if integrity not in MODES:
+                raise ValueError(
+                    f"invalid integrity mode {integrity!r}; expected one of "
+                    f"{MODES}"
+                )
+        self._integrity = integrity
 
     @property
     def work_dir(self) -> Optional[str]:
@@ -92,6 +102,18 @@ class Spec:
         plain dict); ``Plan.execute`` arms it for the compute's duration.
         ``None`` (the default) means no injection."""
         return self._fault_injection
+
+    @property
+    def integrity(self) -> Optional[str]:
+        """Chunk-integrity mode: ``"off"`` (no checksums), ``"write"``
+        (record checksums on every chunk write — what makes resume
+        trustworthy; the effective default), or ``"verify"`` (additionally
+        verify every task-scope chunk read, quarantining corrupt chunks and
+        recomputing their producers). ``None`` defers to the
+        ``CUBED_TPU_INTEGRITY`` env var or the ``"write"`` default;
+        ``Plan.execute`` arms a non-None value for the compute's duration
+        (storage/integrity.py)."""
+        return self._integrity
 
     def __repr__(self) -> str:
         return (

@@ -1,3 +1,4 @@
+from .integrity import ChunkIntegrityError  # noqa: F401
 from .store import ZarrV2Array, open_zarr_array  # noqa: F401
 from .zarr import (  # noqa: F401
     LazyZarrArray,

@@ -4,9 +4,12 @@ The image has no zarr-python, so the persistent-storage layer is implemented
 from scratch: directory stores holding a ``.zarray`` JSON metadata document and
 one raw (uncompressed, C-order) file per chunk, named with ``.``-separated
 chunk indices — the standard Zarr v2 on-disk layout, readable by any Zarr
-implementation. Chunk writes are atomic (temp file + rename), which is what
-makes duplicate/backup tasks and retries safe, matching the reference's
-object-storage semantics (reference docs/reliability.md).
+implementation. Chunk writes are atomic and durable (temp file + fsync +
+rename), which is what makes duplicate/backup tasks and retries safe,
+matching the reference's object-storage semantics (docs/reliability.md).
+Every chunk write also records a checksum in a per-array sidecar manifest,
+task-scope reads can verify it, and resume scans trust only verified
+chunks — see ``storage/integrity.py`` for the full contract.
 
 Local paths use direct file IO; other URLs go through fsspec.
 
@@ -28,11 +31,17 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from ..chunks import blockdims_from_blockshape
-from ..observability.accounting import record_bytes_read, record_bytes_written
+from ..observability.accounting import (
+    record_bytes_read,
+    record_bytes_written,
+    record_scoped_counter,
+)
 from ..observability.metrics import get_registry
 from ..runtime.faults import FaultInjectedIOError, get_injector
 from ..runtime.resilience import RetryPolicy
 from ..utils import join_path
+from . import integrity
+from .integrity import ChunkIntegrityError
 
 logger = logging.getLogger(__name__)
 
@@ -94,10 +103,10 @@ class _LocalIO:
         with open(os.path.join(self.root, name), "rb") as f:
             return f.read()
 
-    def write_bytes_atomic(self, name: str, data: bytes) -> None:
+    def write_bytes_atomic(self, name: str, data: bytes, inject: bool = True) -> None:
         path = os.path.join(self.root, name)
         tmp = path + f".{uuid.uuid4().hex[:8]}.tmp"
-        injector = get_injector()
+        injector = get_injector() if inject else None
         if injector is not None and injector.storage_write_fault(
             _fault_key(self.root, name)
         ):
@@ -108,9 +117,36 @@ class _LocalIO:
                 with open(tmp, "wb") as f:
                     f.write(data[: max(1, len(data) // 2)])
             raise FaultInjectedIOError(f"injected write failure: {name}")
+        if injector is not None:
+            # seeded bit-flip/truncation corruption: the write "succeeds"
+            # but the bytes on disk are wrong — exactly what checksums exist
+            # to catch (the caller records the checksum of the bytes it
+            # intended to write, not what landed on disk)
+            corrupted = injector.storage_corrupt_fault(
+                _fault_key(self.root, name), data
+            )
+            if corrupted is not None:
+                data = corrupted
         with open(tmp, "wb") as f:
             f.write(data)
+            f.flush()
+            # fsync before rename: without it a host crash can leave a
+            # renamed-but-empty chunk that existence-based accounting (and
+            # any pre-checksum reader) counts as done
+            os.fsync(f.fileno())
         os.replace(tmp, path)  # atomic on POSIX: concurrent duplicate tasks are safe
+        _fsync_dir(os.path.dirname(path))
+
+    def rename(self, old: str, new: str) -> None:
+        os.replace(os.path.join(self.root, old), os.path.join(self.root, new))
+
+    def append_bytes(self, name: str, data: bytes) -> None:
+        """O_APPEND write for the manifest's JSONL shards. One writer per
+        shard file by construction (per-process naming), so appends never
+        interleave; no fsync — a lost tail costs recomputation on resume,
+        never correctness (the loader skips torn lines)."""
+        with open(os.path.join(self.root, name), "ab") as f:
+            f.write(data)
 
     def list_names(self) -> list[str]:
         try:
@@ -148,6 +184,23 @@ class _LocalIO:
         return removed
 
 
+def _fsync_dir(path: str) -> None:
+    """Best-effort directory fsync after a rename: makes the new directory
+    entry itself durable, so a host crash can't forget a chunk whose bytes
+    were already fsynced. Filesystems without directory fsync (or platforms
+    without O_DIRECTORY) just skip it — the chunk data is still synced."""
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 class _FsspecIO:
     """fsspec-backed IO for remote stores (s3://, gs://, memory://, ...)."""
 
@@ -171,16 +224,25 @@ class _FsspecIO:
         with self.fs.open(f"{self.root}/{name}", "rb") as f:
             return f.read()
 
-    def write_bytes_atomic(self, name: str, data: bytes) -> None:
-        injector = get_injector()
+    def write_bytes_atomic(self, name: str, data: bytes, inject: bool = True) -> None:
+        injector = get_injector() if inject else None
         if injector is not None and injector.storage_write_fault(
             _fault_key(self.root, name)
         ):
             # whole-object PUTs can't leave partial objects; just fail
             raise FaultInjectedIOError(f"injected write failure: {name}")
+        if injector is not None:
+            corrupted = injector.storage_corrupt_fault(
+                _fault_key(self.root, name), data
+            )
+            if corrupted is not None:
+                data = corrupted
         # object stores have atomic whole-object PUTs
         with self.fs.open(f"{self.root}/{name}", "wb") as f:
             f.write(data)
+
+    def rename(self, old: str, new: str) -> None:
+        self.fs.mv(f"{self.root}/{old}", f"{self.root}/{new}")
 
     def list_names(self) -> list[str]:
         try:
@@ -319,6 +381,11 @@ class ZarrV2Array:
         self.fill_value = _decode_fill(meta.get("fill_value"), self.dtype)
         self.compressor: Optional[dict] = meta.get("compressor")
         self._codec = _codec_from_meta(self.compressor)
+        #: merged manifest, loaded lazily per instance (instances are opened
+        #: per task, so the cache lives at most one task — fresh enough,
+        #: since an array's chunks are fully written before a consuming op
+        #: reads them)
+        self._manifest_cache: Optional[tuple[dict, bool]] = None
 
     # -- metadata ----------------------------------------------------------
 
@@ -345,20 +412,27 @@ class ZarrV2Array:
     def nchunks(self) -> int:
         return prod(self.cdata_shape) if self.shape else 1
 
-    @property
-    def nchunks_initialized(self) -> int:
-        """Number of chunk objects present in the store (drives resume)."""
-        names = set(self._io.list_names())
-        names.discard(".zarray")
-        names.discard(".zattrs")
-        count = 0
-        for name in names:
+    def _chunk_names(self) -> list[str]:
+        """Names of chunk objects present in the store: digit-dotted keys
+        only — metadata, manifests, ``.tmp`` litter and ``*.quarantine.*``
+        files are all excluded."""
+        out = []
+        for name in self._io.list_names():
+            if name.startswith("."):  # .zarray/.zattrs/.manifest-*
+                continue
             if name.endswith(".tmp"):
                 continue
             parts = name.split(".")
             if all(p.lstrip("-").isdigit() for p in parts):
-                count += 1
-        return count
+                out.append(name)
+        return out
+
+    @property
+    def nchunks_initialized(self) -> int:
+        """Number of chunk objects present in the store (drives the
+        existence-only resume fallback; checksum-verified resume uses
+        :meth:`verify_chunks`)."""
+        return len(self._chunk_names())
 
     def chunkset(self) -> tuple[tuple[int, ...], ...]:
         """Chunks in tuple-of-block-sizes form."""
@@ -377,16 +451,115 @@ class ZarrV2Array:
     def _read_chunk(self, idx: tuple[int, ...]) -> Optional[np.ndarray]:
         """Read the full (padded) chunk at block index *idx*, or None if absent."""
         key = self._chunk_key(idx)
+        verify = integrity.verify_reads_active()
         if not self._io.exists(key):
+            if verify and key in self._manifest()[0]:
+                # the manifest says this chunk WAS written: absence is an
+                # integrity failure (quarantined earlier, or the store lost
+                # it), NOT a never-written chunk that may serve fill values
+                # — silently substituting fill for real data would complete
+                # the compute with wrong results
+                record_scoped_counter("chunks_corrupt_detected")
+                raise ChunkIntegrityError(
+                    f"chunk {key} of {self.store} is recorded in the "
+                    "manifest but missing from the store",
+                    store=self.store, chunk_key=key, kind="missing",
+                )
             return None
         data = self._read_bytes_with_retries(key)
         # IO bytes as stored (pre-decompression), attributed to the reading
         # task's scope when one is active (observability/accounting.py)
         record_bytes_read(self.store, len(data))
+        if verify:
+            self._verify_chunk_bytes(key, data)
         if self._codec is not None:
             data = self._codec[1](data)
         arr = np.frombuffer(data, dtype=self.dtype)
         return arr.reshape(self.chunks if self.shape else ())
+
+    def _manifest(self) -> tuple[dict, bool]:
+        """Merged checksum manifest ``(entries, had_shards)``, cached per
+        instance (see ``__init__``)."""
+        if self._manifest_cache is None:
+            self._manifest_cache = integrity.load_manifest(self._io)
+        return self._manifest_cache
+
+    def _verify_chunk_bytes(self, key: str, data: bytes) -> None:
+        """Verify stored chunk bytes against the manifest; on mismatch
+        quarantine the file and raise :class:`ChunkIntegrityError`. Chunks
+        with no manifest entry pass unverified (written with integrity off,
+        or by a pre-integrity version — there is nothing to check against)."""
+        entry = self._manifest()[0].get(key)
+        if entry is None:
+            return
+        record_scoped_counter("chunks_verified")
+        actual = (integrity.checksum(data), len(data))
+        expected = (entry.get("c"), entry.get("n"))
+        if actual != expected:
+            record_scoped_counter("chunks_corrupt_detected")
+            integrity.quarantine_chunk(self._io, key, store=self.store)
+            raise ChunkIntegrityError(
+                f"chunk {key} of {self.store} failed checksum verification "
+                f"(expected crc32={expected[0]} len={expected[1]}, got "
+                f"crc32={actual[0]} len={actual[1]}); file quarantined",
+                store=self.store, chunk_key=key, kind="checksum",
+                expected=expected, actual=actual,
+            )
+
+    def verify_chunks(
+        self,
+        quarantine: bool = True,
+        verify: bool = True,
+        count: bool = True,
+    ) -> tuple[set, list, bool]:
+        """Verify every stored chunk against the manifest.
+
+        Returns ``(valid, corrupt, verified)``: the set of chunk keys whose
+        bytes match their recorded checksum, the list that failed (moved to
+        ``*.quarantine.*`` when *quarantine* is set), and whether
+        verification actually ran. With no manifest at all (integrity off /
+        legacy store) — or with ``verify=False`` (how a resume scan honors
+        ``integrity="off"``) — every present chunk is reported valid and
+        ``verified`` is False: existence-only accounting, the pre-integrity
+        behavior. ``count=False`` keeps the scan off the metrics registry
+        (plan introspection must not skew execution counters). A chunk
+        present on disk but absent from the manifest is reported corrupt
+        (it cannot be trusted), but is never quarantined — it may be a
+        legitimate write that raced manifest recording, and re-running its
+        producing task overwrites it in place.
+        """
+        names = self._chunk_names()
+        if not verify:
+            return set(names), [], False
+        entries, had_shards = integrity.load_manifest(self._io)
+        if not had_shards:
+            return set(names), [], False
+        valid: set = set()
+        corrupt: list = []
+        for name in names:
+            entry = entries.get(name)
+            ok = False
+            if entry is not None:
+                try:
+                    data = self._io.read_bytes(name)
+                except OSError:
+                    data = None
+                ok = (
+                    data is not None
+                    and len(data) == entry.get("n")
+                    and integrity.checksum(data) == entry.get("c")
+                )
+                if count:
+                    record_scoped_counter("chunks_verified")
+            if ok:
+                valid.add(name)
+            else:
+                corrupt.append(name)
+                if count:
+                    record_scoped_counter("chunks_corrupt_detected")
+                if quarantine and entry is not None:
+                    integrity.quarantine_chunk(self._io, name, store=self.store)
+        return valid, corrupt, True
 
     def _read_bytes_with_retries(self, key: str) -> bytes:
         """Chunk reads retry transient IO errors at the storage layer.
@@ -424,7 +597,16 @@ class ZarrV2Array:
         data = arr.tobytes()
         if self._codec is not None:
             data = self._codec[0](data)
-        self._io.write_bytes_atomic(self._chunk_key(idx), data)
+        key = self._chunk_key(idx)
+        self._io.write_bytes_atomic(key, data)
+        if integrity.current_mode() != "off":
+            # recorded AFTER the chunk write succeeds: a crash between the
+            # two leaves a chunk without an entry, which resume treats as
+            # not-computed (safe re-run) — never an entry without its chunk
+            entry = integrity.record_checksum(self._io, self.store, key, data)
+            if self._manifest_cache is not None:
+                self._manifest_cache[0][key] = entry
+                self._manifest_cache = (self._manifest_cache[0], True)
         record_bytes_written(self.store, len(data))
 
     def _empty_chunk(self) -> np.ndarray:
@@ -649,8 +831,30 @@ def open_zarr_array(
     if mode == "r" or (mode == "a" and meta_exists):
         if not meta_exists:
             raise FileNotFoundError(f"No zarr array at {store}")
-        meta = json.loads(io.read_bytes(".zarray"))
-        return ZarrV2Array(store, meta, storage_options)
+        try:
+            meta = json.loads(io.read_bytes(".zarray"))
+            return ZarrV2Array(store, meta, storage_options)
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError) as exc:
+            # corrupt/truncated .zarray JSON (a writer killed mid-crash era,
+            # bit rot). Readers fail loudly with a diagnosable error; a
+            # writer-mode open WITH full creation parameters quarantines the
+            # bad document and recreates it — chunk data is untouched, and
+            # checksum-verified resume decides per chunk what to trust
+            if mode != "r" and shape is not None and dtype is not None:
+                logger.warning(
+                    "quarantining corrupt .zarray at %s and recreating "
+                    "metadata (%s)", store, exc,
+                )
+                try:
+                    io.rename(".zarray", f".zarray.quarantine.{int(time.time() * 1000)}")
+                except OSError:
+                    pass
+                get_registry().counter("zarray_meta_recreated").inc()
+            else:
+                raise ValueError(
+                    f"corrupt .zarray metadata at {store}: {exc!r} (reopen "
+                    "in a writer mode with shape/dtype to recreate it)"
+                ) from exc
     if shape is None or dtype is None:
         raise ValueError("shape and dtype required to create a new array")
     dtype = np.dtype(dtype)

@@ -1,0 +1,306 @@
+"""Corruption chaos: end-to-end computes must survive seeded bit-flip /
+truncation corruption — detected by checksums, quarantined, and repaired by
+recomputing the producing task (mid-compute, via the RECOMPUTE
+classification) or by a chunk-granular ``resume=True`` (after a mid-compute
+kill) — with bitwise-correct results on the threaded, sequential,
+multiprocess and distributed executors.
+
+Marked ``chaos`` (tier-1) like the rest of the fault-injection suite.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime import faults
+from cubed_tpu.runtime.executors.python import PythonDagExecutor
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+from cubed_tpu.runtime.resilience import RetryPolicy
+
+pytestmark = pytest.mark.chaos
+
+#: the acceptance corruption profile: ~5% of chunk writes are silently
+#: corrupted (seeded bit-flip or truncation). The mid-compute kill is
+#: injected deterministically by the plan itself (``_KillableAdd``), not by
+#: seeded task faults: injection keys include the gensym'd array name, so a
+#: seeded crash pattern would depend on how many arrays earlier tests
+#: created in this process — fine for flakiness profiles, wrong for a test
+#: that must die at a controlled point
+CORRUPTION = dict(seed=1234, storage_corrupt_rate=0.05)
+
+
+class _KillableAdd:
+    """Picklable ``x + 1`` task that raises on one late block while the
+    kill-flag file exists — a deterministic mid-compute kill: by the time
+    the late block runs, earlier blocks have completed their writes, and
+    the compute dies with the store partial. Removing the flag makes the
+    same plan computable again (what resume needs)."""
+
+    def __init__(self, flag_path: str, kill_block=(9, 5)):
+        self.flag_path = flag_path
+        self.kill_block = tuple(kill_block)
+
+    def __call__(self, x, block_id=None):
+        if tuple(block_id or ()) == self.kill_block and os.path.exists(
+            self.flag_path
+        ):
+            raise RuntimeError(f"injected mid-compute kill at {block_id}")
+        return x + 1.0
+
+
+def _flip_byte(path: str, offset: int = 0) -> None:
+    with open(path, "r+b") as f:
+        data = bytearray(f.read())
+        data[offset] ^= 0xFF
+        f.seek(0)
+        f.write(data)
+
+
+def _chunk_files(store: str) -> list[str]:
+    return sorted(
+        n
+        for n in os.listdir(store)
+        if not n.startswith(".")
+        and not n.endswith(".tmp")
+        and all(p.lstrip("-").isdigit() for p in n.split("."))
+    )
+
+
+def _stores_with_chunks(work_dir) -> list[str]:
+    return [
+        s
+        for s in sorted(
+            os.path.dirname(p)
+            for p in glob.glob(f"{work_dir}/*/*.zarr/.zarray")
+        )
+        if _chunk_files(s)
+    ]
+
+
+class _StatsCapture:
+    stats: dict = {}
+
+    def on_compute_end(self, event):
+        self.stats = event.executor_stats or {}
+
+
+class _CorruptFirstPopulatedStore:
+    """Callback flipping a byte in one chunk of the first store that gains
+    chunks — i.e. the intermediate array, right after its producing op ends
+    and before any consumer reads it. Deterministic mid-compute corruption
+    without racing the executor."""
+
+    def __init__(self, work_dir):
+        self.work_dir = work_dir
+        self.corrupted = None
+
+    def on_operation_end(self, event):
+        if self.corrupted is not None:
+            return
+        for store in _stores_with_chunks(self.work_dir):
+            name = _chunk_files(store)[0]
+            _flip_byte(os.path.join(store, name), offset=3)
+            self.corrupted = os.path.join(store, name)
+            return
+
+
+# ----------------------------------------------------------------------
+# acceptance: ~5% corruption + mid-compute kill, then resume=True
+# ----------------------------------------------------------------------
+
+
+def _corruption_kill_then_resume(tmp_path, make_executor, close=None):
+    """Shared acceptance body: first pass dies mid-compute (deterministic
+    kill on a late block) under seeded ~5% write corruption; at-rest rot
+    hits one more surviving chunk; then a clean ``resume=True`` yields the
+    bitwise-correct result, quarantining every corrupt chunk and re-running
+    strictly fewer tasks than the full plan."""
+    an = np.arange(400.0, dtype=np.float64).reshape(20, 20)
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 100 chunk tasks
+    kill_flag = os.path.join(str(tmp_path), "kill.flag")
+    with open(kill_flag, "w"):
+        pass
+    b = ct.map_blocks(_KillableAdd(kill_flag), a, dtype=np.float64)
+    full = b.plan.num_tasks(optimize_graph=False)
+    assert full >= 101  # 100 chunk tasks + create-arrays
+
+    ex1 = make_executor(0)
+    try:
+        with faults.scoped(CORRUPTION, export_env=True):
+            with pytest.raises(Exception, match="mid-compute kill"):
+                b.compute(executor=ex1, optimize_graph=False)
+    finally:
+        if close:
+            close(ex1)
+
+    # the kill left a partial store; seeded corruption hit some of the
+    # surviving writes, and one more chunk rots at rest for good measure
+    stores = _stores_with_chunks(str(tmp_path))
+    assert stores, "first pass should have written some chunks before dying"
+    survivors = _chunk_files(stores[0])
+    assert 0 < len(survivors) < 100
+    _flip_byte(os.path.join(stores[0], survivors[0]), offset=7)
+    os.unlink(kill_flag)  # the "host" is healthy again; resume cleanly
+
+    before = get_registry().snapshot()
+    ex2 = make_executor(2)
+    try:
+        res = b.compute(executor=ex2, optimize_graph=False, resume=True)
+    finally:
+        if close:
+            close(ex2)
+    np.testing.assert_array_equal(res, an + 1.0)  # bitwise-correct
+
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("chunks_quarantined", 0) > 0, delta
+    assert delta.get("tasks_skipped_resume", 0) > 0, delta
+    # chunk-granular skip proven via metrics: the resumed compute started
+    # strictly fewer tasks than the full plan
+    assert 0 < delta.get("tasks_started", 0) < full, delta
+    assert (
+        delta.get("tasks_skipped_resume", 0) + delta.get("tasks_started", 0)
+        >= full
+    )
+
+
+def test_chaos_corruption_kill_resume_threaded(tmp_path):
+    _corruption_kill_then_resume(
+        tmp_path,
+        lambda retries: AsyncPythonDagExecutor(
+            retry_policy=RetryPolicy(retries=retries, backoff_base=0.01, seed=0)
+        ),
+    )
+
+
+def test_chaos_corruption_kill_resume_multiprocess(tmp_path):
+    from cubed_tpu.runtime.executors.multiprocess import MultiprocessDagExecutor
+
+    _corruption_kill_then_resume(
+        tmp_path,
+        lambda retries: MultiprocessDagExecutor(
+            max_workers=2,
+            retry_policy=RetryPolicy(retries=retries, backoff_base=0.01, seed=0),
+        ),
+    )
+
+
+def test_chaos_corruption_kill_resume_distributed(tmp_path):
+    from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+
+    _corruption_kill_then_resume(
+        tmp_path,
+        lambda retries: DistributedDagExecutor(
+            n_local_workers=2,
+            retry_policy=RetryPolicy(retries=retries, backoff_base=0.01, seed=0),
+        ),
+        close=lambda ex: ex.close(),
+    )
+
+
+# ----------------------------------------------------------------------
+# mid-compute repair: verify-mode reads + RECOMPUTE classification
+# ----------------------------------------------------------------------
+
+
+def _recompute_repairs_mid_compute(tmp_path, executor):
+    """A corrupt intermediate chunk is detected at read time (verify mode),
+    quarantined, its producing task re-run, and the reader retried — the
+    compute completes bitwise-correct without resume."""
+    an = np.arange(100.0, dtype=np.float64).reshape(10, 10)
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB", integrity="verify")
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1.0)
+    c = xp.multiply(b, 2.0)  # optimize_graph=False keeps b materialized
+
+    corruptor = _CorruptFirstPopulatedStore(str(tmp_path))
+    before = get_registry().snapshot()
+    res = c.compute(
+        executor=executor, optimize_graph=False, callbacks=[corruptor]
+    )
+    np.testing.assert_array_equal(res, (an + 1.0) * 2.0)
+    assert corruptor.corrupted is not None
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("chunks_corrupt_detected", 0) >= 1, delta
+    assert delta.get("chunks_quarantined", 0) >= 1, delta
+    assert delta.get("chunks_recomputed", 0) >= 1, delta
+    assert delta.get("chunks_verified", 0) > 0, delta
+    # fail-fast never fired: corruption is repairable, not a bug
+    assert delta.get("task_failfast", 0) == 0, delta
+
+
+def test_chaos_recompute_repairs_corrupt_chunk_threaded(tmp_path):
+    _recompute_repairs_mid_compute(
+        tmp_path,
+        AsyncPythonDagExecutor(
+            retry_policy=RetryPolicy(retries=3, backoff_base=0.01, seed=0)
+        ),
+    )
+
+
+def test_chaos_recompute_repairs_corrupt_chunk_sequential(tmp_path):
+    _recompute_repairs_mid_compute(
+        tmp_path,
+        PythonDagExecutor(
+            retry_policy=RetryPolicy(retries=3, backoff_base=0.01, seed=0)
+        ),
+    )
+
+
+def test_chaos_recompute_repairs_corrupt_chunk_multiprocess(tmp_path):
+    """The ChunkIntegrityError pickles across the process boundary with its
+    (store, chunk) payload intact; the repair runs client-side."""
+    from cubed_tpu.runtime.executors.multiprocess import MultiprocessDagExecutor
+
+    _recompute_repairs_mid_compute(
+        tmp_path,
+        MultiprocessDagExecutor(
+            max_workers=2,
+            retry_policy=RetryPolicy(retries=3, backoff_base=0.01, seed=0),
+        ),
+    )
+
+
+def test_chaos_recompute_repairs_corrupt_chunk_distributed(tmp_path):
+    """Across the fleet wire the failure arrives as RemoteTaskError with
+    remote_type=ChunkIntegrityError + the structured payload; the
+    coordinator-side policy classifies RECOMPUTE and repairs."""
+    from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+
+    with DistributedDagExecutor(
+        n_local_workers=2,
+        retry_policy=RetryPolicy(retries=3, backoff_base=0.01, seed=0),
+    ) as ex:
+        _recompute_repairs_mid_compute(tmp_path, ex)
+
+
+def test_chaos_unhealable_corruption_fails_loudly(tmp_path):
+    """When every rewrite is corrupted too (rate 1.0), repair cannot
+    converge: the compute must abort within the retry/budget bounds —
+    loudly — instead of looping or silently returning wrong data."""
+    from cubed_tpu.runtime.resilience import RetryBudgetExceededError
+    from cubed_tpu.storage.integrity import ChunkIntegrityError
+
+    an = np.arange(16.0, dtype=np.float64).reshape(4, 4)
+    spec = ct.Spec(
+        work_dir=str(tmp_path),
+        allowed_mem="500MB",
+        integrity="verify",
+        fault_injection=dict(seed=3, storage_corrupt_rate=1.0),
+    )
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    c = xp.multiply(xp.add(a, 1.0), 2.0)
+    with pytest.raises((ChunkIntegrityError, RetryBudgetExceededError)):
+        c.compute(
+            executor=AsyncPythonDagExecutor(
+                retry_policy=RetryPolicy(retries=2, backoff_base=0.01, seed=0)
+            ),
+            optimize_graph=False,
+        )

@@ -1,0 +1,262 @@
+"""Resume semantics: chunk-granular skips, checksum-trustworthy restarts,
+plan introspection consistency (``num_tasks``/``max_projected_mem`` under
+``resume=True`` match what executors actually run), corrupt-metadata
+tolerance, and interaction with speculative backups.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+
+from ..utils import TaskCounter
+
+
+def _output_store(tmp_path) -> str:
+    """The single materialized store of a one-op plan under tmp_path."""
+    stores = sorted(
+        os.path.dirname(p) for p in glob.glob(f"{tmp_path}/*/*.zarr/.zarray")
+    )
+    assert len(stores) == 1, stores
+    return stores[0]
+
+
+def _chunk_files(store: str) -> list[str]:
+    return sorted(
+        n
+        for n in os.listdir(store)
+        if not n.startswith(".")
+        and not n.endswith(".tmp")
+        and all(p.lstrip("-").isdigit() for p in n.split("."))
+    )
+
+
+def _flip_byte(path: str, offset: int = 0) -> None:
+    with open(path, "r+b") as f:
+        data = bytearray(f.read())
+        data[offset] ^= 0xFF
+        f.seek(0)
+        f.write(data)
+
+
+def test_resume_is_chunk_granular(spec, tmp_path):
+    """Resuming an op with 24/25 valid chunks re-runs 1 task, not 25."""
+    an = np.arange(100.0).reshape(10, 10)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1.0)
+    np.testing.assert_array_equal(b.compute(optimize_graph=False), an + 1.0)
+    store = _output_store(spec.work_dir)
+    os.unlink(os.path.join(store, "3.3"))
+
+    before = get_registry().snapshot()
+    counter = TaskCounter()
+    res = b.compute(optimize_graph=False, resume=True, callbacks=[counter])
+    np.testing.assert_array_equal(res, an + 1.0)
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("tasks_skipped_resume") == 24
+    # create-arrays (1 task) + exactly the one missing-chunk task
+    assert counter.value == 2
+
+
+def test_resume_distrusts_corrupt_chunk(spec):
+    """A bit-flipped chunk fails its checksum: resume quarantines it and
+    re-runs exactly its producing task — existence is not integrity."""
+    an = np.arange(100.0).reshape(10, 10)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1.0)
+    b.compute(optimize_graph=False)
+    store = _output_store(spec.work_dir)
+    _flip_byte(os.path.join(store, "0.1"), offset=4)
+
+    before = get_registry().snapshot()
+    res = b.compute(optimize_graph=False, resume=True)
+    np.testing.assert_array_equal(res, an + 1.0)  # bitwise-repaired
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("chunks_corrupt_detected") == 1
+    assert delta.get("chunks_quarantined") == 1
+    assert delta.get("tasks_skipped_resume") == 24
+    assert [n for n in os.listdir(store) if n.startswith("0.1.quarantine.")]
+
+
+def test_num_tasks_resume_matches_executed_tasks(spec):
+    """Plan introspection under resume agrees with what executors run:
+    ``num_tasks(resume=True)`` counts create-arrays plus only the pending
+    chunk tasks, and the resumed compute fires exactly that many."""
+    an = np.arange(64.0).reshape(8, 8)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 16 chunks
+    b = xp.add(a, 1.0)
+    plan = b.plan
+    full = plan.num_tasks(optimize_graph=False)
+    b.compute(optimize_graph=False)
+    store = _output_store(spec.work_dir)
+    for name in ("0.0", "1.2", "3.3"):
+        os.unlink(os.path.join(store, name))
+
+    pending = plan.num_tasks(optimize_graph=False, resume=True)
+    assert pending == full - 13  # 16 - 3 pending chunk tasks were skipped
+    counter = TaskCounter()
+    b.compute(optimize_graph=False, resume=True, callbacks=[counter])
+    assert counter.value == pending
+
+
+def test_num_tasks_resume_complete_plan(spec):
+    an = np.arange(16.0).reshape(4, 4)
+    b = xp.add(ct.from_array(an, chunks=(2, 2), spec=spec), 1.0)
+    plan = b.plan
+    b.compute(optimize_graph=False)
+    # fully valid: only the (idempotent) create-arrays op remains
+    assert plan.num_tasks(optimize_graph=False, resume=True) == 1
+
+
+def test_max_projected_mem_resume_consistent(spec):
+    """An op whose outputs are fully valid drops out of the projected-mem
+    scan, exactly as the executors skip it; a partially-valid op stays."""
+    an = np.arange(64.0).reshape(8, 8)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1.0)
+    plan = b.plan
+    full_mem = plan.max_projected_mem(optimize_graph=False)
+    assert full_mem > 0
+    b.compute(optimize_graph=False)
+    assert plan.max_projected_mem(optimize_graph=False, resume=True) == 0
+    store = _output_store(spec.work_dir)
+    os.unlink(os.path.join(store, "0.0"))
+    # one missing chunk: the op is pending again, with its full footprint
+    assert plan.max_projected_mem(optimize_graph=False, resume=True) == full_mem
+
+
+def test_resume_tolerates_corrupt_zarray(spec):
+    """Regression: a corrupt/truncated .zarray used to crash the resume
+    scan (only FileNotFoundError was caught). Now the op is treated as
+    not-computed, the metadata is recreated, and the compute succeeds."""
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1.0)
+    b.compute(optimize_graph=False)
+    store = _output_store(spec.work_dir)
+    with open(os.path.join(store, ".zarray"), "wb") as f:
+        f.write(b'{"zarr_format": 2, "shape": [6,')  # truncated JSON
+
+    res = b.compute(optimize_graph=False, resume=True)
+    np.testing.assert_array_equal(res, an + 1.0)
+    assert [n for n in os.listdir(store) if n.startswith(".zarray.quarantine.")]
+
+
+def test_resume_tolerates_corrupt_manifest(spec):
+    """Garbage manifest JSON demotes its chunks to untrusted (they re-run)
+    without crashing the scan or poisoning the result."""
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1.0)
+    b.compute(optimize_graph=False)
+    store = _output_store(spec.work_dir)
+    shard = next(n for n in os.listdir(store) if n.startswith(".manifest-"))
+    with open(os.path.join(store, shard), "wb") as f:
+        f.write(b"\xff\xfenot json")
+
+    before = get_registry().snapshot()
+    res = b.compute(optimize_graph=False, resume=True)
+    np.testing.assert_array_equal(res, an + 1.0)
+    delta = get_registry().snapshot_delta(before)
+    # nothing trustworthy -> every chunk task re-ran
+    assert delta.get("tasks_skipped_resume", 0) == 0
+
+
+def test_resume_integrity_off_is_existence_only(spec):
+    """``integrity="off"`` restores the pre-integrity resume: no byte
+    verification, no quarantining — a present-but-corrupt chunk is trusted
+    (the documented trade of turning the feature off)."""
+    from cubed_tpu.storage import integrity
+
+    an = np.arange(100.0).reshape(10, 10)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1.0)
+    b.compute(optimize_graph=False)
+    store = _output_store(spec.work_dir)
+    _flip_byte(os.path.join(store, "0.1"), offset=4)
+
+    before = get_registry().snapshot()
+    with integrity.scoped("off"):
+        b.compute(optimize_graph=False, resume=True)
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("chunks_verified", 0) == 0
+    assert delta.get("chunks_corrupt_detected", 0) == 0
+    assert delta.get("chunks_quarantined", 0) == 0
+    # all chunks "present" -> the whole op is skipped (create-arrays only)
+    assert delta.get("tasks_started") == 1
+    assert not [n for n in os.listdir(store) if "quarantine" in n]
+
+
+def test_plan_introspection_is_metrics_silent(spec):
+    """num_tasks/max_projected_mem(resume=True) must not skew the
+    execution counters (chunks_verified etc.) they'd otherwise double."""
+    an = np.arange(36.0).reshape(6, 6)
+    b = xp.add(ct.from_array(an, chunks=(2, 2), spec=spec), 1.0)
+    b.compute(optimize_graph=False)
+    before = get_registry().snapshot()
+    b.plan.num_tasks(optimize_graph=False, resume=True)
+    b.plan.max_projected_mem(optimize_graph=False, resume=True)
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("chunks_verified", 0) == 0
+    assert delta.get("tasks_skipped_resume", 0) == 0
+
+
+def test_resume_with_speculative_backups(spec):
+    """Chunk-granular resume composes with speculative backups: duplicate
+    twins re-writing identical bytes keep manifests consistent and the
+    resumed result bitwise-correct."""
+    an = np.arange(100.0).reshape(10, 10)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1.0)
+    ex = AsyncPythonDagExecutor(use_backups=True)
+    b.compute(optimize_graph=False, executor=ex)
+    store = _output_store(spec.work_dir)
+    for name in _chunk_files(store)[:5]:
+        os.unlink(os.path.join(store, name))
+
+    before = get_registry().snapshot()
+    res = b.compute(optimize_graph=False, resume=True, executor=ex)
+    np.testing.assert_array_equal(res, an + 1.0)
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("tasks_skipped_resume") == 20
+
+
+def test_multioutput_resume_skips_per_task(spec):
+    """Multi-output ops skip a task only when EVERY output array holds a
+    valid chunk for it; losing one side output's chunk re-runs exactly that
+    task (not the whole op, not zero tasks)."""
+    from cubed_tpu.core.ops import general_blockwise
+    from cubed_tpu.runtime.executors.python import PythonDagExecutor
+
+    an = np.arange(12, dtype=np.float64)
+    a = ct.from_array(an, chunks=(4,), spec=spec)
+
+    def two(chunk):
+        return chunk + 1.0, (chunk * 2.0).astype(np.float64)
+
+    def block_function(out_key):
+        return ((a.name, *out_key[1:]),)
+
+    p, d = general_blockwise(
+        two, block_function, a,
+        shape=a.shape, dtype=[a.dtype, np.dtype(np.float64)],
+        chunks=a.chunks, op_name="two_out",
+    )
+    ex = PythonDagExecutor()
+    np.testing.assert_array_equal(np.asarray(p.compute(executor=ex)), an + 1.0)
+    np.testing.assert_array_equal(np.asarray(d.compute(executor=ex)), an * 2.0)
+    # drop ONE chunk of the SECONDARY output: that task alone re-runs
+    os.unlink(os.path.join(str(d.zarray_maybe_lazy.store), "1"))
+    before = get_registry().snapshot()
+    np.testing.assert_array_equal(
+        np.asarray(d.compute(executor=ex, resume=True)), an * 2.0
+    )
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("tasks_skipped_resume") == 2  # 3 tasks, 1 pending

@@ -1,0 +1,349 @@
+"""Chunk-integrity unit tests: checksum manifests, quarantine, read-time
+verification, corrupt-metadata tolerance, and the corruption fault sites.
+
+The end-to-end story (RECOMPUTE classification, chunk-granular resume,
+corruption chaos across executors) lives in tests/runtime/test_integrity.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cubed_tpu.observability.accounting import task_scope
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime.faults import FaultConfig, FaultInjector
+from cubed_tpu.storage import integrity
+from cubed_tpu.storage.integrity import ChunkIntegrityError
+from cubed_tpu.storage.store import open_zarr_array
+
+
+def _make_array(path, shape=(4, 4), chunks=(2, 2)):
+    arr = open_zarr_array(
+        str(path), mode="a", shape=shape, dtype=np.float64, chunks=chunks
+    )
+    arr[:] = np.arange(np.prod(shape), dtype=np.float64).reshape(shape)
+    return arr
+
+
+def _flip_byte(path, offset=0):
+    with open(path, "r+b") as f:
+        data = bytearray(f.read())
+        data[offset] ^= 0xFF
+        f.seek(0)
+        f.write(data)
+
+
+# -- manifest recording ---------------------------------------------------
+
+
+def test_chunk_writes_record_manifest(tmp_path):
+    store = tmp_path / "a"
+    arr = _make_array(store)
+    shards = [n for n in os.listdir(store) if n.startswith(".manifest-")]
+    assert len(shards) == 1
+    # local shards are append-only JSONL: one line per chunk write
+    lines = [
+        json.loads(line)
+        for line in (store / shards[0]).read_text().splitlines()
+        if line.strip()
+    ]
+    assert {line["k"] for line in lines} == {"0.0", "0.1", "1.0", "1.1"}
+    entries, had = integrity.load_manifest(arr._io)
+    assert had and set(entries) == {"0.0", "0.1", "1.0", "1.1"}
+    for key, ent in entries.items():
+        data = (store / key).read_bytes()
+        assert ent["c"] == integrity.checksum(data)
+        assert ent["n"] == len(data)
+    # the sidecar preserves the Zarr v2 layout: chunk accounting unchanged
+    assert arr.nchunks_initialized == 4
+
+
+def test_integrity_off_records_nothing(tmp_path):
+    with integrity.scoped("off"):
+        _make_array(tmp_path / "a")
+    assert not [
+        n for n in os.listdir(tmp_path / "a") if n.startswith(".manifest-")
+    ]
+
+
+def test_manifest_merges_shards_last_write_wins(tmp_path):
+    store = tmp_path / "a"
+    _make_array(store)
+    # a second writer's shard (e.g. a backup task in another process):
+    # fresher timestamp wins for the shared key, unique keys merge
+    io = open_zarr_array(str(store), mode="r")._io
+    entries, _ = integrity.load_manifest(io)
+    newer = dict(entries["0.0"], c=12345, t=entries["0.0"]["t"] + 100)
+    (store / ".manifest-99999-abc.json").write_text(
+        json.dumps({"writer": "99999-abc", "entries": {"0.0": newer}})
+    )
+    merged, had = integrity.load_manifest(io)
+    assert had
+    assert merged["0.0"]["c"] == 12345
+    assert merged["0.1"] == entries["0.1"]
+
+
+def test_torn_trailing_manifest_line_tolerated(tmp_path):
+    """A crash mid-append can tear the last JSONL line; earlier lines stay
+    usable — only the torn line's chunk loses its entry."""
+    store = tmp_path / "a"
+    arr = _make_array(store)
+    shard = next(n for n in os.listdir(store) if n.startswith(".manifest-"))
+    raw = (store / shard).read_bytes()
+    (store / shard).write_bytes(raw[: len(raw) - 9])  # tear the final line
+    entries, had = integrity.load_manifest(arr._io)
+    assert had and len(entries) == 3
+    valid, corrupt, verified = arr.verify_chunks(quarantine=False)
+    assert verified and len(valid) == 3 and len(corrupt) == 1
+
+
+def test_corrupt_manifest_shard_tolerated(tmp_path):
+    """An undecodable shard is skipped: its chunks lose their entries and
+    verify as untrustworthy — never as valid, and never a crash."""
+    store = tmp_path / "a"
+    arr = _make_array(store)
+    shard = next(n for n in os.listdir(store) if n.startswith(".manifest-"))
+    (store / shard).write_bytes(b"{not json!!")
+    entries, had = integrity.load_manifest(arr._io)
+    assert had and entries == {}
+    valid, corrupt, verified = open_zarr_array(str(store), mode="r").verify_chunks(
+        quarantine=False
+    )
+    assert verified and not valid
+    assert sorted(corrupt) == ["0.0", "0.1", "1.0", "1.1"]
+    # present-but-unmanifested chunks are NOT quarantined (they may simply
+    # predate the manifest); re-running their producer overwrites in place
+    assert not [n for n in os.listdir(store) if "quarantine" in n]
+
+
+# -- verify_chunks --------------------------------------------------------
+
+
+def test_verify_chunks_detects_bitflip_and_quarantines(tmp_path):
+    store = tmp_path / "a"
+    _make_array(store)
+    _flip_byte(store / "1.0", offset=5)
+    before = get_registry().snapshot()
+    arr = open_zarr_array(str(store), mode="r")
+    valid, corrupt, verified = arr.verify_chunks()
+    assert verified
+    assert corrupt == ["1.0"]
+    assert valid == {"0.0", "0.1", "1.1"}
+    quarantined = [n for n in os.listdir(store) if n.startswith("1.0.quarantine.")]
+    assert len(quarantined) == 1
+    assert arr.nchunks_initialized == 3  # quarantine left the chunk namespace
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("chunks_corrupt_detected") == 1
+    assert delta.get("chunks_quarantined") == 1
+    assert delta.get("chunks_verified", 0) >= 4
+
+
+def test_verify_chunks_detects_truncation(tmp_path):
+    store = tmp_path / "a"
+    _make_array(store)
+    data = (store / "0.1").read_bytes()
+    (store / "0.1").write_bytes(data[: len(data) // 2])
+    _, corrupt, _ = open_zarr_array(str(store), mode="r").verify_chunks()
+    assert corrupt == ["0.1"]
+
+
+def test_verify_chunks_without_manifest_falls_back_to_existence(tmp_path):
+    with integrity.scoped("off"):
+        _make_array(tmp_path / "a")
+    arr = open_zarr_array(str(tmp_path / "a"), mode="r")
+    valid, corrupt, verified = arr.verify_chunks()
+    assert not verified  # legacy store: existence-only accounting
+    assert valid == {"0.0", "0.1", "1.0", "1.1"} and not corrupt
+
+
+# -- read-time verification ----------------------------------------------
+
+
+def test_task_scope_read_verifies_and_quarantines(tmp_path):
+    store = tmp_path / "a"
+    expected = np.arange(16.0).reshape(4, 4)
+    _make_array(store)
+    _flip_byte(store / "0.0")
+    arr = open_zarr_array(str(store), mode="r")
+    with integrity.scoped("verify"):
+        with task_scope():
+            with pytest.raises(ChunkIntegrityError) as ei:
+                arr[0:2, 0:2]
+    assert ei.value.kind == "checksum"
+    assert ei.value.chunk_key == "0.0"
+    assert ei.value.store == str(store)
+    assert [n for n in os.listdir(store) if n.startswith("0.0.quarantine.")]
+    # clean chunks still read fine under verification
+    with integrity.scoped("verify"):
+        with task_scope():
+            np.testing.assert_array_equal(arr[2:4, 2:4], expected[2:4, 2:4])
+
+
+def test_quarantined_chunk_reads_as_missing_not_fill_values(tmp_path):
+    """After quarantine the manifest entry survives, so a blind re-read
+    raises (kind="missing") instead of silently serving fill values."""
+    store = tmp_path / "a"
+    _make_array(store)
+    _flip_byte(store / "0.0")
+    with integrity.scoped("verify"):
+        with task_scope():
+            arr = open_zarr_array(str(store), mode="r")
+            with pytest.raises(ChunkIntegrityError):
+                arr[0:2, 0:2]
+            arr2 = open_zarr_array(str(store), mode="r")
+            with pytest.raises(ChunkIntegrityError) as ei:
+                arr2[0:2, 0:2]
+    assert ei.value.kind == "missing"
+
+
+def test_write_mode_does_not_verify_reads(tmp_path):
+    """The default ``write`` mode records checksums but never verifies
+    reads — corruption is caught by resume scans, not the hot path."""
+    store = tmp_path / "a"
+    _make_array(store)
+    _flip_byte(store / "0.0")
+    arr = open_zarr_array(str(store), mode="r")
+    with task_scope():
+        arr[0:2, 0:2]  # no error: mode is "write"
+
+
+def test_client_side_reads_never_verify(tmp_path):
+    """Outside a task scope even ``verify`` mode reads unchecked (the same
+    boundary fault injection uses)."""
+    store = tmp_path / "a"
+    _make_array(store)
+    _flip_byte(store / "0.0")
+    with integrity.scoped("verify"):
+        open_zarr_array(str(store), mode="r")[0:2, 0:2]
+
+
+def test_chunk_integrity_error_pickles():
+    import pickle
+
+    err = ChunkIntegrityError(
+        "boom", store="/s", chunk_key="1.2", kind="checksum",
+        expected=(1, 2), actual=(3, 4),
+    )
+    back = pickle.loads(pickle.dumps(err))
+    assert back.store == "/s" and back.chunk_key == "1.2"
+    assert back.kind == "checksum" and back.wire_payload == err.wire_payload
+
+
+# -- integrity mode knob --------------------------------------------------
+
+
+def test_env_var_overrides_mode(monkeypatch):
+    monkeypatch.setenv(integrity.INTEGRITY_ENV_VAR, "verify")
+    assert integrity.current_mode() == "verify"
+    monkeypatch.setenv(integrity.INTEGRITY_ENV_VAR, "bogus")
+    with pytest.raises(ValueError, match="integrity mode"):
+        integrity.current_mode()
+    monkeypatch.delenv(integrity.INTEGRITY_ENV_VAR)
+    assert integrity.current_mode() == "write"
+    with integrity.scoped("off"):
+        assert integrity.current_mode() == "off"
+    assert integrity.current_mode() == "write"
+
+
+def test_env_override_wins_over_scoped_spec_mode(monkeypatch):
+    """The env var is the operator's override: a Spec-level mode armed via
+    scoped(export_env=True) must neither shadow nor clobber it."""
+    monkeypatch.setenv(integrity.INTEGRITY_ENV_VAR, "verify")
+    with integrity.scoped("off", export_env=True):
+        assert integrity.current_mode() == "verify"  # env wins
+        assert os.environ[integrity.INTEGRITY_ENV_VAR] == "verify"  # unclobbered
+    assert integrity.current_mode() == "verify"
+
+
+def test_spec_rejects_invalid_mode(tmp_path):
+    import cubed_tpu as ct
+
+    with pytest.raises(ValueError, match="integrity mode"):
+        ct.Spec(work_dir=str(tmp_path), integrity="sometimes")
+    assert ct.Spec(work_dir=str(tmp_path), integrity="verify").integrity == "verify"
+
+
+# -- corrupt .zarray hardening -------------------------------------------
+
+
+def test_corrupt_zarray_read_raises_clear_error(tmp_path):
+    store = tmp_path / "a"
+    _make_array(store)
+    (store / ".zarray").write_bytes(b'{"zarr_format": 2, "shape')
+    with pytest.raises(ValueError, match="corrupt .zarray"):
+        open_zarr_array(str(store), mode="r")
+
+
+def test_corrupt_zarray_writer_mode_recreates(tmp_path):
+    """A writer-mode open with full creation parameters (the create-arrays
+    op) quarantines a corrupt .zarray and recreates it; chunk data and
+    manifests survive."""
+    store = tmp_path / "a"
+    _make_array(store)
+    (store / ".zarray").write_bytes(b"\x00garbage")
+    arr = open_zarr_array(
+        str(store), mode="a", shape=(4, 4), dtype=np.float64, chunks=(2, 2)
+    )
+    assert arr.shape == (4, 4)
+    assert [n for n in os.listdir(store) if n.startswith(".zarray.quarantine.")]
+    np.testing.assert_array_equal(arr[:], np.arange(16.0).reshape(4, 4))
+    valid, corrupt, verified = arr.verify_chunks()
+    assert verified and len(valid) == 4 and not corrupt
+
+
+# -- fsync durability (behavioral smoke) ---------------------------------
+
+
+def test_atomic_write_fsyncs_before_rename(tmp_path, monkeypatch):
+    """The temp file must be fsynced before the rename makes it visible —
+    asserted by interposition, since a real crash can't run under pytest."""
+    from cubed_tpu.storage import store as store_mod
+
+    events = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(os, "fsync", lambda fd: (events.append("fsync"), real_fsync(fd))[1])
+    monkeypatch.setattr(
+        os, "replace", lambda a, b: (events.append("replace"), real_replace(a, b))[1]
+    )
+    io = store_mod._LocalIO(str(tmp_path))
+    io.write_bytes_atomic("0", b"hello")
+    assert "fsync" in events and "replace" in events
+    assert events.index("fsync") < events.index("replace")
+    assert (tmp_path / "0").read_bytes() == b"hello"
+
+
+# -- corruption fault injection ------------------------------------------
+
+
+def test_fault_injector_corruption_deterministic_bitflip_or_truncation(tmp_path):
+    inj = FaultInjector(FaultConfig(seed=5, storage_corrupt_rate=1.0))
+    data = bytes(range(256))
+    with task_scope():
+        out1 = inj.storage_corrupt_fault("arr/0.0", data)
+        assert out1 is not None and out1 != data
+        assert len(out1) in (len(data), len(data) // 2)  # bit-flip or truncation
+        # the corruption itself is a pure function of (seed, key)
+        out2 = FaultInjector(
+            FaultConfig(seed=5, storage_corrupt_rate=1.0)
+        ).storage_corrupt_fault("arr/0.0", data)
+        assert out1 == out2
+    # outside a task scope corruption never fires
+    assert inj.storage_corrupt_fault("arr/0.0", data) is None
+
+
+def test_injected_corruption_caught_by_verification(tmp_path):
+    from cubed_tpu.runtime import faults
+
+    store = tmp_path / "a"
+    with faults.scoped({"seed": 1, "storage_corrupt_rate": 1.0}):
+        with task_scope():
+            arr = open_zarr_array(
+                str(store), mode="a", shape=(2,), dtype=np.float64, chunks=(2,)
+            )
+            arr[:] = np.arange(2.0)
+    valid, corrupt, verified = open_zarr_array(str(store), mode="r").verify_chunks()
+    assert verified and corrupt == ["0"] and not valid
