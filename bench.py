@@ -563,6 +563,30 @@ def main() -> None:
                     "chunks_corrupt_detected", 0
                 ),
                 "tasks_skipped_resume": stats.get("tasks_skipped_resume", 0),
+                # memory-guard trajectory: observe-mode exceedances,
+                # admission throttling, and peak worker RSS per config —
+                # guard overhead or pressure regressions show up here
+                # before anyone has to profile (the sampler must stay <2%
+                # wall-clock on the threaded bench, visible via elapsed)
+                "mem_guard_soft_exceeded": stats.get(
+                    "mem_guard_soft_exceeded", 0
+                ),
+                "tasks_throttled": stats.get("tasks_throttled", 0),
+                # gauge for in-process/threaded runs, heartbeat gauge for
+                # fleets, and per-op worker VmHWM (measured where each task
+                # ran, riding TaskEndEvent) for multiprocess pools whose
+                # worker-local gauges never reach the client registry
+                "worker_rss_peak": (
+                    stats.get("worker_rss_bytes_max")
+                    or stats.get("fleet_worker_rss_bytes_max")
+                    or max(
+                        (
+                            (row.get("peak_measured_mem") or 0)
+                            for row in (stats.get("per_op") or {}).values()
+                        ),
+                        default=0,
+                    )
+                ),
                 "executor_stats": stats or None,
             }
 

@@ -248,7 +248,7 @@ class Plan:
             # inherit it); a None config makes this a no-op. Arming is
             # process-global while active — same caveat as the metrics
             # registry below: concurrent computes in one process share it
-            from ..runtime import faults
+            from ..runtime import faults, memory
             from ..storage import integrity
 
             with faults.scoped(
@@ -258,6 +258,15 @@ class Plan:
                 # so spawned pool/fleet workers inherit it) for this
                 # compute's duration; None defers to env/default
                 getattr(spec, "integrity", None), export_env=True
+            ), memory.scoped(
+                # runtime memory guard: the Spec's mode (default observe)
+                # plus its allowed_mem, armed for the compute and exported
+                # so pool workers measure against the same budget; an
+                # operator CUBED_TPU_MEMORY_GUARD env var wins untouched.
+                # No spec at all -> no budget to judge against -> no guard
+                getattr(spec, "memory_guard", None),
+                allowed_mem=getattr(spec, "allowed_mem", None),
+                export_env=True,
             ):
                 executor.execute_dag(
                     dag,

@@ -25,6 +25,13 @@ from .tracer import Tracer
 
 logger = logging.getLogger(__name__)
 
+#: RSS-growth attribution is allocator-granular (arena growth, page
+#: faults, first-task lazy imports can add ~20 MB): a per-task delta
+#: within this many bytes of the projection is measurement noise, not a
+#: mis-modelled op — don't flag it. Real mis-modelling at production chunk
+#: sizes (hundreds of MB) clears this easily.
+_MEM_OVER_NOISE_FLOOR = 64 * 1024 * 1024
+
 
 class TracingCallback(Callback):
     """Record one tracer span per task/operation/compute; export on end.
@@ -158,6 +165,11 @@ class _ComputeAggregator(EventLogCallback):
         self._bytes_read: dict[str, int] = {}
         self._bytes_written: dict[str, int] = {}
         self._peaks: dict[str, int] = {}
+        #: per-op max of the memory guard's per-task RSS-growth attribution
+        #: (runtime/memory.py) — unlike process-peak VmHWM this is a true
+        #: per-task number, so comparing it against projected_mem is
+        #: meaningful
+        self._guard_peaks: dict[str, int] = {}
 
     # note: no on_task_start override — the tasks_started counter lives in
     # runtime.utils.fire_task_start, so executors can skip building start
@@ -196,6 +208,10 @@ class _ComputeAggregator(EventLogCallback):
             self._peaks[name] = max(
                 self._peaks.get(name, 0), event.peak_measured_mem_end
             )
+        if event.guard_mem_peak is not None:
+            self._guard_peaks[name] = max(
+                self._guard_peaks.get(name, 0), event.guard_mem_peak
+            )
 
     def peak_measured_mem_by_op(self) -> dict[str, int]:
         # the base class derives this from retained events; we keep it live
@@ -216,13 +232,45 @@ class _ComputeAggregator(EventLogCallback):
         per_op = {}
         for name, timing in self.op_timings.items():
             row = rows.get(name, {})
+            guard_peak = self._guard_peaks.get(name)
+            projected = row.get("projected_mem", 0)
             per_op[name] = {
                 "tasks": self._tasks.get(name, 0),
                 "wall_clock_s": timing.wall_clock,
-                "projected_mem": row.get("projected_mem", 0),
+                "projected_mem": projected,
                 "peak_measured_mem": row.get("peak_measured_mem"),
                 "bytes_read": self._bytes_read.get(name, 0),
                 "bytes_written": self._bytes_written.get(name, 0),
                 "mem_utilization": row.get("projected_mem_utilization"),
+                # the memory guard's per-task attribution: the only
+                # measured number comparable to projected_mem (VmHWM-based
+                # peak_measured_mem carries the whole process footprint)
+                "guard_peak_mem": guard_peak,
+                "mem_over_projected": bool(
+                    guard_peak is not None
+                    and projected
+                    and guard_peak > projected + _MEM_OVER_NOISE_FLOOR
+                ),
             }
         return {"per_op": per_op} if per_op else {}
+
+    def on_compute_end(self, event) -> None:
+        super().on_compute_end(event)
+        # surface mis-modelled extra_projected_mem without anyone having to
+        # open the Perfetto trace: one line naming every op whose measured
+        # per-task peak exceeded its plan-time projection. Derived from the
+        # same per_op rows executor_stats carries, so the warning and the
+        # mem_over_projected flag can never disagree
+        over = [
+            f"{name} (measured {row['guard_peak_mem']} > "
+            f"projected {row['projected_mem']})"
+            for name, row in self.summary().get("per_op", {}).items()
+            if row.get("mem_over_projected")
+        ]
+        if over:
+            logger.warning(
+                "memory projection exceeded for %d op(s): %s — consider "
+                "raising extra_projected_mem for these ops (or allowed_mem/"
+                "rechunking if the guard also fired)",
+                len(over), "; ".join(sorted(over)),
+            )

@@ -25,6 +25,20 @@ The canonical metric names used across the codebase:
   integrity layer (``storage/integrity.py``): checksum verifications,
   detected corruption, quarantined files, upstream-task recomputes, and
   the tasks a chunk-granular resume proved already done
+- ``mem_guard_soft_exceeded`` / ``mem_guard_hard_exceeded`` /
+  ``mem_guard_aborts`` / ``task_resource_failures`` — the runtime memory
+  guard (``runtime/memory.py``): observe-mode exceedances, enforce-mode
+  guard trips, actionable concurrency-1 aborts, and all
+  RESOURCE-classified task failures
+- ``tasks_throttled`` / ``mem_pressure_stepdowns`` /
+  ``mem_pressure_restores`` / ``admission_limit`` (gauge) — the admission
+  controller's adaptive concurrency degradation under memory pressure
+- ``worker_rss_bytes`` / ``fleet_worker_rss_bytes`` /
+  ``mem_host_available_bytes`` / ``mem_pressure`` (gauges) — sampler- and
+  heartbeat-reported memory telemetry (host watermarks)
+- ``worker_oom_kills`` / ``dispatch_skipped_pressured`` — OOM-killed pool
+  workers detected by exit code, and fleet dispatches rerouted away from
+  memory-pressured workers
 - ``bytes_read`` / ``bytes_written`` / ``chunks_read`` / ``chunks_written``
   — Zarr store IO (see ``accounting.py``)
 - ``virtual_bytes_read`` — reads served by virtual (never-materialized) arrays

@@ -169,6 +169,11 @@ class _WorkerConn:
         #: total tasks ever routed to this worker (load diagnostics)
         self.tasks_sent = 0
         self.alive = True
+        #: last heartbeat-reported RSS (bytes) and memory-pressure flag —
+        #: the coordinator stops dispatching to a pressured worker while
+        #: any unpressured one is live (runtime/memory.py watermarks)
+        self.rss: Optional[int] = None
+        self.pressured = False
 
 
 class Coordinator:
@@ -213,6 +218,11 @@ class Coordinator:
         self._departed: OrderedDict[str, dict] = OrderedDict()
         self.task_timeout = task_timeout
         self.timeout_strikes = timeout_strikes
+        #: optional hook mapping a worker name to its process exit code
+        #: (the executor sets it for locally spawned workers): a dropped
+        #: connection plus exitcode -9/137 reads as an OOM-killed worker,
+        #: which the WorkerLostError message then says out loud
+        self.exit_probe = None
         #: diagnostics: blob bytes actually sent vs referenced by id
         self.stats: Dict[str, int] = {
             "blobs_sent": 0, "tasks_sent": 0, "task_timeouts": 0,
@@ -285,6 +295,27 @@ class Coordinator:
             return len([w for w in self._workers if w.alive])
 
     def _drop_worker(self, conn: _WorkerConn, reason: str) -> None:
+        if (
+            self.exit_probe is not None
+            and reason != "shutdown"
+            and not reason.startswith("hung")
+        ):
+            # best-effort: the worker process usually finishes dying within
+            # a few ms of its socket resetting; -9/137 turns a cause-less
+            # "connection reset" into "likely OOM-killed". Hung-worker
+            # evictions skip this: the process is alive by definition, so
+            # the probe's brief reap-wait would only delay the eviction
+            try:
+                code = self.exit_probe(conn.name)
+            except Exception:
+                code = None
+            if code is not None:
+                hint = (
+                    " — likely OOM-killed (SIGKILL)"
+                    if code in (-9, 137)
+                    else ""
+                )
+                reason = f"{reason} (worker process exitcode {code}{hint})"
         with self._lock:
             conn.alive = False
             if conn in self._workers:
@@ -358,6 +389,18 @@ class Coordinator:
                             if entry is not None:
                                 entry[0] = time.monotonic() + self.task_timeout
                                 entry[1] = True
+                elif mtype == "heartbeat":
+                    # the worker's own memory telemetry: last RSS reading
+                    # plus its local pressure verdict (watermarks evaluated
+                    # where the memory actually is); routing skips
+                    # pressured workers while an unpressured one is live
+                    with self._lock:
+                        conn.rss = msg.get("rss")
+                        conn.pressured = bool(msg.get("pressured"))
+                    if conn.rss is not None:
+                        get_registry().gauge("fleet_worker_rss_bytes").set(
+                            conn.rss
+                        )
                 elif mtype == "blob_dropped":
                     # the worker evicted this blob from its bounded caches;
                     # forget we sent it so the next task of that op
@@ -481,8 +524,15 @@ class Coordinator:
                         f"cannot submit task: no live workers connected to "
                         f"coordinator {host}:{port}; {hint}"
                     )
+                # memory-pressured workers are passed over while any
+                # unpressured one is live (never deadlock: an all-pressured
+                # fleet still gets the least-loaded worker — the admission
+                # controller is what sheds load in that state)
+                unpressured = [w for w in live if not w.pressured]
+                if unpressured and len(unpressured) < len(live):
+                    get_registry().counter("dispatch_skipped_pressured").inc()
                 conn = min(
-                    live,
+                    unpressured or live,
                     key=lambda w: (len(w.outstanding) + len(w.ghost_ids))
                     / max(w.nthreads, 1),
                 )
@@ -499,6 +549,7 @@ class Coordinator:
                         time.monotonic() + self.task_timeout, False
                     ]
             from ..storage import integrity
+            from . import memory
             from .faults import wire_config
 
             msg = {
@@ -518,6 +569,10 @@ class Coordinator:
                 # pre-started fleet verifies (or not) exactly as the client
                 # asked for THIS compute
                 "integrity": integrity.wire_mode(),
+                # ... as does the memory-guard config (mode + allowed_mem),
+                # so workers enforce the same per-task budget the client's
+                # Spec promised
+                "memory_guard": memory.wire_config(),
             }
             try:
                 send_frame(conn.sock, msg, conn.send_lock)
@@ -558,6 +613,8 @@ class Coordinator:
                     "outstanding": len(w.outstanding),
                     "ghosts": len(w.ghost_ids),
                     "tasks_sent": w.tasks_sent,
+                    "rss": w.rss,
+                    "pressured": w.pressured,
                 }
         out["workers"] = workers
         return out
@@ -598,6 +655,8 @@ def run_worker(
     from concurrent.futures import ThreadPoolExecutor
 
     from ..storage import integrity
+    from ..utils import current_measured_mem
+    from . import memory
     from .faults import arm_from_wire, get_injector
     from .utils import execute_with_stats
 
@@ -647,6 +706,8 @@ def run_worker(
                 injector = get_injector()
             if "integrity" in msg:
                 integrity.arm_from_wire(msg.get("integrity"))
+            if "memory_guard" in msg:
+                memory.arm_from_wire(msg.get("memory_guard"))
             if injector is not None:
                 action = injector.worker_task_tick(wname)
                 if action == "crash":
@@ -769,6 +830,32 @@ def run_worker(
                 )
             except (ConnectionError, OSError):
                 stop.set()
+
+    def heartbeat_loop() -> None:
+        """RSS + memory-pressure telemetry, measured where the memory is.
+
+        The coordinator only ever *reads* these; a worker that never
+        heartbeats (older build) simply stays eligible for dispatch."""
+        while not stop.wait(1.0):
+            rss = current_measured_mem()
+            if rss is None:
+                return  # platform can't measure; nothing useful to send
+            try:
+                send_frame(
+                    sock,
+                    {
+                        "type": "heartbeat",
+                        "rss": rss,
+                        "pressured": memory.pressure_level() != "ok",
+                    },
+                    send_lock,
+                )
+            except (ConnectionError, OSError):
+                return
+
+    threading.Thread(
+        target=heartbeat_loop, name=f"worker-heartbeat-{wname}", daemon=True
+    ).start()
 
     with ThreadPoolExecutor(max_workers=max(nthreads, 1)) as pool:
         try:

@@ -31,6 +31,7 @@ import sys
 from typing import Optional
 
 from ..distributed import Coordinator, NoWorkersError
+from ..memory import AdmissionController
 from ..pipeline import (
     RecomputeResolver,
     ResumeState,
@@ -166,12 +167,36 @@ class DistributedDagExecutor(DagExecutor):
                         env=env,
                     )
                 )
+            # locally spawned workers have inspectable exit codes: a
+            # dropped connection plus -9/137 reads as OOM-killed, and the
+            # WorkerLostError message says so instead of a bare reset
+            coord.exit_probe = self._local_worker_exitcode
         try:
             coord.wait_for_workers(self.min_workers, self.worker_start_timeout)
         except TimeoutError:
             self.close()
             raise
         return coord
+
+    def _local_worker_exitcode(self, name: str):
+        """Exit code of a locally spawned worker (names ``local-<i>``), or
+        None while it still runs / for out-of-band workers. Polls briefly:
+        the process usually finishes dying within a few ms of its socket
+        resetting, and a definite code is worth a short wait."""
+        import time
+
+        if not name.startswith("local-"):
+            return None
+        try:
+            proc = self._procs[int(name.split("-", 1)[1])]
+        except (ValueError, IndexError):
+            return None
+        for _ in range(10):
+            code = proc.poll()
+            if code is not None:
+                return code
+            time.sleep(0.05)
+        return None
 
     def close(self) -> None:
         """Tear down the coordinator and any locally spawned workers."""
@@ -231,6 +256,9 @@ class DistributedDagExecutor(DagExecutor):
             compute_arrays_in_parallel = self.compute_arrays_in_parallel
         policy = resolve_policy(retry_policy or self.retry_policy, retries)
         budget = compute_retry_budget(policy, dag)
+        # one controller per compute: a worker-side OOM (RESOURCE off the
+        # wire) steps coordinator-side task admission down for all ops
+        admission = AdmissionController()
 
         coord = self._ensure_fleet()
         if coord.n_workers == 0:
@@ -273,6 +301,7 @@ class DistributedDagExecutor(DagExecutor):
                     array_names=[name for name, _ in merged],
                     executor_name=self.name,
                     recompute_resolver=resolver,
+                    admission=admission,
                 )
                 end_generation(generation, callbacks)
         else:
@@ -296,6 +325,7 @@ class DistributedDagExecutor(DagExecutor):
                     array_name=name,
                     executor_name=self.name,
                     recompute_resolver=resolver,
+                    admission=admission,
                     config=pipeline.config,
                 )
                 callbacks_on(

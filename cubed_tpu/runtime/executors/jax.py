@@ -396,19 +396,23 @@ class JaxExecutor(DagExecutor):
             fire_task_start(callbacks, name, num_tasks=primitive_op.num_tasks)
             t0 = time.time()
             self.stats["eager_ops"] += 1
-            if pipeline.function is apply_blockwise:
-                self._exec_blockwise(primitive_op, resident, budget)
-            elif pipeline.function is copy_read_to_write:
-                self._exec_rechunk(primitive_op, resident, budget)
-            elif pipeline.function is create_zarr_array:
-                # create metadata only for arrays that will actually be
-                # persisted; residency replaces the rest
-                for lazy in pipeline.mappable:
-                    if str(lazy.store) in requested_stores:
-                        lazy.create(mode="a")
-            else:  # pragma: no cover - unknown pipeline type: run it as-is
-                for m in pipeline.mappable:
-                    pipeline.function(m, config=pipeline.config)
+            # observe-only guard (see _run_segment): measure, never enforce
+            from ..memory import task_guard
+
+            with task_guard(f"eager:{name}", observe_only=True) as guard:
+                if pipeline.function is apply_blockwise:
+                    self._exec_blockwise(primitive_op, resident, budget)
+                elif pipeline.function is copy_read_to_write:
+                    self._exec_rechunk(primitive_op, resident, budget)
+                elif pipeline.function is create_zarr_array:
+                    # create metadata only for arrays that will actually be
+                    # persisted; residency replaces the rest
+                    for lazy in pipeline.mappable:
+                        if str(lazy.store) in requested_stores:
+                            lazy.create(mode="a")
+                else:  # pragma: no cover - unknown pipeline type: run as-is
+                    for m in pipeline.mappable:
+                        pipeline.function(m, config=pipeline.config)
             t1 = time.time()
             callbacks_on(
                 callbacks, "on_task_end",
@@ -420,6 +424,7 @@ class JaxExecutor(DagExecutor):
                     function_end_tstamp=t1,
                     task_result_tstamp=t1,
                     executor=self.name,
+                    guard_mem_peak=guard.measured,
                 ),
             )
             callbacks_on(
@@ -592,28 +597,38 @@ class JaxExecutor(DagExecutor):
                 callbacks, name, num_tasks=node["primitive_op"].num_tasks
             )
 
-        traced = False
-        if len(ops) > 0:
-            try:
-                traced = self._trace_segment(
-                    ops, dag, resident, budget, requested_stores
-                )
-                if traced:
-                    self.stats["segments_traced"] += 1
-                else:
-                    self.stats["segment_mem_aborts"] += 1
-            except Exception:
-                logger.exception("segment trace failed; falling back to eager")
-                self.stats["trace_failures"] += 1
-                self.stats["eager_fallbacks"] += 1
-                traced = False
-        if not traced:
-            for name, node in ops:
-                primitive_op = node["primitive_op"]
-                if primitive_op.pipeline.function is apply_blockwise:
-                    self._exec_blockwise(primitive_op, resident, budget)
-                else:
-                    self._exec_rechunk(primitive_op, resident, budget)
+        # observe-only memory guard: the fused segment is one program, not
+        # a retryable task, so enforcement (which degrades via retry) makes
+        # no sense here — but the host-RSS measurement still feeds the
+        # projected-vs-measured summary and observe-mode warnings
+        from ..memory import task_guard
+
+        seg_key = ",".join(name for name, _ in ops)
+        with task_guard(f"segment:{seg_key}", observe_only=True) as guard:
+            traced = False
+            if len(ops) > 0:
+                try:
+                    traced = self._trace_segment(
+                        ops, dag, resident, budget, requested_stores
+                    )
+                    if traced:
+                        self.stats["segments_traced"] += 1
+                    else:
+                        self.stats["segment_mem_aborts"] += 1
+                except Exception:
+                    logger.exception(
+                        "segment trace failed; falling back to eager"
+                    )
+                    self.stats["trace_failures"] += 1
+                    self.stats["eager_fallbacks"] += 1
+                    traced = False
+            if not traced:
+                for name, node in ops:
+                    primitive_op = node["primitive_op"]
+                    if primitive_op.pipeline.function is apply_blockwise:
+                        self._exec_blockwise(primitive_op, resident, budget)
+                    else:
+                        self._exec_rechunk(primitive_op, resident, budget)
 
         t1 = time.time()
         # the segment ran as ONE fused program; apportion its wall time across
@@ -635,6 +650,11 @@ class JaxExecutor(DagExecutor):
                     function_end_tstamp=end,
                     task_result_tstamp=end,
                     executor=self.name,
+                    # the guard measured the WHOLE segment: attributing
+                    # that aggregate to each member op would flag
+                    # correctly-modelled ops as over-projected, so per-op
+                    # attribution only exists for single-op segments
+                    guard_mem_peak=guard.measured if len(ops) == 1 else None,
                 ),
             )
             callbacks_on(

@@ -30,6 +30,7 @@ import os
 from typing import Optional
 
 from ...observability.metrics import get_registry
+from ..memory import AdmissionController
 from ..pipeline import (
     RecomputeResolver,
     ResumeState,
@@ -87,6 +88,65 @@ def _worker_safe_env():
         if prev_platform is None:
             os.environ.pop("JAX_PLATFORMS", None)
         os.environ.update(saved)
+
+
+#: worker exit codes that read as the kernel OOM killer's work: -9 is a
+#: SIGKILL-terminated multiprocessing child (negative signal convention),
+#: 137 is the 128+SIGKILL form a worker that re-execs (or an injected
+#: ``os._exit(137)`` chaos crash) reports
+_OOM_EXITCODES = (-9, 137)
+
+
+def _dead_worker_exitcodes(pool) -> list:
+    """Nonzero exit codes of a broken pool's worker processes.
+
+    Today a pool crash is reported cause-less ("worker process died"); the
+    exit code distinguishes an OOM-kill (SIGKILL, -9) from a segfault or a
+    plain exit, which decides whether the rebuild should also step
+    concurrency down. Reaches into ``pool._processes`` (stdlib-private but
+    stable since 3.7); best-effort — an empty list just means no
+    diagnosis, never an error. Polls briefly: BrokenProcessPool can escape
+    to the caller before the dead child is reaped (exitcode still None),
+    and a definite code is worth a short wait."""
+    import time
+
+    try:
+        procs = list((pool._processes or {}).values())
+    except Exception:
+        return []
+    for _ in range(10):
+        codes = []
+        unreaped = False
+        for p in procs:
+            try:
+                code = p.exitcode
+            except Exception:
+                continue
+            if code is None:
+                unreaped = True
+            elif code not in (0, -15):
+                # -15 (SIGTERM) is the pool's own terminate_broken cleanup
+                # tearing down SURVIVORS — reporting it would misattribute
+                # the crash to a worker that died of the cleanup
+                codes.append(code)
+        if codes or not unreaped:
+            return codes
+        time.sleep(0.05)
+    return codes
+
+
+def exitcode_hint(codes) -> str:
+    """Human-readable rendering of dead-worker exit codes, with the
+    "likely OOM-killed" hint for SIGKILL shapes."""
+    if not codes:
+        return "unknown exit code"
+    parts = []
+    for c in codes:
+        if c in _OOM_EXITCODES:
+            parts.append(f"{c} — likely OOM-killed (SIGKILL)")
+        else:
+            parts.append(str(c))
+    return "exitcode " + ", ".join(parts)
 
 
 class _ProcessTaskRunner:
@@ -159,6 +219,9 @@ class MultiprocessDagExecutor(DagExecutor):
             compute_arrays_in_parallel = self.compute_arrays_in_parallel
         policy = resolve_policy(retry_policy or self.retry_policy, retries)
         budget = compute_retry_budget(policy, dag)
+        # shared per compute: an OOM-killed worker steps task admission
+        # down for every later op, not just the one that crashed
+        admission = AdmissionController()
         state = ResumeState(quarantine=True) if resume else None
         # integrity failures detected worker-side arrive pickled; the repair
         # (re-running the producing task) runs client-side against the
@@ -202,6 +265,7 @@ class MultiprocessDagExecutor(DagExecutor):
                         array_names=[m[0] for m in merged],
                         executor_name=self.name,
                         recompute_resolver=resolver,
+                        admission=admission,
                     )
                     end_generation(generation, callbacks)
             else:
@@ -226,6 +290,7 @@ class MultiprocessDagExecutor(DagExecutor):
                         array_name=name,
                         executor_name=self.name,
                         recompute_resolver=resolver,
+                        admission=admission,
                     )
                     callbacks_on(
                         callbacks, "on_operation_end",
@@ -250,6 +315,15 @@ class MultiprocessDagExecutor(DagExecutor):
         respawn the pool in a tight loop) and draws on the compute's retry
         budget so systemic crash loops abort promptly.
 
+        The dead workers' exit codes are captured before the broken pool is
+        discarded: a SIGKILL shape (-9/137) reads as the kernel OOM killer
+        (``worker_oom_kills``), so the rebuilt pool comes back with HALF
+        the workers — re-running the same op at full process parallelism
+        would feed the same pressure that killed it — and the compute's
+        admission controller steps down with it. Other codes rebuild at
+        full size with the code in the log line instead of today's
+        cause-less generic rebuild.
+
         Note: a re-run fires ``on_task_end`` again for tasks that completed
         before the crash, so progress/history counters can exceed num_tasks
         across pool-crash retries — the same at-least-once event semantics a
@@ -263,6 +337,8 @@ class MultiprocessDagExecutor(DagExecutor):
         if budget is None:
             budget = policy.new_budget(len(inputs))
         retries = policy.retries
+        admission = map_kwargs.get("admission")
+        workers = getattr(pool, "_max_workers", self.max_workers)
         for attempt in range(retries + 1):
             try:
                 map_unordered(
@@ -271,23 +347,31 @@ class MultiprocessDagExecutor(DagExecutor):
                 )
                 return pool
             except BrokenProcessPool as exc:
+                codes = _dead_worker_exitcodes(pool)
                 pool.shutdown(wait=False, cancel_futures=True)
                 if attempt == retries:
                     raise  # caller's finally shuts down this (dead) pool
                 if not budget.consume():
                     raise budget_exhausted_error(exc, budget) from exc
+                oom = any(c in _OOM_EXITCODES for c in codes)
+                if oom:
+                    get_registry().counter("worker_oom_kills").inc()
+                    workers = max(1, workers // 2)
+                    if admission is not None:
+                        admission.step_down(workers * 2)
                 delay = policy.backoff_delay(attempt + 1)
                 get_registry().counter("pool_rebuilds").inc()
                 get_registry().histogram("retry_backoff_s").observe(delay)
                 logger.warning(
-                    "worker process died; rebuilding pool in %.3fs, "
-                    "re-running op (attempt %d/%d)",
-                    delay, attempt + 2, retries + 1,
+                    "worker process died (%s); rebuilding pool with %d "
+                    "worker(s) in %.3fs, re-running op (attempt %d/%d)",
+                    exitcode_hint(codes), workers, delay,
+                    attempt + 2, retries + 1,
                 )
                 if delay > 0:
                     time.sleep(delay)
                 pool = concurrent.futures.ProcessPoolExecutor(
-                    max_workers=self.max_workers, mp_context=ctx
+                    max_workers=workers, mp_context=ctx
                 )
         return pool
 

@@ -13,6 +13,7 @@ import time
 from typing import Optional
 
 from ...observability.metrics import get_registry
+from ..memory import count_resource_failure, resource_abort_error
 from ..pipeline import (
     RecomputeResolver,
     ResumeState,
@@ -98,12 +99,21 @@ class PythonDagExecutor(DagExecutor):
                             from .python_async import _count_integrity_failure
 
                             _count_integrity_failure(metrics, exc)
+                        if cls is Classification.RESOURCE:
+                            # the oracle already runs at concurrency 1, so
+                            # there is nothing to step down; retries still
+                            # help when host pressure is external, but an
+                            # exhausted task surfaces the actionable form
+                            count_resource_failure(metrics, exc)
                         failures += 1
                         # REQUEUE cannot arise in-process; treat it as RETRY
                         if cls is Classification.FAIL_FAST:
                             metrics.counter("task_failfast").inc()
                             raise
                         if failures > policy.retries:
+                            if cls is Classification.RESOURCE:
+                                # the oracle IS concurrency 1
+                                raise resource_abort_error(name, exc) from exc
                             raise
                         if not budget.consume():
                             raise budget_exhausted_error(exc, budget) from exc

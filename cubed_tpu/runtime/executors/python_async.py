@@ -20,10 +20,17 @@ import heapq
 import itertools
 import logging
 import time
+from collections import deque
 from typing import Callable, Dict, Iterable, Optional
 
 from ...observability.metrics import get_registry
 from ..backup import should_launch_backup
+from ..memory import (
+    AdmissionController,
+    count_resource_failure,
+    pressure_level,
+    resource_abort_error,
+)
 from ..pipeline import (
     RecomputeResolver,
     ResumeState,
@@ -88,6 +95,7 @@ def map_unordered(
     retry_policy: Optional[RetryPolicy] = None,
     retry_budget: Optional[RetryBudget] = None,
     recompute_resolver=None,
+    admission: Optional[AdmissionController] = None,
     **kwargs,
 ) -> None:
     """Run function over inputs, handling completion order, retries, backups.
@@ -111,8 +119,20 @@ def map_unordered(
     op's task for exactly that chunk, then the reader resubmits. Each
     repair consumes one retry and one budget unit, so corruption storms
     abort promptly instead of looping.
+
+    ``admission`` (a ``memory.AdmissionController``, shared across one
+    compute's maps like the budget) bounds tasks in flight under memory
+    pressure: unbounded — today's exact behavior — until a
+    RESOURCE-classified failure or a hard host-pressure watermark halves
+    it, after which submissions queue (``tasks_throttled``) until
+    completions free slots or a pressure-free success window restores the
+    limit multiplicatively. A task that fails RESOURCE even when admitted
+    at concurrency 1 aborts the compute with an actionable
+    measured-vs-allowed error instead of burning the budget.
     """
     policy = resolve_policy(retry_policy, retries)
+    if admission is None:
+        admission = AdmissionController()
     if array_names is not None:
         inputs = list(inputs)
         assert len(array_names) == len(inputs)
@@ -120,7 +140,7 @@ def map_unordered(
         _map_unordered_batch(
             executor, function, list(inputs), policy, retry_budget,
             use_backups, callbacks, array_name, array_names, executor_name,
-            recompute_resolver,
+            recompute_resolver, admission,
             **kwargs,
         )
     elif array_names is None:
@@ -132,7 +152,7 @@ def map_unordered(
             _map_unordered_batch(
                 executor, function, batch, policy, retry_budget,
                 use_backups, callbacks, array_name, None, executor_name,
-                recompute_resolver,
+                recompute_resolver, admission,
                 **kwargs,
             )
     else:
@@ -149,6 +169,7 @@ def map_unordered(
                 array_names[start : start + batch_size],
                 executor_name,
                 recompute_resolver,
+                admission,
                 **kwargs,
             )
 
@@ -165,22 +186,37 @@ def _map_unordered_batch(
     array_names: Optional[list] = None,
     executor_name: Optional[str] = None,
     recompute_resolver=None,
+    admission: Optional[AdmissionController] = None,
     **kwargs,
 ) -> None:
     metrics = get_registry()
     retries = policy.retries
     if budget is None:
         budget = policy.new_budget(len(inputs))
+    if admission is None:
+        admission = AdmissionController()
     attempts: Dict[int, int] = {i: 0 for i in range(len(inputs))}
     #: free worker-loss reroutes consumed per input (capped by the policy)
     requeues: Dict[int, int] = {}
     #: min-heap of (due time, input index) retries awaiting their backoff
     delayed: list[tuple[float, int]] = []
+    #: inputs ready to run but waiting for an admission slot (memory
+    #: pressure stepped the in-flight limit down)
+    admit_queue: deque[int] = deque()
+    #: input -> (floor failures so far, done_inputs size at the last one):
+    #: a RESOURCE failure of a task admitted ALONE (limit 1) is only fatal
+    #: on repetition with NO other task completing in between — one solo
+    #: failure can still be residual pressure draining (or, under
+    #: multi-process chaos, a per-process injector decision repeating);
+    #: zero progress between two solo failures proves degradation is spent
+    floor_strikes: Dict[int, tuple[int, int]] = {}
     start_times: Dict[object, float] = {}
     end_times: Dict[object, float] = {}
     create_times: Dict[int, float] = {}
-    # future -> (input index, is_backup, attempt number it was submitted as)
-    pending: Dict[concurrent.futures.Future, tuple[int, bool, int]] = {}
+    # future -> (input index, is_backup, attempt number it was submitted
+    # as, admission limit at submit time — None = unbounded; a RESOURCE
+    # failure of a task admitted at limit 1 is fatal, degradation is spent)
+    pending: Dict[concurrent.futures.Future, tuple[int, bool, int, Optional[int]]] = {}
     backups: Dict[int, list[concurrent.futures.Future]] = {}
     done_inputs: set[int] = set()
     #: input index -> in-flight upstream repair (RECOMPUTE): repairs run on
@@ -216,7 +252,7 @@ def _map_unordered_batch(
         # reports the attempt that actually produced the result (a backup
         # submitted as attempt 0 can win after the original fails and bumps
         # attempts[i])
-        pending[fut] = (i, is_backup, attempts[i])
+        pending[fut] = (i, is_backup, attempts[i], admission.limit)
         if is_backup:
             backups.setdefault(i, []).append(fut)
         return fut
@@ -234,17 +270,39 @@ def _map_unordered_batch(
             cancel_pending()
             raise
 
+    def admit(i: int) -> None:
+        """Submit *i* now, or queue it when the admission limit is hit.
+
+        With the controller unbounded (no memory pressure ever seen) every
+        input submits immediately — exactly the pre-guard behavior."""
+        if not admit_queue and admission.has_slot(len(pending)):
+            resubmit(i)
+            return
+        metrics.counter("tasks_throttled").inc()
+        admit_queue.append(i)
+
+    def drain_admit_queue() -> None:
+        while admit_queue and admission.has_slot(len(pending)):
+            i = admit_queue.popleft()
+            if i not in done_inputs:
+                resubmit(i)
+
     for i in range(len(inputs)):
-        submit(i)
+        admit(i)
 
     try:
-        while pending or delayed or repairing:
+        while pending or delayed or repairing or admit_queue:
             now = time.time()
             # launch retries whose backoff has elapsed
             while delayed and delayed[0][0] <= now:
                 _, i = heapq.heappop(delayed)
                 if i not in done_inputs:
-                    resubmit(i)
+                    admit(i)
+            # hard host pressure (RSS watermark / MemAvailable floor) steps
+            # concurrency down even before any task actually dies of it
+            if pressure_level() == "hard":
+                admission.on_pressure(len(pending))
+            drain_admit_queue()
             # resubmit readers whose upstream repair finished; a failed
             # repair falls back to a backoff retry (next attempt re-triggers
             # the repair — bounded, since each drew retries/budget already)
@@ -254,7 +312,7 @@ def _map_unordered_batch(
                     continue
                 rexc = rfut.exception()
                 if rexc is None:
-                    resubmit(ri)
+                    admit(ri)
                 else:
                     rdelay = policy.backoff_delay(attempts[ri])
                     logger.warning(
@@ -289,7 +347,7 @@ def _map_unordered_batch(
                     # a twin that completed in the same wait batch as its
                     # winner: the winner's cancel loop already removed it
                     continue
-                i, is_backup, attempt = entry
+                i, is_backup, attempt, limit_at_submit = entry
                 end_times[fut] = now
                 if i in done_inputs:
                     continue  # a twin already won
@@ -311,9 +369,31 @@ def _map_unordered_batch(
                             policy.max_requeues,
                         )
                         if not twins:
-                            resubmit(i)
+                            admit(i)
                         continue
                     attempts[i] += 1
+                    if cls is Classification.RESOURCE:
+                        # BEFORE twin suppression — memory pressure is
+                        # real whether or not a backup twin is still
+                        # running, and deferring the step-down until the
+                        # twin also dies would keep everything at full
+                        # concurrency for one extra OOM-pressure round.
+                        # The task (or its worker) ran out of memory:
+                        # blind full-concurrency retries recreate the
+                        # pressure, so halve the admission limit first —
+                        # and if the task was already admitted ALONE
+                        # (limit 1), degradation is spent: abort with the
+                        # actionable measured-vs-allowed error
+                        count_resource_failure(metrics, exc)
+                        if limit_at_submit == 1:
+                            strikes, done_at = floor_strikes.get(i, (0, -1))
+                            if strikes >= 1 and done_at == len(done_inputs):
+                                cancel_pending()
+                                raise resource_abort_error(
+                                    op_of(i), exc
+                                ) from exc
+                            floor_strikes[i] = (strikes + 1, len(done_inputs))
+                        admission.step_down(len(pending) + 1)
                     # suppress if a backup twin is still running
                     if twins:
                         continue
@@ -329,6 +409,12 @@ def _map_unordered_batch(
                         raise exc
                     if attempts[i] > retries:
                         cancel_pending()
+                        if cls is Classification.RESOURCE:
+                            # retries exhausted on memory: surface the
+                            # actionable form, not a bare MemoryError
+                            raise resource_abort_error(
+                                op_of(i), exc, at_floor=False
+                            ) from exc
                         raise exc
                     if not budget.consume():
                         cancel_pending()
@@ -366,12 +452,13 @@ def _map_unordered_batch(
                     metrics.counter("task_retries").inc()
                     metrics.histogram("retry_backoff_s").observe(delay)
                     if delay <= 0:
-                        resubmit(i)
+                        admit(i)
                     else:
                         heapq.heappush(delayed, (now + delay, i))
                     continue
                 _, stats = fut.result()
                 done_inputs.add(i)
+                admission.on_success(pressure_level() == "ok")
                 # cancel the losing twin(s)
                 for f in list(pending):
                     if pending[f][0] == i:
@@ -388,8 +475,10 @@ def _map_unordered_batch(
                         executor=executor_name,
                     ),
                 )
-            if use_backups:
-                for fut, (i, is_backup, _attempt) in list(pending.items()):
+            if use_backups and not admission.throttling:
+                # no speculative duplicates while degraded for memory: a
+                # backup twin is pure extra footprint
+                for fut, (i, is_backup, _attempt, _lim) in list(pending.items()):
                     if is_backup or i in done_inputs or i in backups:
                         continue
                     if should_launch_backup(fut, now, start_times, end_times):
@@ -451,6 +540,10 @@ class AsyncPythonDagExecutor(DagExecutor):
             compute_arrays_in_parallel = self.compute_arrays_in_parallel
         policy = resolve_policy(retry_policy or self.retry_policy, retries)
         budget = compute_retry_budget(policy, dag)
+        # one admission controller per compute (like the budget): a memory
+        # step-down discovered in one op carries into the next instead of
+        # rediscovering the pressure op by op
+        admission = AdmissionController()
         # chunk-granular resume: one checksum-verified scan per store, shared
         # by the op-level and task-level skips; corrupt chunks found by the
         # scan are quarantined so their tasks re-run
@@ -470,7 +563,7 @@ class AsyncPythonDagExecutor(DagExecutor):
                     )
                     self._run_tasks(
                         pool, merged, pipelines, policy, budget, use_backups,
-                        batch_size, callbacks, resolver,
+                        batch_size, callbacks, resolver, admission,
                     )
                     end_generation(generation, callbacks)
             else:
@@ -494,6 +587,7 @@ class AsyncPythonDagExecutor(DagExecutor):
                         array_name=name,
                         executor_name=self.name,
                         recompute_resolver=resolver,
+                        admission=admission,
                         config=pipeline.config,
                     )
                     callbacks_on(
@@ -503,7 +597,7 @@ class AsyncPythonDagExecutor(DagExecutor):
 
     def _run_tasks(
         self, pool, merged, pipelines, policy, budget, use_backups,
-        batch_size, callbacks, recompute_resolver=None,
+        batch_size, callbacks, recompute_resolver=None, admission=None,
     ):
         def fn(item):
             name, m = item
@@ -522,4 +616,5 @@ class AsyncPythonDagExecutor(DagExecutor):
             array_names=[name for name, _ in merged],
             executor_name=self.name,
             recompute_resolver=recompute_resolver,
+            admission=admission,
         )

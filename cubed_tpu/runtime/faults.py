@@ -41,8 +41,10 @@ Injection sites (each counted in the metrics registry under
   instead *succeed with wrong bytes* — a seeded bit-flip or truncation —
   which only the checksum layer (``storage/integrity.py``) can catch.
 - task bodies (``runtime/utils.execute_with_stats``) — raises
-  ``FaultInjectedTaskError`` (transient) or sleeps ``straggler_delay_s``
-  (what speculative backups exist for).
+  ``FaultInjectedTaskError`` (transient), sleeps ``straggler_delay_s``
+  (what speculative backups exist for), or hands the memory guard a
+  synthetic ``task_mem_spike_bytes`` allocation (``task_mem_spike_rate``)
+  so chaos tests exercise the RESOURCE/step-down path deterministically.
 - the distributed worker loop (``runtime/distributed.run_worker``) — a
   named worker hard-exits (``os._exit``) or hangs after its nth task,
   modelling OOM-kills and wedged hosts.
@@ -96,6 +98,13 @@ class FaultConfig:
     #: task body sleeps straggler_delay_s before running
     straggler_rate: float = 0.0
     straggler_delay_s: float = 0.25
+    #: probability a task "allocates" a synthetic memory spike of
+    #: task_mem_spike_bytes: the memory guard (runtime/memory.py) adds the
+    #: injected bytes to the task's measured peak, so chaos tests prove
+    #: observe/enforce behavior deterministically without real allocations
+    #: (which could genuinely OOM the test host)
+    task_mem_spike_rate: float = 0.0
+    task_mem_spike_bytes: int = 0
     #: distributed workers (by --name) that hard-exit / hang when their
     #: per-process executed-task count reaches worker_*_after_tasks (>=1)
     worker_crash_names: tuple = field(default_factory=tuple)
@@ -134,6 +143,7 @@ class FaultConfig:
             or self.storage_corrupt_rate
             or self.task_failure_rate
             or self.straggler_rate
+            or (self.task_mem_spike_rate and self.task_mem_spike_bytes)
             or (self.worker_crash_names and self.worker_crash_after_tasks)
             or (self.worker_hang_names and self.worker_hang_after_tasks)
         )
@@ -224,6 +234,21 @@ class FaultInjector:
             raise FaultInjectedTaskError(
                 f"injected task failure (seed={self.config.seed}, key={key!r})"
             )
+
+    def task_mem_spike(self, key: str) -> int:
+        """Synthetic memory-spike bytes for this task attempt (0 = none).
+
+        The guard adds these to the task's measured peak; a retry in the
+        same process rolls a fresh decision, so a spiked task usually
+        passes on re-run — modelling pressure that recedes once
+        concurrency steps down (a rate of 1.0 models a task that is
+        genuinely over budget and must abort actionably)."""
+        cfg = self.config
+        if not (cfg.task_mem_spike_rate and cfg.task_mem_spike_bytes):
+            return 0
+        if self._hit("task_mem_spike", key, cfg.task_mem_spike_rate):
+            return int(cfg.task_mem_spike_bytes)
+        return 0
 
     # -- distributed workers --------------------------------------------
 

@@ -1,0 +1,763 @@
+"""Runtime memory guard: per-task enforcement of the bounded-memory promise.
+
+The paper's headline guarantee — a bounded maximum memory per task — was
+until now a *plan-time projection* only (``projected_mem`` checked against
+``Spec.allowed_mem`` before execution). Nothing watched what a task
+actually allocated: a mis-modelled ``extra_projected_mem``, a kernel with a
+hidden copy, or plain memory pressure from too many concurrent tasks all
+surfaced as an opaque ``MemoryError`` (blind-retried at full concurrency)
+or an OOM-killed worker (indistinguishable from any other worker loss).
+This module closes the loop at runtime, the way production schedulers do
+(Ray's memory-monitor OOM prevention, Dask distributed's worker memory
+watermarks):
+
+- **Task-scope guard.** ``task_guard`` (entered by
+  ``runtime/utils.execute_with_stats`` around every task body) attributes
+  process RSS *growth* to the running task: a shared low-overhead sampler
+  thread reads ``/proc/self/status`` every ``sample_interval_s`` and keeps,
+  per active task, the peak of ``rss_now - rss_at_task_start``. When that
+  peak (plus any chaos-injected synthetic spike) exceeds ``allowed_mem``:
+  mode ``observe`` (the default) records ``mem_guard_soft_exceeded`` and
+  logs a structured warning naming the task and the measured-vs-allowed
+  bytes; mode ``enforce`` fails the task with a picklable
+  :class:`MemoryGuardExceededError`, which the resilience layer classifies
+  ``RESOURCE``. Mode ``off`` is a true no-op: no sampler thread, no
+  per-task work beyond one env lookup. Attribution under concurrency is
+  deliberately conservative-approximate — RSS is process-wide, so a
+  spike lands on every task in flight; that is the right bias for a
+  *guard* (pressure is real whether or not attribution is exact), and at
+  concurrency 1 the measurement is exact, which is when enforcement uses
+  it to produce an actionable abort.
+
+- **Host-pressure watermarks.** While tasks are active the sampler also
+  compares process RSS growth to ``allowed_mem x tasks-in-flight``: above
+  ``soft_fraction`` of it is *soft* pressure (stop growing concurrency),
+  above it is *hard* pressure (step down). ``/proc/meminfo``'s
+  ``MemAvailable`` under ``host_floor_bytes`` is hard pressure regardless
+  — when the machine is nearly out, per-process accounting is moot.
+  Exported as gauges (``worker_rss_bytes``, ``mem_host_available_bytes``,
+  ``mem_pressure``); the distributed worker heartbeats its RSS + pressure
+  flag so the coordinator stops dispatching to a pressured host.
+
+- **Admission control.** :class:`AdmissionController` (one per compute,
+  consulted by ``map_unordered``) bounds tasks in flight. On a
+  RESOURCE-classified failure or hard host pressure it *halves* the limit
+  (AIMD's multiplicative decrease — the same shape Ray/Dask use to shed
+  memory pressure); after a full window of pressure-free successes it
+  restores multiplicatively (doubling) until back to unbounded. A task
+  that fails RESOURCE even at concurrency 1 cannot be helped by
+  degradation: the compute aborts promptly with an actionable error
+  ("op X measured N bytes > allowed_mem M — raise allowed_mem or
+  rechunk") instead of burning the whole retry budget.
+
+Activation mirrors the integrity layer: ``Spec(memory_guard=...)`` (armed
+by ``Plan.execute`` for the compute, exported to the env so spawned pool
+workers inherit it), the ``CUBED_TPU_MEMORY_GUARD`` env var (operator
+override — wins everywhere), and distributed task messages mirror the
+client's config to pre-started fleets. The guard needs ``allowed_mem`` to
+judge anything, so with no Spec in play it stays inactive.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, fields
+from typing import Optional
+
+from ..observability.accounting import record_scoped_counter
+from ..observability.metrics import get_registry
+from ..utils import current_measured_mem, host_available_mem, memory_repr
+
+logger = logging.getLogger(__name__)
+
+#: env var carrying a JSON MemoryGuardConfig into child processes (and the
+#: operator's override: when set it wins over Spec-level arming)
+MEMORY_GUARD_ENV_VAR = "CUBED_TPU_MEMORY_GUARD"
+
+MODES = ("off", "observe", "enforce")
+DEFAULT_MODE = "observe"
+
+
+class MemoryGuardExceededError(RuntimeError):
+    """A task's measured memory exceeded ``allowed_mem`` under
+    ``memory_guard="enforce"``.
+
+    Picklable (it crosses pool and fleet boundaries like any task failure)
+    and structured: ``chunk_key``/``op_name`` locate the task,
+    ``measured``/``allowed`` are bytes. Classified ``RESOURCE`` by the
+    resilience layer — retried only after a concurrency step-down, and
+    fatal (with an actionable message) when it recurs at concurrency 1.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        chunk_key: Optional[str] = None,
+        measured: Optional[int] = None,
+        allowed: Optional[int] = None,
+        op_name: Optional[str] = None,
+    ):
+        super().__init__(message)
+        self.chunk_key = chunk_key
+        self.measured = measured
+        self.allowed = allowed
+        self.op_name = op_name
+
+    def __reduce__(self):
+        return (
+            MemoryGuardExceededError,
+            (
+                self.args[0] if self.args else "",
+                self.chunk_key,
+                self.measured,
+                self.allowed,
+                self.op_name,
+            ),
+        )
+
+    @property
+    def wire_payload(self) -> dict:
+        """Plain-dict form riding distributed error frames (the same
+        channel ``ChunkIntegrityError`` uses), so the coordinator-side
+        abort message can name real byte counts measured on the worker."""
+        return {
+            "chunk_key": self.chunk_key,
+            "measured": self.measured,
+            "allowed": self.allowed,
+            "op_name": self.op_name,
+            "kind": "memory_guard",
+        }
+
+
+#: remote exception class names that classify RESOURCE (resilience.py reads
+#: this so the wire table and the local isinstance checks can't drift)
+RESOURCE_TYPE_NAMES = frozenset({"MemoryError", "MemoryGuardExceededError"})
+
+
+@dataclass(frozen=True)
+class MemoryGuardConfig:
+    """What to enforce, and how aggressively to watch."""
+
+    #: "off" (true no-op) | "observe" (count + warn) | "enforce" (fail task)
+    mode: str = DEFAULT_MODE
+    #: the per-task budget (bytes) — ``Spec.allowed_mem``; 0 disables the
+    #: guard entirely (nothing to judge against)
+    allowed_mem: int = 0
+    #: sampler period; 20 ms keeps worst-case overhead well under the <2 %
+    #: wall-clock bench budget (one /proc read + a few dict walks per tick)
+    sample_interval_s: float = 0.02
+    #: host-pressure soft watermark as a fraction of allowed_mem x in-flight
+    soft_fraction: float = 0.85
+    #: MemAvailable floor below which the host is hard-pressured regardless
+    host_floor_bytes: int = 128 * 1024 * 1024
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(
+                f"invalid memory_guard mode {self.mode!r}; expected one of "
+                f"{MODES}"
+            )
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MemoryGuardConfig":
+        known = {f.name for f in fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown MemoryGuardConfig fields {sorted(unknown)}; "
+                f"known: {sorted(known)}"
+            )
+        return cls(**d)
+
+    def to_env_json(self) -> str:
+        return json.dumps({f.name: getattr(self, f.name) for f in fields(self)})
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off" and self.allowed_mem > 0
+
+
+# ----------------------------------------------------------------------
+# process-level activation (env > activated > None; mirrors integrity.py:
+# the env var is the operator's override and how children inherit arming)
+# ----------------------------------------------------------------------
+
+_lock = threading.Lock()
+_active: Optional[MemoryGuardConfig] = None
+#: (raw env string, parsed config) — parse once per distinct value
+_env_cache: tuple = (None, None)
+
+
+def _validate_mode(mode: str) -> str:
+    if mode not in MODES:
+        raise ValueError(
+            f"invalid memory_guard mode {mode!r}; expected one of {MODES}"
+        )
+    return mode
+
+
+def _coerce(config) -> MemoryGuardConfig:
+    if isinstance(config, MemoryGuardConfig):
+        return config
+    if isinstance(config, dict):
+        return MemoryGuardConfig.from_dict(config)
+    if isinstance(config, str):
+        return MemoryGuardConfig(mode=config)
+    raise TypeError(
+        f"expected MemoryGuardConfig, dict or mode string, got "
+        f"{type(config).__name__}"
+    )
+
+
+def activate(config, export_env: bool = False) -> MemoryGuardConfig:
+    """Arm the guard in this process (and, with ``export_env``, in every
+    child process spawned afterwards)."""
+    global _active, _baseline_rss
+    cfg = _coerce(config)
+    with _lock:
+        _active = cfg
+        # RSS growth is measured against the footprint at THIS arming, not
+        # absolute RSS: a fat parent (jax imported, a long test session's
+        # caches) must not read as standing pressure — and re-baselining
+        # per arming keeps a long-lived process's slow cache growth from
+        # accruing into phantom pressure across computes
+        _baseline_rss = current_measured_mem()
+    if export_env:
+        os.environ[MEMORY_GUARD_ENV_VAR] = cfg.to_env_json()
+    return cfg
+
+
+def deactivate() -> None:
+    global _active, _env_cache
+    with _lock:
+        _active = None
+        _env_cache = (None, None)
+    os.environ.pop(MEMORY_GUARD_ENV_VAR, None)
+
+
+def get_guard_config() -> Optional[MemoryGuardConfig]:
+    """The effective config, or None (unarmed — the common fast path).
+
+    The env var wins (operator override; also how spawned workers
+    self-arm); a malformed value raises loudly — a typo silently disabling
+    the memory guard would be worse than an error. Accepts either a JSON
+    config or a bare mode string (``CUBED_TPU_MEMORY_GUARD=enforce``) —
+    the bare form overrides the MODE only, inheriting ``allowed_mem`` and
+    the sampler knobs from whatever the Spec armed (an operator asking for
+    enforcement must not silently zero the budget and disable the guard)."""
+    global _env_cache, _baseline_rss
+    raw = os.environ.get(MEMORY_GUARD_ENV_VAR)
+    if raw:
+        # cache key includes the armed base config: a bare-mode override
+        # merges over it, so a new compute's arming must rebuild
+        base = _active
+        cached_key, cached_cfg = _env_cache
+        if (raw, base) == cached_key:
+            return cached_cfg
+        if raw.strip().startswith("{"):
+            cfg = MemoryGuardConfig.from_dict(json.loads(raw))
+        else:
+            mode = _validate_mode(raw.strip())
+            if base is not None:
+                cfg = MemoryGuardConfig(
+                    mode=mode,
+                    allowed_mem=base.allowed_mem,
+                    sample_interval_s=base.sample_interval_s,
+                    soft_fraction=base.soft_fraction,
+                    host_floor_bytes=base.host_floor_bytes,
+                )
+            else:
+                cfg = MemoryGuardConfig(mode=mode)
+        with _lock:
+            _env_cache = ((raw, base), cfg)
+            # a NEW env config = a new compute arming: re-baseline so
+            # growth accrued before it doesn't read as pressure
+            if cfg.enabled:
+                _baseline_rss = current_measured_mem()
+        return cfg
+    return _active
+
+
+def wire_config() -> Optional[str]:
+    """The client's current arming state, serialized for distributed task
+    messages (None = unarmed) — pre-started fleets mirror the client."""
+    cfg = get_guard_config()
+    return cfg.to_env_json() if cfg is not None else None
+
+
+_wire_cache: tuple = (None, None)
+
+
+def arm_from_wire(raw: Optional[str]) -> Optional[MemoryGuardConfig]:
+    """Fleet-worker side: adopt the guard config a task message carried
+    (None disarms, overriding any stale spawn-time env)."""
+    global _active, _wire_cache, _baseline_rss
+    if raw is None:
+        with _lock:
+            _active = None
+        return None
+    cached_raw, cached_cfg = _wire_cache
+    fresh = raw != cached_raw
+    if fresh:
+        try:
+            cached_cfg = MemoryGuardConfig.from_dict(json.loads(raw))
+        except (ValueError, TypeError):
+            logger.warning("ignoring invalid memory-guard config from wire")
+            return _active
+    with _lock:
+        _wire_cache = (raw, cached_cfg)
+        _active = cached_cfg
+        # re-baseline on a new wire config OR whenever this worker is idle
+        # (no guarded task in flight): a persistent fleet worker's slow
+        # cache growth across many computes must not accrue into phantom
+        # pressure — and back-to-back computes with an IDENTICAL Spec send
+        # identical wire strings, so "new config" alone is not enough.
+        # Idle arming ≈ a task starting with nothing else running, which
+        # is exactly when growth-so-far is nobody's working set.
+        if cached_cfg is not None and cached_cfg.enabled and (
+            fresh or not _tasks
+        ):
+            _baseline_rss = _read_rss(
+                max_age_s=cached_cfg.sample_interval_s
+            )
+    return cached_cfg
+
+
+class scoped:
+    """Arm the guard for a ``with`` block (``Plan.execute`` uses this for
+    ``Spec(memory_guard=...)``). ``mode=None`` with a known ``allowed_mem``
+    arms the default ``observe`` mode; with neither it is a no-op. Like the
+    integrity layer, a pre-existing env var is the OPERATOR's override: the
+    process-global config is still recorded (env shadows it via
+    ``get_guard_config``) but the env passes through untouched to this
+    process and every spawned worker."""
+
+    def __init__(self, mode=None, allowed_mem=None, export_env: bool = False):
+        if mode is None and allowed_mem:
+            mode = DEFAULT_MODE
+        self._config = (
+            None
+            if mode is None
+            else MemoryGuardConfig(mode=mode, allowed_mem=int(allowed_mem or 0))
+        )
+        self._export_env = export_env
+
+    def __enter__(self):
+        if self._config is None:
+            return None
+        self._prev = _active
+        self._prev_env = os.environ.get(MEMORY_GUARD_ENV_VAR)
+        return activate(
+            self._config,
+            export_env=self._export_env and self._prev_env is None,
+        )
+
+    def __exit__(self, *exc) -> None:
+        if self._config is None:
+            return
+        global _active
+        with _lock:
+            _active = self._prev
+        if self._export_env:
+            if self._prev_env is None:
+                os.environ.pop(MEMORY_GUARD_ENV_VAR, None)
+            else:
+                os.environ[MEMORY_GUARD_ENV_VAR] = self._prev_env
+
+
+# ----------------------------------------------------------------------
+# the sampler and per-task guard
+# ----------------------------------------------------------------------
+
+#: RSS at first arming — growth (not absolute RSS) is what watermarks see
+_baseline_rss: Optional[int] = None
+
+#: (monotonic ts, rss) — /proc/self/status costs ~200 us in containerized
+#: kernels, so per-task guard enter/exit must not each pay a fresh read;
+#: the sampler refreshes this every tick and tasks accept a reading up to
+#: ~1.5 ticks stale (a memory *guard* doesn't need microsecond freshness)
+_rss_cache: tuple = (0.0, None)
+
+
+def _read_rss(max_age_s: float = 0.0) -> Optional[int]:
+    global _rss_cache
+    if max_age_s > 0.0:
+        ts, val = _rss_cache
+        if val is not None and time.monotonic() - ts <= max_age_s:
+            return val
+    val = current_measured_mem()
+    if val is not None:
+        _rss_cache = (time.monotonic(), val)
+    return val
+
+#: active guarded tasks: id(guard) -> _GuardedTask
+_tasks: dict = {}
+_tasks_lock = threading.Lock()
+_tasks_present = threading.Event()
+
+_sampler_thread: Optional[threading.Thread] = None
+
+#: "ok" | "soft" | "hard" — written by the sampler, read by admission
+_pressure_level = "ok"
+
+
+class _GuardedTask:
+    __slots__ = ("key", "start_rss", "injected", "peak_delta")
+
+    def __init__(self, key: str, start_rss: int, injected: int):
+        self.key = key
+        self.start_rss = start_rss
+        self.injected = injected
+        self.peak_delta = 0
+
+
+def _ensure_sampler() -> None:
+    global _sampler_thread
+    if _sampler_thread is not None and _sampler_thread.is_alive():
+        return
+    with _lock:
+        if _sampler_thread is not None and _sampler_thread.is_alive():
+            return
+        _sampler_thread = threading.Thread(
+            target=_sampler_loop, name="mem-guard-sampler", daemon=True
+        )
+        _sampler_thread.start()
+
+
+def _sample_once(cfg: MemoryGuardConfig, tasks: list) -> None:
+    global _pressure_level
+    rss = _read_rss()  # fresh read; keeps the shared cache warm for tasks
+    if rss is None:
+        return
+    for t in tasks:
+        delta = rss - t.start_rss
+        if delta > t.peak_delta:
+            t.peak_delta = delta
+    reg = get_registry()
+    reg.gauge("worker_rss_bytes").set(rss)
+    # host watermarks: growth over the arming-time baseline vs what the
+    # bounded-memory model says this many concurrent tasks may use
+    base = _baseline_rss if _baseline_rss is not None else rss
+    growth = max(0, rss - base)
+    watermark = cfg.allowed_mem * max(1, len(tasks))
+    level = "ok"
+    if growth > watermark:
+        level = "hard"
+    elif growth > cfg.soft_fraction * watermark:
+        level = "soft"
+    avail = host_available_mem()
+    if avail is not None:
+        reg.gauge("mem_host_available_bytes").set(avail)
+        if avail < cfg.host_floor_bytes:
+            level = "hard"
+    if level != _pressure_level:
+        logger.debug("memory pressure level: %s -> %s", _pressure_level, level)
+    _pressure_level = level
+    reg.gauge("mem_pressure").set({"ok": 0, "soft": 1, "hard": 2}[level])
+
+
+def _sampler_loop() -> None:
+    global _pressure_level
+    while True:
+        if not _tasks_present.wait(timeout=5.0):
+            continue
+        cfg = get_guard_config()
+        with _tasks_lock:
+            tasks = list(_tasks.values())
+        if not tasks or cfg is None or not cfg.enabled:
+            # the last guard exited between the wait and here — or the
+            # compute disarmed (abort path) while already-running task
+            # threads are still inside their guards, which keeps
+            # _tasks_present set: sleep, or this branch busy-spins a core
+            # until the last straggler task finishes
+            _pressure_level = "ok"
+            time.sleep(0.05)
+            continue
+        _sample_once(cfg, tasks)
+        time.sleep(cfg.sample_interval_s)
+
+
+def pressure_level() -> str:
+    """The sampler's latest host-pressure reading ("ok" when the guard is
+    inactive). Cheap — a module attribute read — so admission paths can
+    consult it per loop iteration."""
+    if get_guard_config() is None:
+        return "ok"
+    return _pressure_level
+
+
+class task_guard:
+    """Context manager guarding one task body (see module docstring).
+
+    ``injected_bytes`` is the chaos injector's synthetic memory spike: it
+    adds to the measured peak so seeded chaos tests can deterministically
+    exercise observe/enforce behavior without actually allocating (and
+    risking a real OOM of the test host).
+
+    ``observe_only=True`` coerces ``enforce`` down to ``observe`` — used by
+    the JAX executor, where the guarded unit is a whole fused segment, not
+    a retryable task, so failing it would abort the compute rather than
+    trigger degradation.
+    """
+
+    _INACTIVE = object()
+
+    def __init__(
+        self, key: str = "", injected_bytes: int = 0, observe_only: bool = False
+    ):
+        self._key = key
+        self._injected = int(injected_bytes or 0)
+        self._observe_only = observe_only
+        self._task: Optional[_GuardedTask] = None
+        self._cfg: Optional[MemoryGuardConfig] = None
+        #: peak RSS growth attributed to this task (+ injected spike);
+        #: None while inactive
+        self.measured: Optional[int] = None
+
+    def __enter__(self) -> "task_guard":
+        cfg = get_guard_config()
+        if cfg is None or not cfg.enabled:
+            return self
+        start = _read_rss(max_age_s=cfg.sample_interval_s * 1.5)
+        if start is None:
+            return self  # no /proc: the guard cannot measure here
+        self._cfg = cfg
+        self._task = _GuardedTask(self._key, start, self._injected)
+        with _tasks_lock:
+            _tasks[id(self)] = self._task
+            _tasks_present.set()
+        _ensure_sampler()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        global _pressure_level
+        task = self._task
+        if task is None:
+            return
+        with _tasks_lock:
+            _tasks.pop(id(self), None)
+            if not _tasks:
+                _tasks_present.clear()
+                # no tasks in flight = no watermark to exceed: a stale
+                # "hard" reading must not step down some later compute
+                _pressure_level = "ok"
+        # one final sample so short tasks (shorter than a sampler period)
+        # still measure their live allocations at completion; cached up to
+        # ~1.5 ticks — the sampler keeps it warm, so steady-state guarded
+        # tasks pay dict lookups here, not ~200 us /proc reads
+        rss = _read_rss(max_age_s=self._cfg.sample_interval_s * 1.5)
+        if rss is not None:
+            delta = rss - task.start_rss
+            if delta > task.peak_delta:
+                task.peak_delta = delta
+        self.measured = max(0, task.peak_delta) + task.injected
+        if exc_type is not None:
+            return  # the body already failed; never mask its error
+        cfg = self._cfg
+        if self.measured <= cfg.allowed_mem:
+            return
+        if self._observe_only:
+            # the guarded unit is NOT a single task (a fused JAX segment,
+            # a whole eager op): comparing its aggregate growth against the
+            # PER-TASK budget would pollute mem_guard_soft_exceeded and
+            # spam warnings for correctly-modelled work — measure only
+            return
+        if cfg.mode == "enforce":
+            raise MemoryGuardExceededError(
+                f"task {self._key or '<unnamed>'} measured "
+                f"{memory_repr(self.measured)} ({self.measured} bytes) > "
+                f"allowed_mem {memory_repr(cfg.allowed_mem)} "
+                f"({cfg.allowed_mem} bytes)",
+                chunk_key=self._key,
+                measured=self.measured,
+                allowed=cfg.allowed_mem,
+            )
+        # observe: per-task attribution rides the task's scope counters
+        # back to the client registry (surviving process boundaries)
+        record_scoped_counter("mem_guard_soft_exceeded")
+        logger.warning(
+            "memory guard (observe): task %s measured %s (%d bytes) > "
+            "allowed_mem %s (%d bytes) — enforcement is off; set "
+            "memory_guard='enforce' to fail such tasks, or raise "
+            "allowed_mem / rechunk",
+            self._key or "<unnamed>",
+            memory_repr(self.measured),
+            self.measured,
+            memory_repr(cfg.allowed_mem),
+            cfg.allowed_mem,
+        )
+
+    def stats(self) -> dict:
+        """The guard's contribution to the task stats dict ({} while
+        inactive, so ``memory_guard="off"`` stays byte-identical)."""
+        if self.measured is None:
+            return {}
+        return {"guard_mem_peak": self.measured}
+
+
+# ----------------------------------------------------------------------
+# admission control
+# ----------------------------------------------------------------------
+
+
+class AdmissionController:
+    """AIMD-style concurrency limiter shared by one compute's maps.
+
+    Unbounded (``limit is None``) until the first step-down, so computes
+    that never hit memory pressure pay nothing and behave exactly as
+    before. ``step_down`` halves (multiplicative decrease), ``on_success``
+    doubles back after a full pressure-free window of successes
+    (multiplicative restore), returning to unbounded once the limit covers
+    the highest concurrency ever seen.
+    """
+
+    #: minimum seconds between pressure-triggered step-downs, so one
+    #: sustained pressure episode doesn't collapse the limit to 1 instantly
+    PRESSURE_COOLDOWN_S = 1.0
+
+    def __init__(self):
+        self.limit: Optional[int] = None
+        self._max_seen = 1
+        self._streak = 0
+        self._last_stepdown = 0.0
+        self._lock = threading.Lock()
+
+    def has_slot(self, in_flight: int) -> bool:
+        with self._lock:
+            if in_flight > self._max_seen:
+                self._max_seen = in_flight
+            return self.limit is None or in_flight < self.limit
+
+    @property
+    def throttling(self) -> bool:
+        return self.limit is not None
+
+    def step_down(self, in_flight: int) -> int:
+        """Halve the in-flight limit (RESOURCE failure observed)."""
+        reg = get_registry()
+        with self._lock:
+            base = self.limit if self.limit is not None else max(1, in_flight)
+            new = max(1, base // 2)
+            if self.limit is None or new < self.limit:
+                # WARN once on entering degraded mode; further halvings
+                # (and AIMD flapping around the sustainable level) are
+                # normal operation under pressure — INFO, not 30 warnings
+                log = logger.warning if self.limit is None else logger.info
+                self.limit = new
+                self._streak = 0
+                self._last_stepdown = time.monotonic()
+                reg.counter("mem_pressure_stepdowns").inc()
+                reg.gauge("admission_limit").set(new)
+                log(
+                    "memory pressure: concurrency stepped down to %d "
+                    "in-flight task(s)", new,
+                )
+            return self.limit
+
+    def on_pressure(self, in_flight: int) -> None:
+        """Hard host pressure observed (sampler watermark): step down at
+        most once per cooldown window."""
+        with self._lock:
+            if time.monotonic() - self._last_stepdown < self.PRESSURE_COOLDOWN_S:
+                return
+            if self.limit is not None and in_flight < self.limit:
+                return  # already below the limit; let it drain
+        self.step_down(in_flight)
+
+    def on_success(self, pressure_ok: bool = True) -> None:
+        """A task completed; restore multiplicatively after a full window
+        of successes with no pressure."""
+        with self._lock:
+            if self.limit is None:
+                return
+            if not pressure_ok:
+                self._streak = 0
+                return
+            self._streak += 1
+            if self._streak < self.limit:
+                return
+            self._streak = 0
+            new = self.limit * 2
+            reg = get_registry()
+            reg.counter("mem_pressure_restores").inc()
+            if new >= self._max_seen:
+                self.limit = None
+                reg.gauge("admission_limit").set(self._max_seen)
+                logger.info("memory pressure receded: concurrency unbounded")
+            else:
+                self.limit = new
+                reg.gauge("admission_limit").set(new)
+                logger.info(
+                    "memory pressure receding: concurrency restored to %d", new
+                )
+
+
+# ----------------------------------------------------------------------
+# client-side failure accounting + the actionable abort
+# ----------------------------------------------------------------------
+
+
+def count_resource_failure(metrics, exc: BaseException) -> None:
+    """Count a RESOURCE-classified failure client-side.
+
+    Like integrity detection, the failing task's scope (where the guard
+    would have counted) is discarded on failure, so the completion loop
+    counts — once per failure it actually observes, for every executor
+    (local raise, pickled from a pool worker, or off the fleet wire)."""
+    metrics.counter("task_resource_failures").inc()
+    if isinstance(exc, MemoryGuardExceededError) or (
+        getattr(exc, "remote_type", None) == "MemoryGuardExceededError"
+    ):
+        metrics.counter("mem_guard_hard_exceeded").inc()
+
+
+def _guard_details(exc: BaseException) -> tuple:
+    """(measured, allowed, chunk_key) from a guard error, whether local,
+    unpickled, or a RemoteTaskError carrying the wire payload."""
+    measured = getattr(exc, "measured", None)
+    allowed = getattr(exc, "allowed", None)
+    key = getattr(exc, "chunk_key", None)
+    payload = getattr(exc, "remote_payload", None)
+    if measured is None and isinstance(payload, dict):
+        if payload.get("kind") == "memory_guard":
+            measured = payload.get("measured")
+            allowed = payload.get("allowed")
+            key = key or payload.get("chunk_key")
+    return measured, allowed, key
+
+
+def resource_abort_error(
+    op_name: Optional[str], exc: BaseException, at_floor: bool = True
+) -> MemoryGuardExceededError:
+    """The actionable fail-fast for a task that exceeds memory even at
+    concurrency 1 (``at_floor``) or after exhausting its retries under
+    memory pressure: degradation cannot help, only a bigger budget or
+    smaller chunks can."""
+    get_registry().counter("mem_guard_aborts").inc()
+    measured, allowed, key = _guard_details(exc)
+    if measured is not None and allowed is not None:
+        detail = (
+            f"measured {memory_repr(measured)} ({measured} bytes) > "
+            f"allowed_mem {memory_repr(allowed)} ({allowed} bytes)"
+        )
+    else:
+        detail = f"failed with {type(exc).__name__} ({exc})"
+    context = (
+        "even at concurrency 1"
+        if at_floor
+        else "after exhausting its retries under memory pressure"
+    )
+    return MemoryGuardExceededError(
+        f"op {op_name or '<unknown>'} {detail} {context} — reduced "
+        "concurrency cannot help: raise allowed_mem, or rechunk to "
+        "smaller chunks (adjust extra_projected_mem if the projection "
+        "was trusted)",
+        chunk_key=key,
+        measured=measured,
+        allowed=allowed,
+        op_name=op_name,
+    )

@@ -14,7 +14,11 @@ worth anything:
   backoff then re-run, consuming one of the task's ``retries``), and
   ``REQUEUE`` (infrastructure took the *worker*, not the task —
   ``WorkerLostError`` — so the task reroutes to a survivor without
-  consuming a user-visible retry). Unknown exception types default to
+  consuming a user-visible retry), and ``RESOURCE`` (``MemoryError`` /
+  memory-guard trips / OOM-killed workers: retried only after the
+  admission controller steps concurrency down — runtime/memory.py — and
+  fatal with an actionable error at concurrency 1). Unknown exception
+  types default to
   ``RETRY``: user task code raises arbitrary types and the reference
   runtime retries everything, so the deny-list fails fast only on types
   that are near-certainly deterministic.
@@ -67,6 +71,12 @@ class Classification(enum.Enum):
     #: PRODUCING op's task for that chunk must re-run first, then the reader
     #: retries — each repair drawing one unit of the compute's retry budget
     RECOMPUTE = "recompute"
+    #: the task ran out of MEMORY (``MemoryError``, a memory-guard trip, an
+    #: OOM-killed worker): load-dependent like RETRY, but blind retries at
+    #: full concurrency recreate the very pressure that killed it — retry
+    #: only after the admission controller steps concurrency down, and fail
+    #: fast with an actionable error if it recurs at concurrency 1
+    RESOURCE = "resource"
 
 
 class RetryBudgetExceededError(RuntimeError):
@@ -167,7 +177,14 @@ class RetryPolicy:
 
         from ..storage.integrity import ChunkIntegrityError
         from .distributed import RemoteTaskError, WorkerLostError
+        from .memory import RESOURCE_TYPE_NAMES, MemoryGuardExceededError
 
+        if isinstance(exc, (MemoryError, MemoryGuardExceededError)):
+            # the task ran out of memory (or the runtime guard caught it
+            # about to): retrying at full concurrency recreates the
+            # pressure — RESOURCE retries go through a concurrency
+            # step-down first (runtime/memory.AdmissionController)
+            return Classification.RESOURCE
         if isinstance(exc, ChunkIntegrityError):
             # a corrupt input chunk was detected (and quarantined): the
             # upstream producer's task must re-run before this one retries.
@@ -189,6 +206,10 @@ class RetryPolicy:
                 # integrity failures classify RECOMPUTE across the wire too
                 # (the structured payload rides in exc.remote_payload)
                 return Classification.RECOMPUTE
+            if getattr(exc, "remote_type", None) in RESOURCE_TYPE_NAMES:
+                # a worker-side OOM / guard trip classifies RESOURCE across
+                # the wire too (measured/allowed bytes ride remote_payload)
+                return Classification.RESOURCE
             # Import errors are excluded from remote fail-fast: on a
             # heterogeneous fleet a missing module is a property of ONE
             # host's environment, and a retry may route to a correctly
@@ -203,8 +224,8 @@ class RetryPolicy:
         if _fail_fast_by_mro(exc):
             return Classification.FAIL_FAST
         # everything else — OSError and friends, TimeoutError,
-        # TaskTimeoutError, BrokenProcessPool, MemoryError (load-dependent),
-        # plain RuntimeError from user code — is worth another attempt
+        # TaskTimeoutError, BrokenProcessPool, plain RuntimeError from user
+        # code — is worth another attempt
         return Classification.RETRY
 
     # -- backoff --------------------------------------------------------

@@ -82,6 +82,11 @@ class TaskEndEvent:
     #: observability/accounting.py ``record_scoped_counter``), measured
     #: where the task ran and folded into the client registry like bytes
     counters: Optional[dict] = None
+    #: peak RSS growth the memory guard attributed to this task (bytes),
+    #: measured where it ran (runtime/memory.py); None when the guard was
+    #: off or couldn't measure — per-op maxima feed the projected-vs-
+    #: measured summary in ``ComputeEndEvent.executor_stats``
+    guard_mem_peak: Optional[int] = None
 
 
 class Callback:

@@ -35,24 +35,35 @@ def execute_with_stats(function, *args, **kwargs):
     inject task-level faults: an armed ``FaultInjector`` may sleep an
     artificial straggler delay or raise a (transient-classified) injected
     failure before the body runs — inside the task scope, so the retry
-    machinery sees it exactly like a real task failure.
+    machinery sees it exactly like a real task failure. It is likewise
+    where the runtime memory guard (``runtime/memory.task_guard``) watches
+    the body: per-task RSS-growth attribution measured in whichever process
+    ran it, riding back in the stats dict like the byte counters — and,
+    under ``memory_guard="enforce"``, failing the task with a picklable
+    ``MemoryGuardExceededError`` when it exceeds ``allowed_mem``.
     """
     from .faults import get_injector
+    from .memory import task_guard
 
     peak_before = peak_measured_mem()
     with task_scope() as scope:
         injector = get_injector()
+        key = chunk_key(args[0]) if args else ""
+        spike = 0
         if injector is not None:
-            injector.task_fault(chunk_key(args[0]) if args else "")
-        start = time.time()
-        result = function(*args, **kwargs)
-        end = time.time()
+            injector.task_fault(key)
+            spike = injector.task_mem_spike(key)
+        with task_guard(key, injected_bytes=spike) as guard:
+            start = time.time()
+            result = function(*args, **kwargs)
+            end = time.time()
     peak_after = peak_measured_mem()
     return result, dict(
         function_start_tstamp=start,
         function_end_tstamp=end,
         peak_measured_mem_start=peak_before,
         peak_measured_mem_end=peak_after,
+        **guard.stats(),
         **scope.stats(),
     )
 

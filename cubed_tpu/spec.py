@@ -32,6 +32,7 @@ class Spec:
         executor_options: Optional[dict] = None,
         fault_injection: Optional[Any] = None,
         integrity: Optional[str] = None,
+        memory_guard: Optional[str] = None,
     ):
         self._work_dir = work_dir
         self._reserved_mem = convert_to_bytes(reserved_mem or 0)
@@ -55,6 +56,15 @@ class Spec:
                     f"{MODES}"
                 )
         self._integrity = integrity
+        if memory_guard is not None:
+            from .runtime.memory import MODES as GUARD_MODES
+
+            if memory_guard not in GUARD_MODES:
+                raise ValueError(
+                    f"invalid memory_guard mode {memory_guard!r}; expected "
+                    f"one of {GUARD_MODES}"
+                )
+        self._memory_guard = memory_guard
 
     @property
     def work_dir(self) -> Optional[str]:
@@ -114,6 +124,18 @@ class Spec:
         ``Plan.execute`` arms a non-None value for the compute's duration
         (storage/integrity.py)."""
         return self._integrity
+
+    @property
+    def memory_guard(self) -> Optional[str]:
+        """Runtime memory-guard mode: ``"off"`` (true no-op), ``"observe"``
+        (count + warn when a task's measured memory exceeds
+        ``allowed_mem`` — the effective default), or ``"enforce"`` (fail
+        such tasks with ``MemoryGuardExceededError``, classified RESOURCE:
+        retried only after a concurrency step-down). ``None`` defers to
+        the ``CUBED_TPU_MEMORY_GUARD`` env var or the ``observe`` default;
+        ``Plan.execute`` arms the mode together with this spec's
+        ``allowed_mem`` for the compute's duration (runtime/memory.py)."""
+        return self._memory_guard
 
     def __repr__(self) -> str:
         return (

@@ -235,6 +235,45 @@ def peak_measured_mem() -> int:
     return ru_maxrss * 1024
 
 
+def current_measured_mem() -> int | None:
+    """Current RSS of this process in bytes, or None when unmeasurable.
+
+    The runtime memory guard (runtime/memory.py) samples this to attribute
+    RSS *growth* to running tasks. Like :func:`peak_measured_mem` it reads
+    ``/proc/self/status`` (VmRSS) rather than anything rusage-derived —
+    there is no instantaneous-RSS rusage field at all, and the guard must
+    never inherit a fork/exec parent's footprint as its own. Platforms
+    without ``/proc`` return None and the guard stays inactive (tests
+    needing it carry the ``mem`` marker and auto-skip there)."""
+    if platform.system() != "Linux":
+        return None
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def host_available_mem() -> int | None:
+    """``MemAvailable`` from ``/proc/meminfo`` in bytes, or None.
+
+    The memory guard's host-pressure floor: when the whole machine is
+    nearly out of memory, per-process accounting is moot — back off."""
+    if platform.system() != "Linux":
+        return None
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
 # ---------------------------------------------------------------------------
 # Nested-structure helpers
 # ---------------------------------------------------------------------------

@@ -66,9 +66,32 @@ def pytest_configure(config):
         "markers",
         "chaos: deterministic fault-injection chaos tests (seeded, tier-1)",
     )
+    config.addinivalue_line(
+        "markers",
+        "mem: memory-guard sampler tests (need a readable /proc; "
+        "auto-skipped on platforms without one)",
+    )
+
+
+def _proc_mem_readable() -> bool:
+    """True when the memory guard can measure here (Linux /proc)."""
+    try:
+        from cubed_tpu.utils import current_measured_mem
+
+        return current_measured_mem() is not None
+    except Exception:
+        return False
 
 
 def pytest_collection_modifyitems(config, items):
+    if not _proc_mem_readable():
+        skip_mem = pytest.mark.skip(
+            reason="no readable /proc: the memory-guard sampler cannot "
+            "measure RSS on this platform"
+        )
+        for item in items:
+            if "mem" in item.keywords:
+                item.add_marker(skip_mem)
     if config.getoption("--runslow"):
         return
     skip_slow = pytest.mark.skip(reason="need --runslow option to run")

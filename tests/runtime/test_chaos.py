@@ -155,6 +155,16 @@ def test_chaos_distributed_worker_crash_mid_compute(tmp_path, monkeypatch):
         assert ex._coordinator.n_workers >= 1  # the survivor carried it
         delta = get_registry().snapshot_delta(before)
         assert delta.get("worker_loss_requeues", 0) >= 1, delta
+        # pool-death diagnostics: the injected hard-exit (os._exit(137), a
+        # SIGKILL shape) is attributed via the local-worker exit probe, so
+        # the drop reason — and every WorkerLostError built from it — names
+        # the exit code with the OOM hint instead of a bare reset
+        departed = ex._coordinator.stats_snapshot()["workers"]
+        assert any(
+            "exitcode 137" in str(row.get("reason", ""))
+            and "likely OOM-killed" in str(row.get("reason", ""))
+            for row in departed.values()
+        ), departed
     finally:
         ex.close()
 

@@ -55,7 +55,9 @@ def test_programming_errors_fail_fast(exc):
         ConnectionResetError(),
         TimeoutError("slow"),
         TaskTimeoutError("task 3 exceeded 8s"),
-        MemoryError(),  # load-dependent, not deterministic
+        # NOTE: MemoryError is no longer here — it classifies RESOURCE
+        # (retry only after a concurrency step-down; tests/runtime/
+        # test_memory_guard.py), not plain RETRY
         RuntimeError("unknown user error"),  # unknown types default to retry
         FaultInjectedIOError("injected"),
         FaultInjectedTaskError("injected"),
