@@ -592,6 +592,7 @@ def main() -> None:
 
     # per-op timing / IO-byte trajectories ride alongside the headline
     # numbers so future rounds can localize regressions without re-profiling
+    prev_trajectory = _previous_trajectory()
     try:
         path = os.path.join(REPO, "BENCH_METRICS.json")
         tmp = path + ".tmp"
@@ -603,6 +604,93 @@ def main() -> None:
         os.replace(tmp, path)
     except OSError as e:
         print(f"could not write BENCH_METRICS.json: {e}", file=sys.stderr)
+    _print_trajectory_deltas(metrics_record, prev_trajectory)
+
+
+def _previous_trajectory():
+    """The most recent prior bench record to compare this run against.
+
+    Prefers a previous ``BENCH_METRICS.json`` (full per-config elapsed +
+    peak-RSS), falling back to the newest committed ``BENCH_r*.json``
+    driver record (throughput-only, parsed from its emitted tail lines).
+    Returns ``(configs_dict, label)``; empty dict when there is nothing.
+    """
+    path = os.path.join(REPO, "BENCH_METRICS.json")
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+        configs = prev.get("configs") or {}
+        if configs:
+            return configs, f"BENCH_METRICS.json ({prev.get('t', '?')})"
+    except (OSError, ValueError):
+        pass
+    import glob
+
+    best: dict = {}
+    label = ""
+    for p in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        metrics = {}
+        for ln in str(rec.get("tail") or "").splitlines():
+            try:
+                d = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(d, dict) and "metric" in d and "value" in d:
+                metrics[d["metric"]] = {"value": d["value"]}
+        if metrics:
+            best, label = metrics, os.path.basename(p)
+    return best, label
+
+
+def _delta_pct(cur, old):
+    if not isinstance(cur, (int, float)) or not isinstance(old, (int, float)):
+        return None
+    if old == 0:
+        return None
+    return (cur - old) / old * 100.0
+
+
+def _print_trajectory_deltas(metrics_record: dict, prev_trajectory) -> None:
+    """One line per config vs the previous trajectory (stderr — stdout's
+    last line belongs to the driver), so the bench history stops being
+    write-only: a wall-clock or peak-RSS regression is visible in the run
+    output itself, without anyone diffing JSON files."""
+    prev, label = prev_trajectory
+    if not prev:
+        print("trajectory: no previous bench record to compare against",
+              file=sys.stderr)
+        return
+    for metric, cur in metrics_record.items():
+        old = prev.get(metric)
+        if not isinstance(old, dict):
+            print(f"trajectory {metric}: new config (no prior record in "
+                  f"{label})", file=sys.stderr)
+            continue
+        parts = []
+        for key, name, fmt in (
+            ("elapsed", "wall", "{:.2f}s"),
+            ("worker_rss_peak", "peak-rss", "{:.0f}B"),
+            ("value", "throughput", "{:.3f}"),
+        ):
+            pct = _delta_pct(cur.get(key), old.get(key))
+            if pct is None:
+                continue
+            # wall clock / RSS: up is worse; throughput: up is better
+            worse = pct > 0 if key != "value" else pct < 0
+            tag = "regressed" if abs(pct) >= 5 and worse else (
+                "improved" if abs(pct) >= 5 else "~flat")
+            parts.append(
+                f"{name} {fmt.format(cur[key])} vs {fmt.format(old[key])} "
+                f"({pct:+.1f}%, {tag})"
+            )
+        if parts:
+            print(f"trajectory {metric}: " + "; ".join(parts) +
+                  f"  [vs {label}]", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import inspect
 import logging
+import os
 import shutil
 import tempfile
 import uuid
@@ -233,25 +234,66 @@ class Plan:
         # every compute carries an aggregator: it folds per-task stats
         # (completion counts, storage bytes measured where each task ran)
         # into the process metrics registry and builds the per-op summary
+        from ..observability import logs
         from ..observability.callback import _ComputeAggregator
+        from ..observability.collect import TraceCollector
+        from ..observability.flightrecorder import (
+            FLIGHT_RECORDER_ENV_VAR,
+            FlightRecorder,
+        )
         from ..observability.metrics import get_registry
 
+        #: correlates this compute's trace, structured logs, flight bundle
+        compute_id = f"c-{uuid.uuid4().hex[:10]}"
         aggregator = _ComputeAggregator()
         all_callbacks = list(callbacks) if callbacks else []
         all_callbacks.append(aggregator)
+        recorder_dir = os.environ.get(FLIGHT_RECORDER_ENV_VAR)
+        if recorder_dir and not any(
+            isinstance(cb, TraceCollector) for cb in all_callbacks
+        ):
+            # operator-armed post-mortems: every compute records, bundles
+            # are only written on failure. Suppressed when the caller
+            # already attached ANY collector (FlightRecorder included) —
+            # two collectors would double-count the spans_dropped/
+            # stragglers_detected counters and duplicate scheduler-lane
+            # straggler instants; a caller who wants both a loose trace
+            # AND bundles should attach one FlightRecorder and export from
+            # it (observability/flightrecorder.py)
+            all_callbacks.append(FlightRecorder(bundle_dir=recorder_dir))
         metrics_before = get_registry().snapshot()
 
-        callbacks_on(all_callbacks, "on_compute_start", ComputeStartEvent(dag, resume))
+        callbacks_on(
+            all_callbacks, "on_compute_start",
+            ComputeStartEvent(dag, resume, compute_id=compute_id),
+        )
+        compute_error: Optional[BaseException] = None
         try:
             # Spec-level chaos config arms fault injection for this
             # compute's duration (exported to the env so spawned workers
             # inherit it); a None config makes this a no-op. Arming is
             # process-global while active — same caveat as the metrics
             # registry below: concurrent computes in one process share it
+            from ..observability import accounting
             from ..runtime import faults, memory
             from ..storage import integrity
 
-            with faults.scoped(
+            with logs.compute_scope(
+                # log-correlation context: every client/pool/fleet log line
+                # emitted under this compute carries its id (the env export
+                # is how spawned pool workers inherit it; fleet workers get
+                # it from each task message)
+                compute_id, export_env=True
+            ), accounting.spans_scoped(
+                # span recording is pay-for-what-you-watch: armed only while
+                # a collector is attached to merge the spans (exported to
+                # the env for pool spawns; fleet task messages mirror it).
+                # None leaves an operator's CUBED_TPU_TASK_SPANS untouched
+                True if any(
+                    isinstance(cb, TraceCollector) for cb in all_callbacks
+                ) else None,
+                export_env=True,
+            ), faults.scoped(
                 getattr(spec, "fault_injection", None), export_env=True
             ), integrity.scoped(
                 # Spec-level integrity mode, armed (and exported to the env,
@@ -276,6 +318,11 @@ class Plan:
                     spec=spec,
                     **kwargs,
                 )
+        except BaseException as e:
+            # captured for the end event (the flight recorder keys its
+            # bundle assembly off it), then re-raised untouched
+            compute_error = e
+            raise
         finally:
             # on_compute_end fires even when the compute FAILS: that is when
             # a trace of the partial run (TracingCallback's trace.json) and
@@ -311,7 +358,12 @@ class Plan:
             callbacks_on(
                 all_callbacks,
                 "on_compute_end",
-                ComputeEndEvent(dag, executor_stats=stats or None),
+                ComputeEndEvent(
+                    dag,
+                    executor_stats=stats or None,
+                    compute_id=compute_id,
+                    error=compute_error,
+                ),
             )
 
     # -- introspection -----------------------------------------------------

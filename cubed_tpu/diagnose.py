@@ -1,0 +1,150 @@
+"""Read a flight-recorder bundle: ``python -m cubed_tpu.diagnose <bundle>``.
+
+Prints the post-mortem a human wants first: what failed (op + chunk +
+error), the slowest ops, the top stragglers, the retry/quarantine/guard
+decision timeline, and per-worker clock skew. The bundle is the directory
+``FlightRecorder`` wrote (``bundle-<compute_id>/``) — see
+``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+from .observability.flightrecorder import load_bundle
+
+
+def _fmt_s(v) -> str:
+    return f"{v:.3f}s" if isinstance(v, (int, float)) else "-"
+
+
+def _section(title: str) -> str:
+    return f"\n== {title} " + "=" * max(1, 60 - len(title))
+
+
+#: decision kinds grouped into the timelines the report prints (every kind
+#: here has a record_decision call site; fail-fasts are task_failed rows
+#: with classification=fail_fast)
+_TIMELINE_GROUPS = {
+    "retries": ("retry", "requeue", "backup", "task_failed", "pool_rebuild"),
+    "integrity": ("recompute", "quarantine"),
+    "memory guard": ("admission_step_down", "admission_restore",
+                     "guard_soft_exceeded", "device_memory"),
+    "stragglers": ("straggler",),
+}
+
+
+def render_report(bundle: dict, timeline_limit: int = 20) -> str:
+    m = bundle["manifest"]
+    out = []
+    out.append(f"compute {m.get('compute_id')}  [{m.get('status')}]  "
+               f"wall clock {_fmt_s(m.get('wall_clock_s'))}  "
+               f"({m.get('created_at')})")
+
+    err = m.get("error")
+    if err:
+        out.append(_section("failure"))
+        where = ""
+        if err.get("op") or err.get("chunk"):
+            where = f" in op {err.get('op')} chunk {err.get('chunk')}"
+        out.append(f"{err.get('type')}: {err.get('message')}{where}")
+        failures = m.get("failing_tasks") or []
+        for f in failures[-5:]:
+            out.append(
+                f"  task_failed op={f.get('op')} chunk={f.get('chunk')} "
+                f"attempt={f.get('attempt')} error={f.get('error_type')}: "
+                f"{str(f.get('error'))[:120]}"
+            )
+
+    ops = sorted(
+        (m.get("op_wall_clock") or {}).items(),
+        key=lambda kv: -(kv[1] or 0),
+    )
+    if ops:
+        out.append(_section("slowest ops"))
+        plan = {r.get("array_name"): r for r in (m.get("plan") or [])}
+        for name, wall in ops[:10]:
+            row = plan.get(name, {})
+            util = row.get("projected_mem_utilization")
+            out.append(
+                f"  {name:<28} {_fmt_s(wall):>10}  tasks={row.get('num_tasks', '-'):<6} "
+                f"projected_mem={row.get('projected_mem', '-')} "
+                f"peak={row.get('peak_measured_mem', '-')}"
+                + (f" ({util:.0%} of projection)" if util else "")
+            )
+
+    stragglers = m.get("stragglers") or []
+    if stragglers:
+        out.append(_section("top stragglers"))
+        for s in stragglers:
+            out.append(
+                f"  {s.get('op')} chunk={s.get('chunk')} "
+                f"{_fmt_s(s.get('duration_s'))} "
+                f"({(s.get('factor') or 0):.1f}x op median "
+                f"{_fmt_s(s.get('op_median_s'))}) on {s.get('worker')}"
+            )
+
+    decisions = m.get("decisions") or []
+    for title, kinds in _TIMELINE_GROUPS.items():
+        rows = [d for d in decisions if d.get("kind") in kinds]
+        if not rows:
+            continue
+        out.append(_section(f"{title} timeline ({len(rows)} events)"))
+        t0 = rows[0].get("ts", 0)
+        for d in rows[-timeline_limit:]:
+            extra = " ".join(
+                f"{k}={v}" for k, v in d.items()
+                if k not in ("ts", "kind", "compute_id")
+            )
+            out.append(f"  +{(d.get('ts', 0) - t0):8.3f}s {d.get('kind'):<20} {extra}")
+
+    offsets = m.get("clock_offsets") or {}
+    skewed = {k: v for k, v in offsets.items() if k != "client"}
+    if skewed:
+        out.append(_section("per-worker clock skew"))
+        for name, row in sorted(skewed.items()):
+            rtt = row.get("rtt")
+            out.append(
+                f"  {name:<20} offset {row.get('offset', 0):+0.6f}s "
+                f"({row.get('source')})"
+                + (f" rtt {rtt * 1e3:.1f}ms" if rtt else "")
+            )
+
+    trace = bundle.get("trace")
+    if trace:
+        n = len(trace.get("traceEvents") or [])
+        out.append(_section("artifacts"))
+        out.append(f"  trace.json: {n} events — open at https://ui.perfetto.dev")
+        out.append(f"  logs.jsonl: {len(bundle.get('logs') or [])} structured records")
+    dropped = m.get("task_records_dropped")
+    if dropped:
+        out.append(f"  NOTE: {dropped} task record(s) beyond the retention "
+                   "bound were dropped; the trace is truncated")
+    return "\n".join(out) + "\n"
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m cubed_tpu.diagnose", description=__doc__
+    )
+    parser.add_argument(
+        "bundle", help="flight-recorder bundle directory (or its manifest.json)"
+    )
+    parser.add_argument(
+        "--timeline-limit", type=int, default=20,
+        help="max events shown per decision timeline (default 20)",
+    )
+    args = parser.parse_args(argv)
+    try:
+        bundle = load_bundle(args.bundle)
+    except (OSError, ValueError) as e:
+        print(f"cannot read bundle {args.bundle!r}: {e}", file=sys.stderr)
+        return 2
+    sys.stdout.write(render_report(bundle, timeline_limit=args.timeline_limit))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

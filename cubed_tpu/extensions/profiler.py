@@ -1,59 +1,15 @@
-"""Device-side profiling via the jax profiler (xprof traces).
-
-The reference measures per-task host RSS/wall-clock (cubed/runtime/utils.py);
-on TPU the interesting signal is the device trace — this callback brackets the
-whole compute in ``jax.profiler.trace`` so kernel timing/HBM occupancy can be
-inspected in TensorBoard/XProf, and snapshots device memory stats per op.
+"""Compatibility shim: the device profiler callbacks moved into the span
+pipeline at ``cubed_tpu.observability.profiler`` (their start/stop and
+per-op device-memory snapshots now land on the merged trace's scheduler
+lane and in flight-recorder bundles). This module keeps the historical
+import path working.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from ..observability.profiler import (  # noqa: F401
+    DeviceMemoryCallback,
+    JaxProfilerCallback,
+)
 
-from ..runtime.types import Callback
-
-
-class JaxProfilerCallback(Callback):
-    """Write a jax profiler trace for the span of one compute call."""
-
-    def __init__(self, log_dir: str = "profile"):
-        self.log_dir = log_dir
-        self._active = False
-
-    def on_compute_start(self, event) -> None:
-        import jax
-
-        try:
-            jax.profiler.start_trace(self.log_dir)
-            self._active = True
-        except Exception:
-            self._active = False
-
-    def on_compute_end(self, event) -> None:
-        if self._active:
-            import jax
-
-            jax.profiler.stop_trace()
-            self._active = False
-
-
-class DeviceMemoryCallback(Callback):
-    """Record per-op device memory watermarks (HBM analogue of peak RSS)."""
-
-    def __init__(self):
-        self.samples: list[dict] = []
-
-    def on_operation_start(self, event) -> None:
-        import jax
-
-        try:
-            stats = jax.devices()[0].memory_stats() or {}
-        except Exception:
-            stats = {}
-        self.samples.append(
-            {
-                "op": event.name,
-                "bytes_in_use": stats.get("bytes_in_use"),
-                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
-            }
-        )
+__all__ = ["JaxProfilerCallback", "DeviceMemoryCallback"]

@@ -11,7 +11,18 @@ in ``runtime/types.py``); this package turns that stream into
   ``ComputeEndEvent.executor_stats`` for every compute;
 - **byte accounting**: the Zarr storage layer records per-store
   ``bytes_read`` / ``bytes_written``, attributed to the task that did the
-  IO even across process boundaries (``accounting.task_scope``).
+  IO even across process boundaries (``accounting.task_scope``);
+- **distributed traces**: :class:`TraceCollector` merges client spans,
+  worker-shipped task sub-spans (storage IO, kernel time, verification),
+  scheduler decisions and memory-guard samples into one clock-aligned
+  Perfetto trace per compute, with a live straggler watch (``collect``);
+- **correlated structured logs**: compute/op/chunk contextvars make every
+  client, pool and fleet-worker log line attributable to its task
+  (``logs``);
+- **flight recorder**: :class:`FlightRecorder` bundles the merged trace,
+  metrics, plan projections, decision timelines and last-N logs into a
+  post-mortem directory readable by ``python -m cubed_tpu.diagnose``
+  (``flightrecorder``).
 """
 
 from .accounting import (  # noqa: F401
@@ -19,11 +30,18 @@ from .accounting import (  # noqa: F401
     record_bytes_written,
     record_virtual_read,
     reset_store_totals,
+    scope_span,
     store_totals,
     task_scope,
 )
 from .callback import TracingCallback  # noqa: F401
+from .collect import (  # noqa: F401
+    TraceCollector,
+    record_decision,
+    record_sample,
+)
 from .events import EventLogCallback, PlanRow  # noqa: F401
+from .flightrecorder import FlightRecorder, load_bundle  # noqa: F401
 from .metrics import (  # noqa: F401
     MetricsRegistry,
     get_registry,

@@ -22,19 +22,110 @@ way, for debugging which store dominates IO; overflow beyond
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, Optional
 
+from . import clock
 from .metrics import get_registry
 
 #: cap on per-store breakdown entries (plans create one temp store per
 #: intermediate array; an unbounded dict would grow with every plan)
 MAX_TRACKED_STORES = 128
 
+#: cap on spans buffered per task: a pathological task (thousands of chunk
+#: reads) must not ship a megabyte of span payload with its result — excess
+#: spans drop with a count, surfaced as the ``spans_dropped`` counter
+MAX_TASK_SPANS = 128
+
+#: operator override for span recording ("1" forces it on everywhere; also
+#: how a client's arming reaches spawned pool workers)
+SPANS_ENV_VAR = "CUBED_TPU_TASK_SPANS"
+
+#: process-global arming state (None = defer to env/default-off). Span
+#: recording is opt-in per compute: ``Plan.execute`` arms it only while a
+#: ``TraceCollector``/``FlightRecorder`` is attached, so an unobserved
+#: compute records no span dicts and ships no span payload in its result
+#: frames — the same arming pattern fault injection and the integrity mode
+#: use (env export for pool spawns, task-message mirroring for fleets)
+_spans_armed: Optional[bool] = None
+
 _tls = threading.local()
 
 _store_lock = threading.Lock()
 _store_totals: Dict[str, list] = {}
+
+#: a human-readable label for THIS process ("local-0" for a fleet worker,
+#: None for the client / pool workers) — stamped on task stats so merged
+#: traces can give each worker its own lane and look up its clock offset
+_process_label: Optional[str] = None
+
+
+def set_process_label(label: Optional[str]) -> None:
+    global _process_label
+    _process_label = label
+
+
+def get_process_label() -> Optional[str]:
+    return _process_label
+
+
+def spans_enabled() -> bool:
+    """Whether ``scope_span`` records anything (env > armed > off)."""
+    env = os.environ.get(SPANS_ENV_VAR)
+    if env:
+        return env == "1"
+    if _spans_armed is not None:
+        return _spans_armed
+    return False
+
+
+def spans_wire() -> bool:
+    """The client's resolved arming, attached to every fleet task message
+    so pre-started workers record spans exactly when the client collects
+    them (and stop when it doesn't)."""
+    return spans_enabled()
+
+
+def arm_spans_from_wire(armed) -> None:
+    """Fleet-worker side: mirror the arming a task message carried."""
+    global _spans_armed
+    _spans_armed = None if armed is None else bool(armed)
+
+
+class spans_scoped:
+    """Arm span recording for a ``with`` block (``Plan.execute`` uses this
+    while a trace collector is attached); ``None`` is a no-op. With
+    ``export_env`` the env var is set so pool workers spawned inside the
+    block inherit the arming — unless the operator already set it, in
+    which case their override passes through untouched (the same env-wins
+    rule the integrity/memory-guard scopes follow)."""
+
+    def __init__(self, armed: Optional[bool] = None, export_env: bool = False):
+        self._armed = armed
+        self._export_env = export_env
+
+    def __enter__(self):
+        if self._armed is None:
+            return None
+        global _spans_armed
+        self._prev = _spans_armed
+        self._prev_env = os.environ.get(SPANS_ENV_VAR)
+        _spans_armed = bool(self._armed)
+        if self._export_env and self._armed and self._prev_env is None:
+            os.environ[SPANS_ENV_VAR] = "1"
+        return self._armed
+
+    def __exit__(self, *exc) -> None:
+        if self._armed is None:
+            return
+        global _spans_armed
+        _spans_armed = self._prev
+        if self._export_env:
+            if self._prev_env is None:
+                os.environ.pop(SPANS_ENV_VAR, None)
+            else:
+                os.environ[SPANS_ENV_VAR] = self._prev_env
 
 
 class TaskScope:
@@ -47,6 +138,8 @@ class TaskScope:
         "chunks_written",
         "virtual_bytes_read",
         "counters",
+        "spans",
+        "spans_dropped",
     )
 
     def __init__(self):
@@ -59,6 +152,25 @@ class TaskScope:
         #: recorded inside this scope — riding the stats dict across process
         #: boundaries exactly like the byte counters
         self.counters: Dict[str, int] = {}
+        #: bounded buffer of spans recorded inside this task body (storage
+        #: reads/writes, kernel apply, integrity verify, retry sleeps) —
+        #: measured on THIS process's clock, shipped back in the stats dict
+        #: like the byte counters so remote work becomes visible in the
+        #: merged trace (observability/collect.py)
+        self.spans: list = []
+        self.spans_dropped = 0
+
+    def add_span(
+        self, name: str, start: float, end: float, cat: str = "span", **attrs
+    ) -> None:
+        if len(self.spans) >= MAX_TASK_SPANS:
+            self.spans_dropped += 1
+            return
+        span = {"name": name, "ts": start, "dur": max(0.0, end - start),
+                "cat": cat}
+        if attrs:
+            span["attrs"] = attrs
+        self.spans.append(span)
 
     def stats(self) -> dict:
         return {
@@ -68,6 +180,8 @@ class TaskScope:
             "chunks_written": self.chunks_written,
             "virtual_bytes_read": self.virtual_bytes_read,
             "counters": dict(self.counters),
+            "spans": list(self.spans),
+            "spans_dropped": self.spans_dropped,
         }
 
 
@@ -96,6 +210,45 @@ class task_scope:
 def current_scope() -> Optional[TaskScope]:
     stack = getattr(_tls, "stack", None)
     return stack[-1] if stack else None
+
+
+class scope_span:
+    """Time a block of code as a span on the current task scope.
+
+    A no-op (no timestamps taken, nothing allocated beyond this object)
+    when no task scope is active — metadata/plan-level IO stays unspanned —
+    or when span recording is disarmed (``spans_enabled``): a compute with
+    no trace collector attached pays nothing for span bookkeeping.
+    The ``attrs`` dict is mutable until exit, so callers can attach
+    results measured inside the block (byte counts, retry counts). A block
+    that raises still records its span, closed at the raise instant with
+    ``error=True`` — failures are when the trace matters most.
+    """
+
+    __slots__ = ("name", "cat", "attrs", "_scope", "_start")
+
+    def __init__(self, name: str, cat: str = "span", **attrs):
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs
+        self._scope: Optional[TaskScope] = None
+
+    def __enter__(self) -> "scope_span":
+        self._scope = current_scope() if spans_enabled() else None
+        if self._scope is not None:
+            self._start = clock.now()
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        scope = self._scope
+        if scope is None:
+            return
+        if exc_type is not None:
+            self.attrs["error"] = True
+            self.attrs["error_type"] = exc_type.__name__
+        scope.add_span(
+            self.name, self._start, clock.now(), cat=self.cat, **self.attrs
+        )
 
 
 def _track_store(store: str, read: int, written: int) -> None:

@@ -1,0 +1,69 @@
+"""The observability wall clock: ``time.time`` plus a settable skew.
+
+Every span/heartbeat timestamp in the tracing pipeline goes through
+``now()`` instead of ``time.time()`` so tests can inject per-process clock
+skew deterministically and prove the cross-process alignment machinery
+corrects it (``docs/observability.md``, "Distributed traces"). In
+production the skew is always 0 and ``now()`` is ``time.time()`` plus one
+float add.
+
+Skew is configured per process:
+
+- ``set_skew(seconds)`` — programmatic.
+- env ``CUBED_TPU_CLOCK_SKEW_S`` — either a plain float (skew every
+  process that reads it) or a JSON object mapping worker names to floats
+  (``{"local-0": 2.0, "local-1": -3.0}``) so each fleet worker in a test
+  gets its own wrong clock. ``configure_from_env(name)`` resolves it; the
+  fleet worker entry point calls it with its ``--name``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+SKEW_ENV_VAR = "CUBED_TPU_CLOCK_SKEW_S"
+
+_skew = 0.0
+
+
+def now() -> float:
+    """Epoch seconds on this process's (possibly skewed) observability clock."""
+    return time.time() + _skew
+
+
+def get_skew() -> float:
+    return _skew
+
+
+def set_skew(seconds: float) -> None:
+    global _skew
+    _skew = float(seconds)
+
+
+def skew_for(name: Optional[str] = None) -> float:
+    """The env-configured skew for this process (0.0 when unset).
+
+    A malformed env value raises loudly — a silently unskewed clock-skew
+    test would pass for the wrong reason.
+    """
+    raw = os.environ.get(SKEW_ENV_VAR)
+    if not raw:
+        return 0.0
+    try:
+        return float(raw)
+    except ValueError:
+        pass
+    mapping = json.loads(raw)
+    if not isinstance(mapping, dict):
+        raise ValueError(f"{SKEW_ENV_VAR} must be a float or a JSON object")
+    return float(mapping.get(name or "", 0.0))
+
+
+def configure_from_env(name: Optional[str] = None) -> float:
+    """Adopt the env-configured skew (worker entry points call this)."""
+    skew = skew_for(name)
+    set_skew(skew)
+    return skew

@@ -1,0 +1,181 @@
+"""Correlated structured logging: every log line attributable to its task.
+
+Three contextvars — ``compute_id``, ``op``, ``chunk`` — are set where the
+work actually happens (``Plan.execute`` around a compute, task bodies in
+``execute_with_stats``), so a log record emitted anywhere under them can be
+joined back to the compute/op/chunk that produced it, in the client, a
+multiprocess pool worker (the compute id crosses the spawn boundary via
+``CUBED_TPU_COMPUTE_ID``), or a fleet worker (every task message carries
+the client's compute id).
+
+Pieces:
+
+- :class:`ContextFilter` — a ``logging.Filter`` injecting
+  ``record.compute_id`` / ``record.op`` / ``record.chunk`` so any format
+  string (or the JSON formatter below) can reference them.
+- :class:`StructuredFormatter` — one JSON object per line (ts, level,
+  logger, message, compute_id, op, chunk, pid), greppable and
+  machine-joinable against the merged trace.
+- :class:`RecentRecordsHandler` — a bounded ring of the last N structured
+  records, installed once per process on the ``cubed_tpu`` logger; the
+  flight recorder snapshots it into every post-mortem bundle.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import os
+import threading
+from collections import deque
+from contextlib import contextmanager
+from typing import Optional
+
+#: how the compute id crosses the spawn boundary into pool workers
+COMPUTE_ID_ENV_VAR = "CUBED_TPU_COMPUTE_ID"
+
+compute_id_var: contextvars.ContextVar = contextvars.ContextVar(
+    "cubed_tpu_compute_id", default=None
+)
+op_var: contextvars.ContextVar = contextvars.ContextVar(
+    "cubed_tpu_op", default=None
+)
+chunk_var: contextvars.ContextVar = contextvars.ContextVar(
+    "cubed_tpu_chunk", default=None
+)
+
+
+def current_compute_id() -> Optional[str]:
+    """The active compute id: contextvar first, spawn-time env second."""
+    cid = compute_id_var.get()
+    if cid is not None:
+        return cid
+    return os.environ.get(COMPUTE_ID_ENV_VAR) or None
+
+
+@contextmanager
+def compute_scope(compute_id: str, export_env: bool = False):
+    """Bind the compute id for a block (and, with ``export_env``, for every
+    child process spawned inside it — how pool workers inherit it)."""
+    token = compute_id_var.set(compute_id)
+    prev_env = os.environ.get(COMPUTE_ID_ENV_VAR)
+    if export_env:
+        os.environ[COMPUTE_ID_ENV_VAR] = compute_id
+    try:
+        yield
+    finally:
+        compute_id_var.reset(token)
+        if export_env:
+            if prev_env is None:
+                os.environ.pop(COMPUTE_ID_ENV_VAR, None)
+            else:
+                os.environ[COMPUTE_ID_ENV_VAR] = prev_env
+
+
+@contextmanager
+def task_context(op: Optional[str] = None, chunk: Optional[str] = None,
+                 compute_id: Optional[str] = None):
+    """Bind op/chunk (and optionally compute id) around one task body."""
+    tokens = []
+    if compute_id is not None:
+        tokens.append((compute_id_var, compute_id_var.set(compute_id)))
+    if op is not None:
+        tokens.append((op_var, op_var.set(op)))
+    if chunk is not None:
+        tokens.append((chunk_var, chunk_var.set(chunk)))
+    try:
+        yield
+    finally:
+        for var, token in reversed(tokens):
+            var.reset(token)
+
+
+class ContextFilter(logging.Filter):
+    """Inject the correlation contextvars into every record that passes."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.compute_id = current_compute_id() or "-"
+        record.op = op_var.get() or "-"
+        record.chunk = chunk_var.get() or "-"
+        return True
+
+
+class StructuredFormatter(logging.Formatter):
+    """One JSON object per line; joinable against the merged trace."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        return json.dumps(_record_to_dict(record), default=str)
+
+
+def _record_to_dict(record: logging.LogRecord) -> dict:
+    out = {
+        "ts": record.created,
+        "level": record.levelname,
+        "logger": record.name,
+        "message": record.getMessage(),
+        "compute_id": getattr(record, "compute_id", None)
+        or current_compute_id() or "-",
+        "op": getattr(record, "op", None) or op_var.get() or "-",
+        "chunk": getattr(record, "chunk", None) or chunk_var.get() or "-",
+        "pid": record.process,
+    }
+    if record.exc_info and record.exc_info[0] is not None:
+        out["exc_type"] = record.exc_info[0].__name__
+    return out
+
+
+class RecentRecordsHandler(logging.Handler):
+    """Bounded ring buffer of structured records (the flight recorder's
+    last-N log window). Never raises into the logging call."""
+
+    def __init__(self, capacity: int = 500):
+        super().__init__()
+        self._records: deque = deque(maxlen=capacity)
+        self.addFilter(ContextFilter())
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self._records.append(_record_to_dict(record))
+        except Exception:
+            pass  # an observer must never fail the caller
+
+    def records(self, n: Optional[int] = None) -> list:
+        items = list(self._records)
+        return items if n is None else items[-n:]
+
+
+_install_lock = threading.Lock()
+_ring: Optional[RecentRecordsHandler] = None
+
+
+def install(capacity: int = 500) -> RecentRecordsHandler:
+    """Attach the ring handler to the ``cubed_tpu`` logger (idempotent).
+
+    Records from every ``cubed_tpu.*`` module logger propagate here, so
+    the ring sees retry warnings, straggler alerts, quarantine notices —
+    regardless of how the application configured its own handlers.
+    """
+    global _ring
+    with _install_lock:
+        if _ring is None:
+            _ring = RecentRecordsHandler(capacity=capacity)
+            logging.getLogger("cubed_tpu").addHandler(_ring)
+        return _ring
+
+
+def recent_records(n: Optional[int] = None) -> list:
+    """The last structured records captured in this process ([] before
+    :func:`install` has run)."""
+    return _ring.records(n) if _ring is not None else []
+
+
+def basic_structured_config(level: int = logging.INFO) -> None:
+    """Convenience: root handler emitting JSON lines with correlation ids
+    (what the fleet worker entry point uses with ``--log-json``)."""
+    handler = logging.StreamHandler()
+    handler.setFormatter(StructuredFormatter())
+    handler.addFilter(ContextFilter())
+    root = logging.getLogger()
+    root.addHandler(handler)
+    root.setLevel(level)

@@ -1,0 +1,68 @@
+"""Device-side profiling, folded into the span pipeline.
+
+``JaxProfilerCallback`` brackets a compute in ``jax.profiler.trace`` (xprof
+traces for TensorBoard/XProf) and ``DeviceMemoryCallback`` snapshots device
+memory watermarks per op — the HBM analogue of the host RSS the memory
+guard samples. Both now feed the unified pipeline: profiler start/stop and
+each device-memory snapshot are recorded as :func:`collect.record_decision`
+entries, so they appear on the ``scheduler`` lane of the merged trace and
+inside flight-recorder bundles next to the host-side story.
+
+``cubed_tpu.extensions.profiler`` re-exports these classes unchanged (the
+historical import path keeps working).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..runtime.types import Callback
+from .collect import record_decision
+
+
+class JaxProfilerCallback(Callback):
+    """Write a jax profiler trace for the span of one compute call."""
+
+    def __init__(self, log_dir: str = "profile"):
+        self.log_dir = log_dir
+        self._active = False
+
+    def on_compute_start(self, event) -> None:
+        import jax
+
+        try:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            record_decision("jax_profiler_start", log_dir=self.log_dir)
+        except Exception:
+            self._active = False
+
+    def on_compute_end(self, event) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            record_decision("jax_profiler_stop", log_dir=self.log_dir)
+
+
+class DeviceMemoryCallback(Callback):
+    """Record per-op device memory watermarks (HBM analogue of peak RSS)."""
+
+    def __init__(self):
+        self.samples: list[dict] = []
+
+    def on_operation_start(self, event) -> None:
+        import jax
+
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+        except Exception:
+            stats = {}
+        sample = {
+            "op": event.name,
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+        }
+        self.samples.append(sample)
+        record_decision("device_memory", **sample)

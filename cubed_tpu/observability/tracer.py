@@ -41,6 +41,9 @@ class Tracer:
         self.dropped = 0
         self._clock = clock
         self._lock = threading.Lock()
+        #: spans entered but not yet exited, across all threads — so an
+        #: export can close them (error=True) instead of dropping them
+        self._open: dict[int, "_Span"] = {}
         self._tls = threading.local()
         self._jsonl_path = jsonl_path
         self._jsonl_file = None
@@ -103,25 +106,67 @@ class Tracer:
             }
         )
 
-    def instant(self, name: str, lane: Optional[str] = None, **attrs) -> None:
+    def instant(self, name: str, lane: Optional[str] = None, ts: Optional[float] = None, **attrs) -> None:
         self._record(
             {
                 "name": name,
                 "cat": "instant",
                 "ph": "i",
-                "ts": self._clock(),
+                "ts": ts if ts is not None else self._clock(),
                 "dur": 0.0,
                 "lane": lane or f"thread-{threading.get_ident()}",
                 "args": attrs,
             }
         )
 
+    def add_counter(
+        self, name: str, ts: float, value, lane: Optional[str] = None
+    ) -> None:
+        """A counter sample (Perfetto renders these as a value track)."""
+        self._record(
+            {
+                "name": name,
+                "cat": "counter",
+                "ph": "C",
+                "ts": ts,
+                "dur": 0.0,
+                "lane": lane or name,
+                "args": {"value": value},
+            }
+        )
+
     # -- export --------------------------------------------------------
 
     def chrome_events(self) -> list[dict]:
-        """Chrome trace-event list: lanes mapped to tids + name metadata."""
+        """Chrome trace-event list: lanes mapped to tids + name metadata.
+
+        Spans still open at export time (entered but never exited — a task
+        that raised through a frame holding one, or an export taken
+        mid-compute) are closed AT the export instant and emitted with
+        ``error=True`` + ``unterminated=True`` instead of being silently
+        dropped: a crash is exactly when the trace matters most.
+        """
         with self._lock:
             events = list(self.events)
+            open_spans = list(self._open.values())
+        end = self._clock()
+        for s in open_spans:
+            attrs = dict(s.attrs)
+            attrs["error"] = True
+            attrs["unterminated"] = True
+            events.append(
+                {
+                    "name": s.name,
+                    "cat": "span",
+                    "ph": "X",
+                    "ts": s.start,
+                    "dur": max(0.0, end - s.start),
+                    # the OWNING thread's lane, not the exporting thread's:
+                    # a crashed task's span must land on its own lane
+                    "lane": s.lane or f"thread-{s.owner}",
+                    "args": attrs,
+                }
+            )
         if not events:
             return []
         t0 = min(e["ts"] for e in events)
@@ -177,6 +222,14 @@ class Tracer:
         with self._lock:
             self.events = []
             self.dropped = 0
+            # also drop spans left open before the clear: a reused tracer
+            # (TracingCallback clears per compute) must not re-emit a prior
+            # compute's abandoned span into every later export — its stale
+            # ts would anchor t0 and shift the whole new timeline (and the
+            # strong ref would pin the span forever). A span live across
+            # the clear still records on exit; it just can't be synthesized
+            # if abandoned.
+            self._open.clear()
 
     def close(self) -> None:
         with self._jsonl_lock:
@@ -191,7 +244,10 @@ class Tracer:
 class _Span:
     """The context manager returned by ``Tracer.span``."""
 
-    __slots__ = ("tracer", "name", "lane", "attrs", "start", "parent", "depth")
+    __slots__ = (
+        "tracer", "name", "lane", "attrs", "start", "parent", "depth",
+        "owner",
+    )
 
     def __init__(self, tracer: Tracer, name: str, lane, attrs: dict):
         self.tracer = tracer
@@ -205,17 +261,24 @@ class _Span:
         self.depth = len(stack)
         stack.append(self)
         self.start = self.tracer._clock()
+        self.owner = threading.get_ident()
+        with self.tracer._lock:
+            self.tracer._open[id(self)] = self
         return self
 
     def __exit__(self, exc_type, *exc) -> None:
         end = self.tracer._clock()
         self.tracer._stack().pop()
+        with self.tracer._lock:
+            self.tracer._open.pop(id(self), None)
         attrs = dict(self.attrs)
         if self.parent is not None:
             attrs["parent"] = self.parent
         attrs["depth"] = self.depth
         if exc_type is not None:
-            attrs["error"] = exc_type.__name__
+            # error=True is the machine-checkable flag; error_type names it
+            attrs["error"] = True
+            attrs["error_type"] = exc_type.__name__
         self.tracer.add_complete(
             self.name, self.start, end, lane=self.lane, **attrs
         )

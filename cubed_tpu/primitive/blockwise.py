@@ -126,13 +126,18 @@ def _is_key(obj) -> bool:
 
 def apply_blockwise(out_key: tuple, *, config: BlockwiseSpec) -> None:
     """Task body: read input chunks, apply the (fused) kernel, write the result."""
+    from ..observability.accounting import scope_span
+
     out_name, out_coords = out_key[0], tuple(out_key[1:])
     args_structure = config.block_function(out_key)
     args = [_read_keys(entry, config) for entry in args_structure]
-    if getattr(config.function, "needs_block_id", False):
-        result = config.function(*args, block_id=out_coords)
-    else:
-        result = config.function(*args)
+    # the kernel itself gets its own span (vs the storage spans around it),
+    # so a merged trace separates compute time from IO time per task
+    with scope_span("kernel_apply", cat="kernel", op=out_name):
+        if getattr(config.function, "needs_block_id", False):
+            result = config.function(*args, block_id=out_coords)
+        else:
+            result = config.function(*args)
 
     if config.writes_rest:
         writes = config.writes

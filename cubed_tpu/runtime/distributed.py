@@ -174,6 +174,12 @@ class _WorkerConn:
         #: any unpressured one is live (runtime/memory.py watermarks)
         self.rss: Optional[int] = None
         self.pressured = False
+        #: NTP-style clock estimate from the heartbeat echo handshake:
+        #: coordinator_time ≈ worker_time + clock_offset, accurate to about
+        #: clock_rtt/2 — what the trace merger uses to land this worker's
+        #: spans on the client timeline (observability/collect.py)
+        self.clock_offset: Optional[float] = None
+        self.clock_rtt: Optional[float] = None
 
 
 class Coordinator:
@@ -328,6 +334,8 @@ class Coordinator:
                 "outstanding": 0,
                 "ghosts": len(conn.ghost_ids),
                 "tasks_sent": conn.tasks_sent,
+                "clock_offset": conn.clock_offset,
+                "clock_rtt": conn.clock_rtt,
             }
             while len(self._departed) > 32:
                 self._departed.popitem(last=False)
@@ -372,14 +380,18 @@ class Coordinator:
                         except Exception:
                             pass  # cancelled concurrently (losing twin)
                     else:
-                        _fail_future(
-                            fut,
-                            RemoteTaskError(
-                                msg.get("error", ""),
-                                msg.get("error_type"),
-                                msg.get("error_payload"),
-                            ),
+                        err = RemoteTaskError(
+                            msg.get("error", ""),
+                            msg.get("error_type"),
+                            msg.get("error_payload"),
                         )
+                        task_stats = msg.get("task_stats")
+                        if task_stats:
+                            # the failed attempt's salvaged span buffer
+                            # (collect.record_failed_task reads it off the
+                            # exception on the client side)
+                            err.cubed_tpu_task_stats = task_stats
+                        _fail_future(fut, err)
                 elif mtype == "started":
                     # execution begins now: restart the timeout clock and
                     # make a subsequent timeout count as a real hang
@@ -397,10 +409,44 @@ class Coordinator:
                     with self._lock:
                         conn.rss = msg.get("rss")
                         conn.pressured = bool(msg.get("pressured"))
+                        if msg.get("clock_offset") is not None:
+                            conn.clock_offset = msg["clock_offset"]
+                            conn.clock_rtt = msg.get("clock_rtt")
                     if conn.rss is not None:
                         get_registry().gauge("fleet_worker_rss_bytes").set(
                             conn.rss
                         )
+                        # worker memory telemetry feeds the merged trace's
+                        # per-worker memory lane client-side (the worker's
+                        # own sampler ring never crosses the process
+                        # boundary) — stamped at receipt on the client
+                        # clock, so no alignment needed
+                        from ..observability.collect import record_sample
+
+                        record_sample(rss=conn.rss, worker=conn.name)
+                    if msg.get("t0") is not None:
+                        # clock handshake: echo the worker's send timestamp
+                        # with our own receipt time — the worker computes an
+                        # NTP-style offset from the pair and ships it back
+                        # on the next heartbeat (and immediately via a
+                        # "clock" message, so even sub-second computes have
+                        # aligned worker spans)
+                        try:
+                            send_frame(
+                                conn.sock,
+                                {
+                                    "type": "heartbeat_echo",
+                                    "t0": msg["t0"],
+                                    "t_coord": time.time(),
+                                },
+                                conn.send_lock,
+                            )
+                        except (ConnectionError, OSError):
+                            pass  # recv will notice the dead socket
+                elif mtype == "clock":
+                    with self._lock:
+                        conn.clock_offset = msg.get("clock_offset")
+                        conn.clock_rtt = msg.get("clock_rtt")
                 elif mtype == "blob_dropped":
                     # the worker evicted this blob from its bounded caches;
                     # forget we sent it so the next task of that op
@@ -548,6 +594,7 @@ class Coordinator:
                     conn.deadlines[task_id] = [
                         time.monotonic() + self.task_timeout, False
                     ]
+            from ..observability import accounting, logs
             from ..storage import integrity
             from . import memory
             from .faults import wire_config
@@ -558,6 +605,9 @@ class Coordinator:
                 "blob_id": blob_id,
                 "blob": blob if first_use else None,
                 "input": task_input,
+                # the client's compute id rides with every task so worker
+                # log lines/spans correlate to the compute that asked
+                "compute_id": logs.current_compute_id(),
                 # ack execution start only when someone is watching the clock
                 "ack": self.task_timeout is not None,
                 # the client's fault-injection arming state rides with every
@@ -573,6 +623,10 @@ class Coordinator:
                 # so workers enforce the same per-task budget the client's
                 # Spec promised
                 "memory_guard": memory.wire_config(),
+                # ... and the span-recording arming: workers buffer/ship
+                # spans exactly when the client has a collector to merge
+                # them, and stop when it doesn't
+                "spans": accounting.spans_wire(),
             }
             try:
                 send_frame(conn.sock, msg, conn.send_lock)
@@ -615,6 +669,8 @@ class Coordinator:
                     "tasks_sent": w.tasks_sent,
                     "rss": w.rss,
                     "pressured": w.pressured,
+                    "clock_offset": w.clock_offset,
+                    "clock_rtt": w.clock_rtt,
                 }
         out["workers"] = workers
         return out
@@ -654,6 +710,12 @@ def run_worker(
     import cloudpickle
     from concurrent.futures import ThreadPoolExecutor
 
+    from ..observability import clock as obs_clock
+    from ..observability import logs as obs_logs
+    from ..observability.accounting import (
+        arm_spans_from_wire,
+        set_process_label,
+    )
     from ..storage import integrity
     from ..utils import current_measured_mem
     from . import memory
@@ -665,6 +727,16 @@ def run_worker(
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     send_lock = threading.Lock()
     wname = name or f"{socket.gethostname()}:{os.getpid()}"
+    # stamp this process's task stats with the worker name (its trace lane)
+    # and adopt any test-injected clock skew before the first heartbeat
+    set_process_label(wname)
+    obs_clock.configure_from_env(wname)
+    #: latest NTP-style clock estimate from the coordinator's heartbeat
+    #: echoes (coordinator_time ≈ our clock.now() + offset); "best" is the
+    #: lowest rtt ever observed — the fixed quality anchor for refreshes
+    clock_est: Dict[str, Optional[float]] = {
+        "offset": None, "rtt": None, "best": None,
+    }
     send_frame(
         sock,
         {
@@ -694,6 +766,9 @@ def run_worker(
 
     def run_task(msg: dict) -> None:
         task_id = msg["task_id"]
+        # correlate every log line/span this task emits with the client's
+        # compute (the id rides each task message; None clears stale state)
+        cid_token = obs_logs.compute_id_var.set(msg.get("compute_id"))
         try:
             # chaos hook: a named worker hard-exits or wedges when its
             # executed-task count reaches the configured threshold —
@@ -708,6 +783,8 @@ def run_worker(
                 integrity.arm_from_wire(msg.get("integrity"))
             if "memory_guard" in msg:
                 memory.arm_from_wire(msg.get("memory_guard"))
+            if "spans" in msg:
+                arm_spans_from_wire(msg.get("spans"))
             if injector is not None:
                 action = injector.worker_task_tick(wname)
                 if action == "crash":
@@ -825,32 +902,47 @@ def run_worker(
                      "error_type": type(e).__name__,
                      # structured payload (ChunkIntegrityError: the corrupt
                      # chunk's store/key) for coordinator-side repair
-                     "error_payload": getattr(e, "wire_payload", None)},
+                     "error_payload": getattr(e, "wire_payload", None),
+                     # the failed attempt's salvaged span buffer (plain
+                     # dict — execute_with_stats attached it), so the
+                     # client can land the failure on the merged trace
+                     "task_stats": getattr(
+                         e, "cubed_tpu_task_stats", None
+                     )},
                     send_lock,
                 )
             except (ConnectionError, OSError):
                 stop.set()
+        finally:
+            obs_logs.compute_id_var.reset(cid_token)
 
     def heartbeat_loop() -> None:
-        """RSS + memory-pressure telemetry, measured where the memory is.
+        """RSS/memory-pressure telemetry plus the clock handshake's t0.
 
-        The coordinator only ever *reads* these; a worker that never
-        heartbeats (older build) simply stays eligible for dispatch."""
-        while not stop.wait(1.0):
+        The first heartbeat goes out immediately (not after the 1s period)
+        so the coordinator's echo — and with it this worker's clock offset
+        — exists before the first task completes: even a sub-second compute
+        gets aligned worker spans. The coordinator only ever *reads* these;
+        a worker that never heartbeats (older build) simply stays eligible
+        for dispatch."""
+        while True:
             rss = current_measured_mem()
-            if rss is None:
-                return  # platform can't measure; nothing useful to send
+            hb = {
+                "type": "heartbeat",
+                "rss": rss,
+                "pressured": (
+                    rss is not None and memory.pressure_level() != "ok"
+                ),
+                "t0": obs_clock.now(),
+            }
+            if clock_est["offset"] is not None:
+                hb["clock_offset"] = clock_est["offset"]
+                hb["clock_rtt"] = clock_est["rtt"]
             try:
-                send_frame(
-                    sock,
-                    {
-                        "type": "heartbeat",
-                        "rss": rss,
-                        "pressured": memory.pressure_level() != "ok",
-                    },
-                    send_lock,
-                )
+                send_frame(sock, hb, send_lock)
             except (ConnectionError, OSError):
+                return
+            if stop.wait(1.0):
                 return
 
     threading.Thread(
@@ -866,6 +958,43 @@ def run_worker(
                     if msg.get("blob") is not None:
                         raw_blobs[msg["blob_id"]] = msg["blob"]
                     pool.submit(run_task, msg)
+                elif mtype == "heartbeat_echo":
+                    # NTP-style: the coordinator echoed our t0 with its own
+                    # clock; offset = t_coord - midpoint(t0, t1), accurate
+                    # to ~rtt/2. Accept a sample when its rtt is comparable
+                    # to the BEST rtt ever seen (a fixed anchor — never
+                    # ratcheted by accepted samples — with a 1ms epsilon so
+                    # coarse clocks reporting rtt=0 still refresh), so slow
+                    # clock drift heals without estimate quality degrading
+                    # under rising load. Ship it back immediately — the
+                    # next task's spans may be exported before the next
+                    # 1s heartbeat
+                    t1 = obs_clock.now()
+                    t0, tc = msg.get("t0"), msg.get("t_coord")
+                    if t0 is not None and tc is not None:
+                        rtt = max(0.0, t1 - t0)
+                        best = clock_est.get("best")
+                        if best is None or rtt < best:
+                            best = rtt
+                        clock_est["best"] = best
+                        if (
+                            clock_est["rtt"] is None
+                            or rtt <= best * 1.5 + 1e-3
+                        ):
+                            clock_est["offset"] = tc - (t0 + t1) / 2
+                            clock_est["rtt"] = rtt
+                            try:
+                                send_frame(
+                                    sock,
+                                    {
+                                        "type": "clock",
+                                        "clock_offset": clock_est["offset"],
+                                        "clock_rtt": rtt,
+                                    },
+                                    send_lock,
+                                )
+                            except (ConnectionError, OSError):
+                                break
                 elif mtype == "shutdown":
                     break
                 else:

@@ -30,6 +30,8 @@ import subprocess
 import sys
 from typing import Optional
 
+from ...observability import accounting
+from ...observability import logs as obs_logs
 from ..distributed import Coordinator, NoWorkersError
 from ..memory import AdmissionController
 from ..pipeline import (
@@ -53,6 +55,17 @@ from .python_async import compute_retry_budget, map_unordered
 logger = logging.getLogger(__name__)
 
 
+#: per-compute client state that must NOT leak into persistent fleet
+#: workers: these env exports exist for per-compute pool spawns, but a fleet
+#: outlives the compute that spawned it and gets the live values on every
+#: task message — an inherited copy would outrank the wire (env > armed) and
+#: pin spans/compute-id to the spawning compute forever
+_PER_COMPUTE_ENV_VARS = (
+    accounting.SPANS_ENV_VAR,
+    obs_logs.COMPUTE_ID_ENV_VAR,
+)
+
+
 def _worker_env() -> dict:
     """Hermetic env for locally spawned workers: CPU jax, no device plugin
     registration (workers do chunk IO + host compute; the client process owns
@@ -61,6 +74,7 @@ def _worker_env() -> dict:
         k: v
         for k, v in os.environ.items()
         if not k.startswith(_PLUGIN_ENV_PREFIXES)
+        and k not in _PER_COMPUTE_ENV_VARS
     }
     env["JAX_PLATFORMS"] = "cpu"
     repo_root = os.path.dirname(
@@ -261,6 +275,14 @@ class DistributedDagExecutor(DagExecutor):
         admission = AdmissionController()
 
         coord = self._ensure_fleet()
+        from ...observability.collect import record_decision
+
+        # the fleet's shape at compute start anchors the decision timeline
+        # (a later worker loss reads very differently at 8 workers vs 1)
+        record_decision(
+            "fleet_compute", n_workers=coord.n_workers,
+            coordinator=f"{coord.address[0]}:{coord.address[1]}",
+        )
         if coord.n_workers == 0:
             # fail fast with a diagnostic instead of letting the first
             # submit discover it mid-plan (min_workers=0 configurations

@@ -615,12 +615,20 @@ class JaxExecutor(DagExecutor):
                         self.stats["segments_traced"] += 1
                     else:
                         self.stats["segment_mem_aborts"] += 1
+                        from ...observability.collect import record_decision
+
+                        record_decision(
+                            "jax_segment_mem_abort", segment=seg_key
+                        )
                 except Exception:
                     logger.exception(
                         "segment trace failed; falling back to eager"
                     )
                     self.stats["trace_failures"] += 1
                     self.stats["eager_fallbacks"] += 1
+                    from ...observability.collect import record_decision
+
+                    record_decision("jax_eager_fallback", segment=seg_key)
                     traced = False
             if not traced:
                 for name, node in ops:

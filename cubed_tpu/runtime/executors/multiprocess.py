@@ -362,6 +362,12 @@ class MultiprocessDagExecutor(DagExecutor):
                 delay = policy.backoff_delay(attempt + 1)
                 get_registry().counter("pool_rebuilds").inc()
                 get_registry().histogram("retry_backoff_s").observe(delay)
+                from ...observability.collect import record_decision
+
+                record_decision(
+                    "pool_rebuild", exitcodes=codes, workers=workers,
+                    oom=oom, delay_s=round(delay, 4),
+                )
                 logger.warning(
                     "worker process died (%s); rebuilding pool with %d "
                     "worker(s) in %.3fs, re-running op (attempt %d/%d)",

@@ -95,6 +95,19 @@ class PythonDagExecutor(DagExecutor):
                         break
                     except Exception as exc:
                         cls = policy.classify(exc)
+                        from ...observability.collect import (
+                            record_decision,
+                            record_failed_task,
+                        )
+
+                        record_decision(
+                            "task_failed",
+                            op=name, chunk=key, attempt=failures,
+                            error_type=type(exc).__name__,
+                            error=str(exc)[:200],
+                            classification=cls.name.lower(),
+                        )
+                        record_failed_task(name, key, failures, exc)
                         if cls is Classification.RECOMPUTE:
                             from .python_async import _count_integrity_failure
 
@@ -139,6 +152,10 @@ class PythonDagExecutor(DagExecutor):
                         )
                         metrics.counter("task_retries").inc()
                         metrics.histogram("retry_backoff_s").observe(delay)
+                        record_decision(
+                            "retry", op=name, chunk=key, attempt=failures,
+                            delay_s=round(delay, 4),
+                        )
                         if delay > 0:
                             time.sleep(delay)
                 handle_callbacks(

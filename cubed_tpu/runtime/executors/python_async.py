@@ -23,6 +23,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, Iterable, Optional
 
+from ...observability.collect import record_decision, record_failed_task
 from ...observability.metrics import get_registry
 from ..backup import should_launch_backup
 from ..memory import (
@@ -79,6 +80,10 @@ def _count_integrity_failure(metrics, exc) -> None:
     payload = integrity_payload(exc)
     if payload and payload.get("kind") == "checksum":
         metrics.counter("chunks_quarantined").inc()
+        record_decision(
+            "quarantine", store=str(payload.get("store", "")),
+            chunk=payload.get("chunk_key"),
+        )
 
 
 def map_unordered(
@@ -355,6 +360,19 @@ def _map_unordered_batch(
                 if exc is not None:
                     twins = [f for f in pending if pending[f][0] == i]
                     cls = policy.classify(exc)
+                    # the failure timeline: every observed task failure,
+                    # with its classification, for traces/bundles
+                    record_decision(
+                        "task_failed",
+                        op=op_of(i), chunk=key_of(i), attempt=attempt,
+                        error_type=type(exc).__name__,
+                        error=str(exc)[:200],
+                        classification=cls.name.lower(),
+                    )
+                    # the failed attempt's span buffer rides the exception
+                    # (locally, pickled off a pool, or on the fleet error
+                    # frame): land it on the merged trace
+                    record_failed_task(op_of(i), key_of(i), attempt, exc)
                     if (
                         cls is Classification.REQUEUE
                         and requeues.get(i, 0) < policy.max_requeues
@@ -363,6 +381,10 @@ def _map_unordered_batch(
                         # survivor without consuming a user-visible retry
                         requeues[i] = requeues.get(i, 0) + 1
                         metrics.counter("worker_loss_requeues").inc()
+                        record_decision(
+                            "requeue", op=op_of(i), chunk=key_of(i),
+                            requeue=requeues[i],
+                        )
                         logger.info(
                             "requeueing input %s after worker loss "
                             "(requeue %d/%d)", i, requeues[i],
@@ -430,6 +452,12 @@ def _map_unordered_batch(
                             # chunk on the side pool; the reader resubmits
                             # when the repair lands (no extra backoff — the
                             # repair itself costs the wall clock one would)
+                            payload = integrity_payload(exc) or {}
+                            record_decision(
+                                "recompute", op=op_of(i), chunk=key_of(i),
+                                store=str(payload.get("store", "")),
+                                corrupt_chunk=payload.get("chunk_key"),
+                            )
                             if repair_pool is None:
                                 repair_pool = (
                                     concurrent.futures.ThreadPoolExecutor(
@@ -451,6 +479,10 @@ def _map_unordered_batch(
                     )
                     metrics.counter("task_retries").inc()
                     metrics.histogram("retry_backoff_s").observe(delay)
+                    record_decision(
+                        "retry", op=op_of(i), chunk=key_of(i),
+                        attempt=attempts[i], delay_s=round(delay, 4),
+                    )
                     if delay <= 0:
                         admit(i)
                     else:
@@ -484,6 +516,9 @@ def _map_unordered_batch(
                     if should_launch_backup(fut, now, start_times, end_times):
                         logger.info("launching backup for input %s", i)
                         metrics.counter("speculative_backups").inc()
+                        record_decision(
+                            "backup", op=op_of(i), chunk=key_of(i),
+                        )
                         submit(i, is_backup=True)
     finally:
         # reset even when retries are exhausted mid-loop: a stale nonzero

@@ -404,6 +404,12 @@ _sampler_thread: Optional[threading.Thread] = None
 #: "ok" | "soft" | "hard" — written by the sampler, read by admission
 _pressure_level = "ok"
 
+#: trace-sample throttle (see _sample_once): the guard samples every ~20ms
+#: but the collect ring is bounded, so the memory lane records at most one
+#: sample per period (plus every pressure-level change)
+_TRACE_SAMPLE_PERIOD_S = 0.25
+_last_trace_sample = 0.0
+
 
 class _GuardedTask:
     __slots__ = ("key", "start_rss", "injected", "peak_delta")
@@ -454,10 +460,25 @@ def _sample_once(cfg: MemoryGuardConfig, tasks: list) -> None:
         reg.gauge("mem_host_available_bytes").set(avail)
         if avail < cfg.host_floor_bytes:
             level = "hard"
-    if level != _pressure_level:
+    level_changed = level != _pressure_level
+    if level_changed:
         logger.debug("memory pressure level: %s -> %s", _pressure_level, level)
     _pressure_level = level
-    reg.gauge("mem_pressure").set({"ok": 0, "soft": 1, "hard": 2}[level])
+    level_int = {"ok": 0, "soft": 1, "hard": 2}[level]
+    reg.gauge("mem_pressure").set(level_int)
+    # feed the trace merger's memory lane, throttled to one sample per
+    # _TRACE_SAMPLE_PERIOD_S (plus every pressure-level change): at the
+    # guard's 20ms cadence the bounded ring would only hold the last ~80s
+    # of a long compute, silently hiding the pressure ramp that triggered
+    # early step-downs; at 250ms it covers ~17 minutes — longer than the
+    # bench budget
+    global _last_trace_sample
+    now_s = time.monotonic()
+    if now_s - _last_trace_sample >= _TRACE_SAMPLE_PERIOD_S or level_changed:
+        _last_trace_sample = now_s
+        from ..observability.collect import record_sample
+
+        record_sample(rss=rss, pressure=level_int, available=avail)
 
 
 def _sampler_loop() -> None:
@@ -577,8 +598,16 @@ class task_guard:
                 allowed=cfg.allowed_mem,
             )
         # observe: per-task attribution rides the task's scope counters
-        # back to the client registry (surviving process boundaries)
+        # back to the client registry (surviving process boundaries); the
+        # decision entry feeds the trace/bundle guard timeline (in-process
+        # executors only — a pool/fleet worker's ring stays local)
         record_scoped_counter("mem_guard_soft_exceeded")
+        from ..observability.collect import record_decision
+
+        record_decision(
+            "guard_soft_exceeded", chunk=self._key,
+            measured=self.measured, allowed=cfg.allowed_mem,
+        )
         logger.warning(
             "memory guard (observe): task %s measured %s (%d bytes) > "
             "allowed_mem %s (%d bytes) — enforcement is off; set "
@@ -652,6 +681,9 @@ class AdmissionController:
                 self._last_stepdown = time.monotonic()
                 reg.counter("mem_pressure_stepdowns").inc()
                 reg.gauge("admission_limit").set(new)
+                from ..observability.collect import record_decision
+
+                record_decision("admission_step_down", limit=new)
                 log(
                     "memory pressure: concurrency stepped down to %d "
                     "in-flight task(s)", new,
@@ -684,13 +716,17 @@ class AdmissionController:
             new = self.limit * 2
             reg = get_registry()
             reg.counter("mem_pressure_restores").inc()
+            from ..observability.collect import record_decision
+
             if new >= self._max_seen:
                 self.limit = None
                 reg.gauge("admission_limit").set(self._max_seen)
+                record_decision("admission_restore", limit=None)
                 logger.info("memory pressure receded: concurrency unbounded")
             else:
                 self.limit = new
                 reg.gauge("admission_limit").set(new)
+                record_decision("admission_restore", limit=new)
                 logger.info(
                     "memory pressure receding: concurrency restored to %d", new
                 )

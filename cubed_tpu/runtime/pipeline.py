@@ -69,6 +69,13 @@ class ResumeState:
                         "resume scan: %d corrupt/untrusted chunk(s) in %s "
                         "will recompute", len(corrupt), store,
                     )
+                    if self.count:
+                        from ..observability.collect import record_decision
+
+                        record_decision(
+                            "quarantine", store=store,
+                            chunks=len(corrupt), source="resume_scan",
+                        )
             else:
                 valid = None
         except FileNotFoundError:
@@ -256,7 +263,7 @@ class RecomputeResolver:
             return None
 
         def recompute():
-            from ..observability.accounting import task_scope
+            from ..observability.accounting import scope_span, task_scope
 
             logger.warning(
                 "recomputing corrupt chunk %s of %s (upstream task re-run)",
@@ -268,16 +275,30 @@ class RecomputeResolver:
             # storm then exhausts the reader's retries instead of being
             # silently laundered through an unverified side door)
             with task_scope() as scope:
-                pipeline.function(task_input, config=pipeline.config)
+                with scope_span(
+                    "recompute_repair", cat="repair", chunk=key,
+                    store=str(payload.get("store", "")),
+                ):
+                    pipeline.function(task_input, config=pipeline.config)
             reg = get_registry()
-            for sname, n in scope.stats().items():
+            stats = scope.stats()
+            for sname, n in stats.items():
                 if sname == "counters":
                     for cname, cn in n.items():
                         if cn:
                             reg.counter(cname).inc(cn)
+                elif sname == "spans":
+                    continue  # span dicts, not a counter — shipped below
                 elif n:
                     reg.counter(sname).inc(n)
             reg.counter("chunks_recomputed").inc()
+            # a repair has no task event to ride, but it runs client-side:
+            # hand its spans (the recompute_repair wrapper + the storage IO
+            # inside it) straight to the trace ring so the documented
+            # repair span actually appears in the merged trace
+            from ..observability.collect import record_repair_spans
+
+            record_repair_spans(key, str(payload.get("store", "")), stats)
 
         return recompute
 

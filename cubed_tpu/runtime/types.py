@@ -87,6 +87,17 @@ class TaskEndEvent:
     #: off or couldn't measure — per-op maxima feed the projected-vs-
     #: measured summary in ``ComputeEndEvent.executor_stats``
     guard_mem_peak: Optional[int] = None
+    #: spans recorded inside this task's body (storage IO, kernel apply,
+    #: integrity verify, retry sleeps), measured on the executing process's
+    #: clock — see ``observability/accounting.py`` (bounded buffer) and
+    #: ``observability/collect.py`` (clock-aligned merge)
+    spans: Optional[list] = None
+    #: spans beyond the per-task buffer bound, dropped where the task ran
+    spans_dropped: Optional[int] = None
+    #: pid of the process that executed the task (lane + clock identity)
+    pid: Optional[int] = None
+    #: fleet worker name when the task ran on a named worker, else None
+    worker: Optional[str] = None
 
 
 class Callback:
@@ -119,6 +130,9 @@ class Callback:
 class ComputeStartEvent:
     dag: object
     resume: Optional[bool] = None
+    #: unique id for this compute (``Plan.execute`` mints one); correlates
+    #: traces, structured logs and flight-recorder bundles
+    compute_id: Optional[str] = None
 
 
 @dataclass
@@ -129,6 +143,12 @@ class ComputeEndEvent:
     #: observability metrics snapshot (task counters, bytes_read/written,
     #: retries/timeouts/backups, per_op summary) — None if nothing reported
     executor_stats: Optional[dict] = None
+    #: the compute's id (matches the start event's)
+    compute_id: Optional[str] = None
+    #: the exception that failed the compute, or None on success — how the
+    #: flight recorder knows to assemble a bundle (the event still fires on
+    #: failure; the exception propagates to the caller regardless)
+    error: Optional[BaseException] = None
 
 
 @dataclass

@@ -10,11 +10,17 @@ it, so remote executors report IO accurately.
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from functools import partial
 from typing import Iterable, Iterator, Optional, Sequence
 
-from ..observability.accounting import task_scope
+from ..observability import clock, logs
+from ..observability.accounting import (
+    get_process_label,
+    spans_enabled,
+    task_scope,
+)
 from ..observability.metrics import get_registry
 from ..utils import peak_measured_mem
 from .types import (
@@ -46,23 +52,62 @@ def execute_with_stats(function, *args, **kwargs):
     from .memory import task_guard
 
     peak_before = peak_measured_mem()
-    with task_scope() as scope:
-        injector = get_injector()
-        key = chunk_key(args[0]) if args else ""
-        spike = 0
-        if injector is not None:
-            injector.task_fault(key)
-            spike = injector.task_mem_spike(key)
-        with task_guard(key, injected_bytes=spike) as guard:
-            start = time.time()
-            result = function(*args, **kwargs)
-            end = time.time()
+    start = None
+    try:
+        with task_scope() as scope:
+            injector = get_injector()
+            key = chunk_key(args[0]) if args else ""
+            # blockwise mappable items are (out_name, i, j, ...) tuples: the
+            # first element names the op's output array — good enough task
+            # attribution for log correlation without threading the op through
+            op = None
+            if args and isinstance(args[0], tuple) and args[0]:
+                op = str(args[0][0])
+            spike = 0
+            if injector is not None:
+                spike = injector.task_mem_spike(key)
+            with logs.task_context(op=op, chunk=key):
+                with task_guard(key, injected_bytes=spike) as guard:
+                    start = clock.now()
+                    # injected faults run inside the timed window: an injected
+                    # straggler delay is part of the task's measured duration
+                    # (exactly like a real slow task), so the live straggler
+                    # watch and the merged trace see it
+                    if injector is not None:
+                        injector.task_fault(key)
+                    result = function(*args, **kwargs)
+                    end = clock.now()
+    except Exception as e:
+        # a raising task produces no stats dict, so its span buffer — the
+        # part of the trace that matters most — would vanish. Attach it to
+        # the exception instead: the attribute survives pickling (pool
+        # workers) and the fleet error frame copies it explicitly, so the
+        # client's failure handler can land the failed attempt on the
+        # merged trace (observability/collect.record_failed_task). Only
+        # when spans are armed: an unobserved compute adds nothing to its
+        # exceptions.
+        if spans_enabled():
+            try:
+                now_ts = clock.now()
+                e.cubed_tpu_task_stats = dict(
+                    function_start_tstamp=start if start is not None else now_ts,
+                    function_end_tstamp=now_ts,
+                    pid=os.getpid(),
+                    worker=get_process_label(),
+                    error_type=type(e).__name__,
+                    **scope.stats(),
+                )
+            except Exception:
+                pass  # salvage must never mask the task's own failure
+        raise
     peak_after = peak_measured_mem()
     return result, dict(
         function_start_tstamp=start,
         function_end_tstamp=end,
         peak_measured_mem_start=peak_before,
         peak_measured_mem_end=peak_after,
+        pid=os.getpid(),
+        worker=get_process_label(),
         **guard.stats(),
         **scope.stats(),
     )

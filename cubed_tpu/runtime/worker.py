@@ -26,11 +26,22 @@ def main(argv=None) -> None:
     parser.add_argument(
         "-v", "--verbose", action="store_true", help="log at INFO level"
     )
-    args = parser.parse_args(argv)
-    logging.basicConfig(
-        level=logging.INFO if args.verbose else logging.WARNING,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    parser.add_argument(
+        "--log-json", action="store_true",
+        help="emit structured JSON log lines carrying compute/op/chunk "
+        "correlation ids (observability/logs.py)",
     )
+    args = parser.parse_args(argv)
+    level = logging.INFO if args.verbose else logging.WARNING
+    if args.log_json:
+        from ..observability.logs import basic_structured_config
+
+        basic_structured_config(level)
+    else:
+        logging.basicConfig(
+            level=level,
+            format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        )
     run_worker(args.coordinator, nthreads=args.threads, name=args.name)
 
 

@@ -35,6 +35,7 @@ from ..observability.accounting import (
     record_bytes_read,
     record_bytes_written,
     record_scoped_counter,
+    scope_span,
 )
 from ..observability.metrics import get_registry
 from ..runtime.faults import FaultInjectedIOError, get_injector
@@ -466,12 +467,15 @@ class ZarrV2Array:
                     store=self.store, chunk_key=key, kind="missing",
                 )
             return None
-        data = self._read_bytes_with_retries(key)
+        with scope_span("storage_read", cat="storage", key=key) as sp:
+            data = self._read_bytes_with_retries(key)
+            sp.attrs["bytes"] = len(data)
         # IO bytes as stored (pre-decompression), attributed to the reading
         # task's scope when one is active (observability/accounting.py)
         record_bytes_read(self.store, len(data))
         if verify:
-            self._verify_chunk_bytes(key, data)
+            with scope_span("integrity_verify", cat="integrity", key=key):
+                self._verify_chunk_bytes(key, data)
         if self._codec is not None:
             data = self._codec[1](data)
         arr = np.frombuffer(data, dtype=self.dtype)
@@ -590,7 +594,11 @@ class ZarrV2Array:
                 )
                 get_registry().counter("storage_read_retries").inc()
                 if delay > 0:
-                    time.sleep(delay)
+                    with scope_span(
+                        "retry_sleep", cat="retry", site="storage_read",
+                        key=key,
+                    ):
+                        time.sleep(delay)
 
     def _write_chunk(self, idx: tuple[int, ...], arr: np.ndarray) -> None:
         arr = np.ascontiguousarray(arr, dtype=self.dtype)
@@ -598,15 +606,21 @@ class ZarrV2Array:
         if self._codec is not None:
             data = self._codec[0](data)
         key = self._chunk_key(idx)
-        self._io.write_bytes_atomic(key, data)
-        if integrity.current_mode() != "off":
-            # recorded AFTER the chunk write succeeds: a crash between the
-            # two leaves a chunk without an entry, which resume treats as
-            # not-computed (safe re-run) — never an entry without its chunk
-            entry = integrity.record_checksum(self._io, self.store, key, data)
-            if self._manifest_cache is not None:
-                self._manifest_cache[0][key] = entry
-                self._manifest_cache = (self._manifest_cache[0], True)
+        with scope_span(
+            "storage_write", cat="storage", key=key, bytes=len(data)
+        ):
+            self._io.write_bytes_atomic(key, data)
+            if integrity.current_mode() != "off":
+                # recorded AFTER the chunk write succeeds: a crash between
+                # the two leaves a chunk without an entry, which resume
+                # treats as not-computed (safe re-run) — never an entry
+                # without its chunk
+                entry = integrity.record_checksum(
+                    self._io, self.store, key, data
+                )
+                if self._manifest_cache is not None:
+                    self._manifest_cache[0][key] = entry
+                    self._manifest_cache = (self._manifest_cache[0], True)
         record_bytes_written(self.store, len(data))
 
     def _empty_chunk(self) -> np.ndarray:

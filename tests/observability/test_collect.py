@@ -1,0 +1,420 @@
+"""TraceCollector unit tests: clock alignment (handshake + latency
+estimate), bounded span buffers, the live straggler watch, and the merged
+export's lane/event structure — driven with synthetic events so every edge
+is deterministic."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import networkx as nx
+import pytest
+
+from cubed_tpu.observability import accounting
+from cubed_tpu.observability.accounting import task_scope
+from cubed_tpu.observability.collect import (
+    TraceCollector,
+    decisions_since,
+    record_decision,
+    record_sample,
+    samples_since,
+)
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime.types import (
+    ComputeEndEvent,
+    ComputeStartEvent,
+    TaskEndEvent,
+)
+
+
+def _start_event(compute_id="c-test"):
+    return ComputeStartEvent(nx.MultiDiGraph(), compute_id=compute_id)
+
+
+def _task_event(op="op-a", chunk="0.0", start=None, end=None, pid=None,
+                worker=None, spans=None, spans_dropped=None, result=None):
+    now = time.time()
+    return TaskEndEvent(
+        array_name=op,
+        chunk_key=chunk,
+        function_start_tstamp=start if start is not None else now - 0.01,
+        function_end_tstamp=end if end is not None else now,
+        task_result_tstamp=result,
+        pid=pid,
+        worker=worker,
+        spans=spans,
+        spans_dropped=spans_dropped,
+    )
+
+
+def _events_by_lane(doc):
+    meta = {e["tid"]: e["args"]["name"] for e in doc if e.get("ph") == "M"}
+    out: dict = {}
+    for e in doc:
+        if e.get("ph") == "M":
+            continue
+        out.setdefault(meta.get(e.get("tid")), []).append(e)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# clock alignment
+# ---------------------------------------------------------------------------
+
+
+def test_handshake_offset_aligns_fleet_worker_spans(tmp_path):
+    """Spans from a worker whose clock is 5s behind land on the client
+    timeline when the executor stats carry its handshake offset."""
+    col = TraceCollector(trace_dir=None)
+    col.on_compute_start(_start_event())
+    now = time.time()
+    skew = -5.0  # the worker's clock reads 5s behind the client's
+    span = {"name": "storage_read", "ts": now + skew - 0.008,
+            "dur": 0.005, "cat": "storage"}
+    col.on_task_end(
+        _task_event(start=now + skew - 0.01, end=now + skew, pid=12345,
+                    worker="w1", spans=[span], result=now)
+    )
+    col.on_compute_end(
+        ComputeEndEvent(
+            nx.MultiDiGraph(),
+            executor_stats={
+                "workers": {"w1": {"clock_offset": 5.0, "clock_rtt": 0.002}}
+            },
+        )
+    )
+    offs = col.clock_offsets()
+    assert offs["w1"]["source"] == "handshake"
+    assert offs["w1"]["offset"] == 5.0
+    events = col.merged_tracer().events
+    task = next(e for e in events if e["cat"] == "task")
+    sub = next(e for e in events if e["cat"] == "storage")
+    # aligned within the handshake's accuracy, not 5 seconds off
+    assert abs(task["ts"] - (now - 0.01)) < 0.01
+    assert abs(sub["ts"] - (now - 0.008)) < 0.01
+    assert task["lane"] == "worker w1" and sub["lane"] == "worker w1"
+
+
+def test_latency_estimate_aligns_unlabelled_remote_process():
+    """With no handshake (multiprocess pool), the min result-shipping
+    delta estimates the offset; a big skew is corrected."""
+    col = TraceCollector(trace_dir=None)
+    col.on_compute_start(_start_event())
+    now = time.time()
+    skew = -3.0
+    for i in range(5):
+        col.on_task_end(
+            _task_event(chunk=str(i), start=now + skew - 0.01,
+                        end=now + skew, pid=99999, result=now + 0.001 * i)
+        )
+    col.on_compute_end(ComputeEndEvent(nx.MultiDiGraph()))
+    offs = col.clock_offsets()
+    assert offs["pid-99999"]["source"] == "latency"
+    assert offs["pid-99999"]["offset"] == pytest.approx(3.0, abs=0.05)
+
+
+def test_same_clock_latency_noise_is_not_treated_as_skew():
+    """Sub-threshold shipping latency (same-host pool) must not warp
+    timestamps."""
+    col = TraceCollector(trace_dir=None)
+    col.on_compute_start(_start_event())
+    now = time.time()
+    col.on_task_end(
+        _task_event(start=now - 0.01, end=now, pid=99999, result=now + 0.004)
+    )
+    col.on_compute_end(ComputeEndEvent(nx.MultiDiGraph()))
+    assert col.clock_offsets()["pid-99999"] == {
+        "offset": 0.0, "source": "local"
+    }
+
+
+def test_client_pid_needs_no_offset():
+    col = TraceCollector(trace_dir=None)
+    col.on_compute_start(_start_event())
+    col.on_task_end(_task_event(pid=os.getpid()))
+    assert col.clock_offsets()["client"]["offset"] == 0.0
+
+
+def test_skewed_worker_spans_order_correctly_after_alignment():
+    """Two workers skewed in opposite directions: after alignment their
+    spans interleave in true execution order within ~1 RTT."""
+    col = TraceCollector(trace_dir=None)
+    col.on_compute_start(_start_event())
+    now = time.time()
+    rtt = 0.004
+    # true order: w1's task ran 0-10ms, w2's ran 20-30ms; raw timestamps
+    # would order them the other way around
+    col.on_task_end(
+        _task_event(chunk="a", start=now + 2.0, end=now + 2.01,
+                    worker="w1", result=now + 0.012)
+    )
+    col.on_task_end(
+        _task_event(chunk="b", start=now - 3.0 + 0.02, end=now - 3.0 + 0.03,
+                    worker="w2", result=now + 0.032)
+    )
+    col.on_compute_end(
+        ComputeEndEvent(
+            nx.MultiDiGraph(),
+            executor_stats={
+                "workers": {
+                    "w1": {"clock_offset": -2.0, "clock_rtt": rtt},
+                    "w2": {"clock_offset": 3.0, "clock_rtt": rtt},
+                }
+            },
+        )
+    )
+    events = [e for e in col.merged_tracer().events if e["cat"] == "task"]
+    by_chunk = {e["args"]["chunk"]: e for e in events}
+    # aligned: w1's span ends before w2's starts (modulo one RTT)
+    assert (
+        by_chunk["a"]["ts"] + by_chunk["a"]["dur"]
+        <= by_chunk["b"]["ts"] + rtt
+    )
+
+
+# ---------------------------------------------------------------------------
+# bounded buffers
+# ---------------------------------------------------------------------------
+
+
+def test_task_scope_span_buffer_is_bounded():
+    with task_scope() as scope:
+        for i in range(accounting.MAX_TASK_SPANS + 25):
+            scope.add_span(f"s{i}", 0.0, 1.0)
+    assert len(scope.spans) == accounting.MAX_TASK_SPANS
+    assert scope.spans_dropped == 25
+    stats = scope.stats()
+    assert stats["spans_dropped"] == 25
+    assert len(stats["spans"]) == accounting.MAX_TASK_SPANS
+
+
+def test_spans_dropped_reaches_the_metrics_registry():
+    before = get_registry().counter("spans_dropped").value
+    col = TraceCollector(trace_dir=None)
+    col.on_compute_start(_start_event())
+    col.on_task_end(_task_event(spans_dropped=7))
+    assert get_registry().counter("spans_dropped").value == before + 7
+
+
+def test_task_record_retention_is_bounded_and_counted():
+    col = TraceCollector(trace_dir=None, max_task_records=3)
+    col.on_compute_start(_start_event())
+    for i in range(5):
+        col.on_task_end(_task_event(chunk=str(i)))
+    assert len(col._records) == 3
+    assert col.records_dropped == 2
+
+
+def test_scope_span_records_error_and_noops_without_scope():
+    with accounting.spans_scoped(True):
+        # no scope: nothing recorded, nothing raised
+        with accounting.scope_span("outside"):
+            pass
+        with task_scope() as scope:
+            with pytest.raises(ValueError):
+                with accounting.scope_span("fails", cat="storage"):
+                    raise ValueError("boom")
+    assert len(scope.spans) == 1
+    span = scope.spans[0]
+    assert span["name"] == "fails"
+    assert span["attrs"]["error"] is True
+    assert span["attrs"]["error_type"] == "ValueError"
+
+
+def test_scope_span_records_nothing_while_disarmed():
+    # recording is pay-for-what-you-watch: no collector armed it, so a
+    # task scope buffers nothing and ships no span payload
+    assert not accounting.spans_enabled()
+    with task_scope() as scope:
+        with accounting.scope_span("storage_read", cat="storage"):
+            pass
+    assert scope.spans == []
+    assert scope.spans_dropped == 0
+    with accounting.spans_scoped(True):
+        assert accounting.spans_enabled()
+        with task_scope() as scope:
+            with accounting.scope_span("storage_read", cat="storage"):
+                pass
+        assert [s["name"] for s in scope.spans] == ["storage_read"]
+    assert not accounting.spans_enabled()
+
+
+def test_spans_env_var_wins_over_scoped_arming(monkeypatch):
+    monkeypatch.setenv(accounting.SPANS_ENV_VAR, "1")
+    assert accounting.spans_enabled()
+    # wire mirroring reflects the effective state
+    assert accounting.spans_wire() is True
+    monkeypatch.setenv(accounting.SPANS_ENV_VAR, "0")
+    with accounting.spans_scoped(True):
+        # operator's explicit off wins over programmatic arming
+        assert not accounting.spans_enabled()
+
+
+# ---------------------------------------------------------------------------
+# straggler watch
+# ---------------------------------------------------------------------------
+
+
+def test_live_straggler_watch_counts_and_records(caplog):
+    before = get_registry().counter("stragglers_detected").value
+    col = TraceCollector(trace_dir=None, straggler_factor=3.0,
+                         straggler_min_s=0.05, straggler_min_tasks=5)
+    col.on_compute_start(_start_event())
+    now = time.time()
+    for i in range(6):
+        col.on_task_end(
+            _task_event(chunk=str(i), start=now, end=now + 0.02)
+        )
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="cubed_tpu"):
+        col.on_task_end(
+            _task_event(chunk="slow", start=now, end=now + 1.0)
+        )
+    assert get_registry().counter("stragglers_detected").value == before + 1
+    assert any("straggler" in r.message for r in caplog.records)
+    tail = decisions_since(now - 1)
+    assert any(
+        d["kind"] == "straggler" and d["chunk"] == "slow" for d in tail
+    )
+    # the post-hoc table agrees with the live flag
+    rows = col.stragglers()
+    assert rows and rows[0]["chunk"] == "slow"
+    assert rows[0]["factor"] > 3.0
+
+
+def test_fast_ops_produce_no_stragglers():
+    before = get_registry().counter("stragglers_detected").value
+    col = TraceCollector(trace_dir=None)
+    col.on_compute_start(_start_event())
+    now = time.time()
+    for i in range(20):
+        col.on_task_end(_task_event(chunk=str(i), start=now, end=now + 0.01))
+    assert get_registry().counter("stragglers_detected").value == before
+    assert col.stragglers() == []
+
+
+# ---------------------------------------------------------------------------
+# merged export
+# ---------------------------------------------------------------------------
+
+
+def test_export_merges_decisions_and_samples_and_is_loadable(tmp_path):
+    col = TraceCollector(trace_dir=str(tmp_path))
+    col.on_compute_start(_start_event("c-exp"))
+    record_decision("retry", op="op-a", chunk="0.0", delay_s=0.1)
+    record_sample(rss=123456789, pressure=1)
+    col.on_task_end(
+        _task_event(spans=[{"name": "kernel_apply", "ts": time.time(),
+                            "dur": 0.001, "cat": "kernel"}])
+    )
+    col.on_compute_end(ComputeEndEvent(nx.MultiDiGraph()))
+    assert col.trace_path == str(tmp_path / "trace-c-exp.json")
+    doc = json.load(open(col.trace_path))
+    lanes = _events_by_lane(doc["traceEvents"])
+    assert any(e["name"] == "retry" for e in lanes.get("scheduler", []))
+    assert any(
+        e["ph"] == "C" and e["name"] == "rss_bytes"
+        for e in lanes.get("memory", [])
+    )
+    client = lanes.get("client tasks", [])
+    assert any(e.get("cat") == "kernel" for e in client)
+    assert any(e.get("cat") == "task" for e in client)
+    assert samples_since(0)  # the ring kept the sample
+
+
+def test_execute_with_stats_ships_spans_pid_and_worker_label():
+    from cubed_tpu.runtime.utils import execute_with_stats
+
+    def body(m):
+        with accounting.scope_span("storage_read", cat="storage", key="0.0"):
+            pass
+        return m
+
+    accounting.set_process_label("test-worker")
+    try:
+        with accounting.spans_scoped(True):
+            _, stats = execute_with_stats(body, ("op-x", 0, 0))
+    finally:
+        accounting.set_process_label(None)
+    assert stats["pid"] == os.getpid()
+    assert stats["worker"] == "test-worker"
+    assert [s["name"] for s in stats["spans"]] == ["storage_read"]
+    assert stats["spans_dropped"] == 0
+    # the stats dict still builds a TaskEndEvent directly
+    TaskEndEvent(array_name="op-x", **stats)
+
+
+def test_failed_task_spans_ride_the_exception_to_the_trace(tmp_path):
+    """A raising task's span buffer lands on the merged trace: the buffer
+    rides the exception (surviving a pickle round-trip, like the pool and
+    fleet channels give it) and record_failed_task merges it with
+    error=True on the failing worker's lane."""
+    import pickle
+
+    from cubed_tpu.observability.collect import record_failed_task
+    from cubed_tpu.runtime.utils import execute_with_stats
+
+    def body(m):
+        with accounting.scope_span("storage_read", cat="storage", key="0.0"):
+            pass
+        raise OSError("disk on fire")
+
+    with accounting.spans_scoped(True):
+        with pytest.raises(OSError) as excinfo:
+            execute_with_stats(body, ("op-f", 0, 0))
+    stats = excinfo.value.cubed_tpu_task_stats
+    assert stats["error_type"] == "OSError"
+    assert [s["name"] for s in stats["spans"]] == ["storage_read"]
+    assert stats["function_end_tstamp"] >= stats["function_start_tstamp"]
+
+    # the attribute survives pickling (how it crosses the pool boundary)
+    exc = pickle.loads(pickle.dumps(excinfo.value))
+    assert exc.cubed_tpu_task_stats["spans"]
+
+    col = TraceCollector(trace_dir=str(tmp_path))
+    col.on_compute_start(_start_event("c-fail"))
+    record_failed_task("op-f", "(op-f, 0, 0)", 0, exc)
+    col.on_compute_end(ComputeEndEvent(nx.MultiDiGraph()))
+    doc = json.load(open(col.trace_path))
+    lanes = _events_by_lane(doc["traceEvents"])
+    client = lanes.get("client tasks", [])
+    failed = [e for e in client if e.get("cat") == "task"
+              and e["args"].get("error")]
+    assert failed and failed[0]["args"]["error_type"] == "OSError"
+    assert any(e["name"] == "storage_read" for e in client)
+
+
+def test_failed_task_without_stats_is_a_noop():
+    from cubed_tpu.observability.collect import (
+        oob_tasks_since,
+        record_failed_task,
+    )
+
+    t0 = time.time()
+    record_failed_task("op", "0.0", 0, ValueError("no stats attached"))
+    assert [t for t in oob_tasks_since(t0) if t["op"] == "op"] == []
+
+
+def test_repair_spans_reach_the_merged_trace(tmp_path):
+    from cubed_tpu.observability.collect import record_repair_spans
+
+    col = TraceCollector(trace_dir=str(tmp_path))
+    col.on_compute_start(_start_event("c-rep"))
+    with accounting.spans_scoped(True):
+        with task_scope() as scope:
+            with accounting.scope_span(
+                "recompute_repair", cat="repair", chunk="0.0"
+            ):
+                pass
+    record_repair_spans("0.0", "/store/x", scope.stats())
+    col.on_compute_end(ComputeEndEvent(nx.MultiDiGraph()))
+    doc = json.load(open(col.trace_path))
+    lanes = _events_by_lane(doc["traceEvents"])
+    client = lanes.get("client tasks", [])
+    assert any(
+        e["name"] == "recompute_repair" and e.get("cat") == "repair"
+        for e in client
+    )

@@ -1,0 +1,189 @@
+"""Flight recorder + diagnose CLI: a failed chaos compute leaves a bundle
+that names the failing op/chunk and top stragglers; the CLI renders it."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import networkx as nx
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.diagnose import main as diagnose_main
+from cubed_tpu.observability import FlightRecorder, load_bundle
+from cubed_tpu.observability.collect import record_decision
+from cubed_tpu.runtime.types import (
+    ComputeEndEvent,
+    ComputeStartEvent,
+    TaskEndEvent,
+)
+
+
+@pytest.fixture
+def spec_factory(tmp_path):
+    def make(**kw):
+        return ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB", **kw)
+
+    return make
+
+
+def _failed_chaos_compute(tmp_path, spec, callbacks=None):
+    """A compute guaranteed to fail via seeded chaos injection."""
+    from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+
+    an = np.arange(64.0).reshape(8, 8)
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    target = xp.add(xp.add(a, 1), 1)
+    with pytest.raises(Exception):
+        target.compute(
+            callbacks=callbacks,
+            executor=AsyncPythonDagExecutor(retries=1),
+            optimize_graph=False,
+        )
+
+
+def test_failed_chaos_compute_produces_readable_bundle(tmp_path, spec_factory, capsys):
+    spec = spec_factory(
+        fault_injection={"seed": 7, "task_failure_rate": 1.0}
+    )
+    fr = FlightRecorder(bundle_dir=str(tmp_path / "fr"))
+    _failed_chaos_compute(tmp_path, spec, callbacks=[fr])
+
+    assert fr.bundle_path is not None
+    assert sorted(os.listdir(fr.bundle_path)) == [
+        "logs.jsonl", "manifest.json", "trace.json"
+    ]
+    bundle = load_bundle(fr.bundle_path)
+    m = bundle["manifest"]
+    assert m["status"] == "failed"
+    assert m["error"]["type"] == "FaultInjectedTaskError"
+    # the failing op/chunk are named, not just the exception text
+    assert m["error"]["op"]
+    assert m["error"]["chunk"]
+    assert m["failing_tasks"]
+    assert m["metrics"]["tasks_started"] > 0
+    assert bundle["trace"]["traceEvents"]
+    # retry decisions made it into the timeline
+    kinds = {d["kind"] for d in m["decisions"]}
+    assert "task_failed" in kinds and "retry" in kinds
+
+    # the CLI renders it and names the failing op
+    rc = diagnose_main([fr.bundle_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "[failed]" in out
+    assert "FaultInjectedTaskError" in out
+    assert m["error"]["op"] in out
+    assert "retries timeline" in out
+
+
+def test_diagnose_cli_runs_as_a_module(tmp_path, spec_factory):
+    spec = spec_factory(
+        fault_injection={"seed": 3, "task_failure_rate": 1.0}
+    )
+    fr = FlightRecorder(bundle_dir=str(tmp_path / "fr2"))
+    _failed_chaos_compute(tmp_path, spec, callbacks=[fr])
+    proc = subprocess.run(
+        [sys.executable, "-m", "cubed_tpu.diagnose", fr.bundle_path],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "[failed]" in proc.stdout
+    assert "failure" in proc.stdout
+
+
+def test_diagnose_cli_errors_cleanly_on_missing_bundle(tmp_path, capsys):
+    rc = diagnose_main([str(tmp_path / "nope")])
+    assert rc == 2
+    assert "cannot read bundle" in capsys.readouterr().err
+
+
+def test_bundle_names_top_stragglers(tmp_path, capsys):
+    """Synthetic straggler-heavy compute: the bundle's straggler table and
+    the CLI's 'top stragglers' section name the slow task."""
+    fr = FlightRecorder(bundle_dir=str(tmp_path / "fr3"), always=True)
+    fr.on_compute_start(
+        ComputeStartEvent(nx.MultiDiGraph(), compute_id="c-strag")
+    )
+    now = time.time()
+    for i in range(8):
+        fr.on_task_end(
+            TaskEndEvent(
+                array_name="op-a", chunk_key=str(i),
+                function_start_tstamp=now, function_end_tstamp=now + 0.02,
+            )
+        )
+    fr.on_task_end(
+        TaskEndEvent(
+            array_name="op-a", chunk_key="slowpoke",
+            function_start_tstamp=now, function_end_tstamp=now + 2.0,
+            worker="local-1",
+        )
+    )
+    fr.on_compute_end(ComputeEndEvent(nx.MultiDiGraph()))
+    assert fr.bundle_path  # always=True bundles successes too
+    m = load_bundle(fr.bundle_path)["manifest"]
+    assert m["status"] == "succeeded"
+    assert m["stragglers"][0]["chunk"] == "slowpoke"
+    assert m["stragglers"][0]["worker"] == "local-1"
+    rc = diagnose_main([fr.bundle_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "top stragglers" in out
+    assert "slowpoke" in out
+
+
+def test_dump_on_demand_without_failure(tmp_path):
+    fr = FlightRecorder(bundle_dir=str(tmp_path / "fr4"))
+    fr.on_compute_start(
+        ComputeStartEvent(nx.MultiDiGraph(), compute_id="c-ok")
+    )
+    fr.on_compute_end(ComputeEndEvent(nx.MultiDiGraph()))
+    assert fr.bundle_path is None  # success + on_failure-only: no bundle
+    path = fr.dump()
+    assert os.path.exists(os.path.join(path, "manifest.json"))
+    assert load_bundle(path)["manifest"]["status"] == "succeeded"
+
+
+def test_env_var_arms_flight_recorder_for_every_compute(
+    tmp_path, spec_factory, monkeypatch
+):
+    from cubed_tpu.observability.flightrecorder import FLIGHT_RECORDER_ENV_VAR
+
+    bundles = tmp_path / "auto-fr"
+    monkeypatch.setenv(FLIGHT_RECORDER_ENV_VAR, str(bundles))
+    spec = spec_factory(
+        fault_injection={"seed": 11, "task_failure_rate": 1.0}
+    )
+    _failed_chaos_compute(tmp_path, spec, callbacks=None)
+    made = [d for d in os.listdir(bundles) if d.startswith("bundle-")]
+    assert len(made) == 1
+    m = load_bundle(str(bundles / made[0]))["manifest"]
+    assert m["status"] == "failed"
+
+
+def test_decision_ring_feeds_failing_task_payloads(tmp_path):
+    fr = FlightRecorder(bundle_dir=str(tmp_path / "fr5"))
+    fr.on_compute_start(
+        ComputeStartEvent(nx.MultiDiGraph(), compute_id="c-pay")
+    )
+    record_decision(
+        "task_failed", op="op-x", chunk="2.3", attempt=1,
+        error_type="ValueError", error="bad block",
+        classification="fail_fast",
+    )
+    err = ValueError("bad block")
+    fr.on_compute_end(ComputeEndEvent(nx.MultiDiGraph(), error=err))
+    m = json.load(
+        open(os.path.join(fr.bundle_path, "manifest.json"))
+    )
+    assert m["error"]["op"] == "op-x"
+    assert m["error"]["chunk"] == "2.3"
+    assert m["failing_tasks"][-1]["error"] == "bad block"

@@ -1,0 +1,111 @@
+"""Structured-log correlation: contextvars, the record filter, the JSON
+formatter, the bounded ring, and end-to-end attribution through a real
+compute."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.observability import logs
+
+
+def test_compute_scope_binds_contextvar_and_env(monkeypatch):
+    monkeypatch.delenv(logs.COMPUTE_ID_ENV_VAR, raising=False)
+    assert logs.current_compute_id() is None
+    with logs.compute_scope("c-123", export_env=True):
+        assert logs.current_compute_id() == "c-123"
+        assert os.environ[logs.COMPUTE_ID_ENV_VAR] == "c-123"
+        with logs.compute_scope("c-nested"):
+            assert logs.current_compute_id() == "c-nested"
+        assert logs.current_compute_id() == "c-123"
+    assert logs.current_compute_id() is None
+    assert logs.COMPUTE_ID_ENV_VAR not in os.environ
+
+
+def test_env_fallback_is_how_pool_workers_inherit(monkeypatch):
+    # a spawned pool worker has no contextvar, only the exported env
+    monkeypatch.setenv(logs.COMPUTE_ID_ENV_VAR, "c-from-env")
+    assert logs.current_compute_id() == "c-from-env"
+
+
+def test_task_context_binds_op_and_chunk():
+    with logs.task_context(op="op-a", chunk="1.2", compute_id="c-t"):
+        assert logs.op_var.get() == "op-a"
+        assert logs.chunk_var.get() == "1.2"
+        assert logs.current_compute_id() == "c-t"
+    assert logs.op_var.get() is None and logs.chunk_var.get() is None
+
+
+def test_context_filter_injects_fields():
+    record = logging.LogRecord(
+        "cubed_tpu.x", logging.WARNING, __file__, 1, "msg", (), None
+    )
+    with logs.task_context(op="op-b", chunk="0.0", compute_id="c-f"):
+        assert logs.ContextFilter().filter(record) is True
+    assert record.compute_id == "c-f"
+    assert record.op == "op-b"
+    assert record.chunk == "0.0"
+
+
+def test_structured_formatter_emits_parseable_json():
+    record = logging.LogRecord(
+        "cubed_tpu.y", logging.ERROR, __file__, 1, "it %s", ("broke",), None
+    )
+    with logs.task_context(op="op-c", chunk="3", compute_id="c-j"):
+        line = logs.StructuredFormatter().format(record)
+    doc = json.loads(line)
+    assert doc["message"] == "it broke"
+    assert doc["level"] == "ERROR"
+    assert (doc["compute_id"], doc["op"], doc["chunk"]) == ("c-j", "op-c", "3")
+    assert doc["pid"] == os.getpid()
+
+
+def test_ring_handler_captures_correlated_records():
+    ring = logs.install(capacity=500)
+    with logs.task_context(op="op-ring", chunk="7", compute_id="c-ring"):
+        logging.getLogger("cubed_tpu.tests.ring").warning("ring me")
+    recs = [r for r in ring.records() if r["message"] == "ring me"]
+    assert recs
+    assert recs[-1]["compute_id"] == "c-ring"
+    assert recs[-1]["op"] == "op-ring"
+    assert logs.recent_records(5)  # module-level accessor sees the same ring
+
+
+def test_ring_is_bounded():
+    ring = logs.RecentRecordsHandler(capacity=3)
+    logger = logging.Logger("standalone")
+    logger.addHandler(ring)
+    for i in range(10):
+        logger.warning("m%d", i)
+    msgs = [r["message"] for r in ring.records()]
+    assert msgs == ["m7", "m8", "m9"]
+
+
+def test_compute_log_lines_carry_the_compute_id(tmp_path):
+    """End-to-end: a record emitted from inside a task body during a real
+    compute carries that compute's id and the task's op/chunk context."""
+    ring = logs.install()
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+    an = np.arange(16.0).reshape(4, 4)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+
+    probe = logging.getLogger("cubed_tpu.tests.probe")
+
+    def noisy(x):
+        probe.warning("inside a task")
+        return x + 1
+
+    result = ct.map_blocks(noisy, xp.add(a, 1), dtype=a.dtype).compute()
+    np.testing.assert_allclose(result, an + 2)
+    recs = [r for r in ring.records() if r["message"] == "inside a task"]
+    assert recs
+    assert all(r["compute_id"].startswith("c-") for r in recs)
+    # chunk context set by execute_with_stats around the task body
+    assert all(r["chunk"] != "-" for r in recs)

@@ -38,7 +38,34 @@ def test_span_records_exception_and_does_not_swallow():
         pass
     else:  # pragma: no cover
         raise AssertionError("span must not swallow exceptions")
-    assert tr.events[0]["args"]["error"] == "ValueError"
+    assert tr.events[0]["args"]["error"] is True
+    assert tr.events[0]["args"]["error_type"] == "ValueError"
+
+
+def test_open_span_is_exported_closed_with_error_not_dropped():
+    """A span still open at export (a task raised through a frame holding
+    it, or a mid-compute export) appears in chrome_events closed at the
+    export instant with error=True — never silently dropped."""
+    tr = Tracer()
+    with tr.span("done"):
+        pass
+    sp = tr.span("left-open")
+    sp.__enter__()
+    events = tr.chrome_events()
+    open_recs = [
+        e for e in events if e.get("ph") == "X" and e["name"] == "left-open"
+    ]
+    assert len(open_recs) == 1
+    assert open_recs[0]["args"]["error"] is True
+    assert open_recs[0]["args"]["unterminated"] is True
+    # the synthesized close is export-only: the live span is untouched and
+    # records its real completion when it finally exits
+    assert all(e["name"] != "left-open" for e in tr.events)
+    sp.__exit__(None, None, None)
+    assert any(e["name"] == "left-open" for e in tr.events)
+    assert "error" not in [
+        e for e in tr.events if e["name"] == "left-open"
+    ][0]["args"]
 
 
 def test_nesting_is_per_thread():
