@@ -208,6 +208,92 @@ x = jax.jit(lambda: jnp.sum(jnp.ones((256, 256), jnp.float32)))()
 print("smoke ok", float(x), round(time.perf_counter() - t0, 2), flush=True)
 """
 
+#: fleet sizes for the scaling sweep (tasks/sec per size; efficiency is
+#: tps(n) / (n * tps(1)))
+FLEET_SIZES = (1, 2, 4, 8)
+#: tasks in the sweep workload and the per-task sleep: sleep-bound bodies
+#: make tasks/sec measure the FLEET's dispatch/requeue machinery (what the
+#: autoscaler and drain path touch), not this host's core count
+FLEET_TASKS = 64
+FLEET_TASK_DELAY_S = 0.05
+
+FLEET_SCALING = r"""
+import json, sys, tempfile, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import cubed_tpu as ct
+from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+
+
+class SleepAdd:
+    def __init__(self, delay_s):
+        self.delay_s = delay_s
+
+    def __call__(self, x):
+        time.sleep(self.delay_s)
+        return x + 1.0
+
+
+an = np.arange({tasks!r} * 4, dtype=np.float64).reshape(-1, 4)
+out = {{}}
+for n in {sizes!r}:
+    spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="2GB")
+    a = ct.from_array(an, chunks=(1, 4), spec=spec)  # one row per task
+    r = ct.map_blocks(SleepAdd({delay!r}), a, dtype=np.float64)
+    ex = DistributedDagExecutor(n_local_workers=n)
+    try:
+        ex._ensure_fleet()  # boot outside the timed window
+        t0 = time.perf_counter()
+        val = np.asarray(r.compute(executor=ex))
+        elapsed = time.perf_counter() - t0
+    finally:
+        ex.close()
+    assert (val == an + 1.0).all()
+    out[str(n)] = {tasks!r} / elapsed
+    print("fleet", n, "workers:", round(out[str(n)], 1), "tasks/s",
+          file=sys.stderr, flush=True)
+print(json.dumps(out), flush=True)
+"""
+
+
+def measure_fleet_scaling(timeout: float):
+    """tasks/sec on the distributed fleet at 1→2→4→8 local workers.
+
+    Runs tunnel-free (the fleet path never touches a device); each size
+    boots a fresh fleet, runs a sleep-bound 64-task compute, and reports
+    tasks/sec. The parent derives per-size scaling efficiency
+    (``tps(n) / (n * tps(1))``) so fleet-dispatch regressions become a
+    tracked number instead of an anecdote. Returns ``None`` on failure —
+    the scaling record is additive, never the reason a bench run dies."""
+    script = FLEET_SCALING.format(
+        repo=REPO, sizes=list(FLEET_SIZES), tasks=FLEET_TASKS,
+        delay=FLEET_TASK_DELAY_S,
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            env=_scrubbed_cpu_env(),
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"fleet scaling failed (rc={out.returncode}): "
+                f"{out.stderr[-2000:]}"
+            )
+        tps = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        print(f"fleet scaling sweep skipped: {e}", file=sys.stderr)
+        return None
+    base = tps.get("1")
+    efficiency = {
+        size: tp / (int(size) * base)
+        for size, tp in tps.items()
+        if base and int(size) > 1
+    }
+    return {"tasks_per_s": tps, "efficiency": efficiency}
+
 
 def _scrubbed_cpu_env() -> dict:
     """Tunnel-free env: no plugin-gating vars, ONE CPU device.
@@ -590,6 +676,15 @@ def main() -> None:
                 "executor_stats": stats or None,
             }
 
+    # fleet scaling: tasks/sec at 1→2→4→8 workers, budget permitting —
+    # sleep-bound tasks, so ~20s of sweep + fleet boots
+    if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 90:
+        scaling = measure_fleet_scaling(_remaining(120))
+        if scaling is not None:
+            metrics_record["fleet_scaling"] = scaling
+    else:
+        print("fleet scaling sweep skipped: out of budget", file=sys.stderr)
+
     # per-op timing / IO-byte trajectories ride alongside the headline
     # numbers so future rounds can localize regressions without re-profiling
     prev_trajectory = _previous_trajectory()
@@ -655,6 +750,55 @@ def _delta_pct(cur, old):
     return (cur - old) / old * 100.0
 
 
+def _print_scaling_deltas(cur: dict, old: dict, label: str) -> None:
+    """Fleet-scaling trajectory: per-size tasks/sec and scaling efficiency
+    vs the previous record, with a LOUD flag on any >20 % efficiency drop
+    — the number the autoscaler/drain machinery is on the hook for, so it
+    must not be able to rot silently."""
+    tps, eff = cur.get("tasks_per_s") or {}, cur.get("efficiency") or {}
+    line = ", ".join(
+        f"{n}w {tp:.1f}/s" + (
+            f" (eff {eff[n]:.2f})" if n in eff else ""
+        )
+        for n, tp in sorted(tps.items(), key=lambda kv: int(kv[0]))
+    )
+    print(f"trajectory fleet_scaling: {line}", file=sys.stderr)
+    old_tps = old.get("tasks_per_s") or {}
+    old_eff = old.get("efficiency") or {}
+    if not old_tps:
+        print("trajectory fleet_scaling: no prior record to compare "
+              f"against in {label}" if label else
+              "trajectory fleet_scaling: first record", file=sys.stderr)
+        return
+    regressed = []
+    for size in sorted(eff, key=int):
+        pct = _delta_pct(eff.get(size), old_eff.get(size))
+        if pct is not None and pct <= -20.0:
+            regressed.append(
+                f"{size}w efficiency {eff[size]:.2f} vs "
+                f"{old_eff[size]:.2f} ({pct:+.1f}%)"
+            )
+    # absolute throughput at each size backs the efficiency ratios: a run
+    # where EVERY size slowed equally keeps its efficiency but is still a
+    # fleet-dispatch regression
+    for size in sorted(tps, key=int):
+        pct = _delta_pct(tps.get(size), old_tps.get(size))
+        if pct is not None and pct <= -20.0:
+            regressed.append(
+                f"{size}w {tps[size]:.1f} tasks/s vs "
+                f"{old_tps[size]:.1f} ({pct:+.1f}%)"
+            )
+    if regressed:
+        print(
+            "SCALING REGRESSION (>20% vs " + (label or "prior record")
+            + "): " + "; ".join(regressed),
+            file=sys.stderr,
+        )
+    else:
+        print(f"trajectory fleet_scaling: within 20% of {label}",
+              file=sys.stderr)
+
+
 def _print_trajectory_deltas(metrics_record: dict, prev_trajectory) -> None:
     """One line per config vs the previous trajectory (stderr — stdout's
     last line belongs to the driver), so the bench history stops being
@@ -667,6 +811,10 @@ def _print_trajectory_deltas(metrics_record: dict, prev_trajectory) -> None:
         return
     for metric, cur in metrics_record.items():
         old = prev.get(metric)
+        if metric == "fleet_scaling":
+            _print_scaling_deltas(cur, old if isinstance(old, dict) else {},
+                                  label)
+            continue
         if not isinstance(old, dict):
             print(f"trajectory {metric}: new config (no prior record in "
                   f"{label})", file=sys.stderr)

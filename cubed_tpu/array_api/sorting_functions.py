@@ -149,13 +149,9 @@ def argsort(x, /, *, axis=-1, descending=False, stable=True):
         if BACKEND == "jax":
             idx = nxp.argsort(a, axis=axis, stable=stable, descending=descending)
         elif descending:
-            # numpy has no descending, and negating wraps unsigned/INT_MIN.
-            # Identity: stable-argsort the axis-reversed array, map positions
-            # back (m-1-idx), reverse the result -> values descending with
-            # ties in first-appearance order (the spec's stable meaning).
-            m = a.shape[axis]
-            idx_r = nxp.argsort(nxp.flip(a, axis=axis), axis=axis, stable=True)
-            idx = nxp.flip(m - 1 - idx_r, axis=axis)
+            # numpy has no descending, and negating wraps unsigned/INT_MIN —
+            # the shared flip-identity kernel handles it for all dtypes
+            idx = _stable_argsort_kernel(a, axis, True)
         else:
             idx = nxp.argsort(a, axis=axis, stable=stable or None)
         return idx.astype(np.int64)
@@ -250,6 +246,35 @@ def _searchsorted_partial_counts(x1, x2, side):
     return _sum(partials, axis=0)
 
 
+def _stable_argsort_kernel(a, axis: int, descending: bool):
+    """Stable in-kernel argsort along ``axis``, either direction, safe for
+    ALL real dtypes. Descending must NOT negate the keys: negation wraps
+    unsigned ints (``-1 -> UINT_MAX``) and ``INT_MIN``, silently producing
+    wrong orderings. jax has native stable-descending; elsewhere the
+    flip identity applies: ``argsort_desc(x) = flip(m-1 - argsort_asc(
+    flip(x)))`` — values descending, ties in first-appearance order."""
+    if not descending:
+        return nxp.argsort(a, axis=axis, stable=True)
+    if BACKEND == "jax":
+        return nxp.argsort(a, axis=axis, stable=True, descending=True)
+    m = a.shape[axis]
+    idx_r = nxp.argsort(nxp.flip(a, axis=axis), axis=axis, stable=True)
+    return nxp.flip(m - 1 - idx_r, axis=axis)
+
+
+def _pad_sentinel(dtype, descending: bool):
+    """The least-competitive value of ``dtype`` for a top-k pad slot: one
+    that can never beat a real element (``±inf`` only exists for floats —
+    integer pads must use the dtype's own extremes)."""
+    dt = np.dtype(dtype)
+    if dt.kind in "iu":
+        info = np.iinfo(dt)
+        return info.min if descending else info.max
+    if dt.kind == "b":
+        return not descending
+    return -np.inf if descending else np.inf
+
+
 def _topk_args(x, k, axis, fname):
     if not isinstance(k, (int, np.integer)) or isinstance(k, bool) or k == 0:
         raise ValueError(f"{fname}: k must be a non-zero integer")
@@ -301,7 +326,7 @@ def _topk_impl(x, k, axis, want_indices):
 
     c = x.chunksize[axis]
     numblocks = x.numblocks
-    sentinel = -np.inf if desc else np.inf
+    sentinel = _pad_sentinel(x.dtype, desc)
     offsets = _offsets_array_for(x)
     x_name, off_name = x.name, offsets.name
 
@@ -310,8 +335,7 @@ def _topk_impl(x, k, axis, want_indices):
 
     def _local_topk(block, off):
         bi = block_index_from_offset(off, axis, numblocks)
-        key = nxp.negative(block) if desc else block
-        order = nxp.argsort(key, axis=axis, stable=True)
+        order = _stable_argsort_kernel(block, axis, desc)
         vals = nxp.take_along_axis(block, order, axis=axis)
         idxs = (order + bi * c).astype(np.int64)
         ln = block.shape[axis]
@@ -362,8 +386,7 @@ def _topk_impl(x, k, axis, want_indices):
     def _merge_topk(v_blocks, i_blocks):
         v = nxp.concatenate(list(v_blocks), axis=axis)
         i = nxp.concatenate(list(i_blocks), axis=axis)
-        key = nxp.negative(v) if desc else v
-        order = nxp.argsort(key, axis=axis, stable=True)
+        order = _stable_argsort_kernel(v, axis, desc)
         sel = tuple(
             slice(0, kk) if d == axis else slice(None)
             for d in range(v.ndim)

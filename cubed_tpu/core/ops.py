@@ -1600,12 +1600,25 @@ def map_overlap(
         np.dtype(x.dtype).itemsize, np.dtype(dtype).itemsize
     )
 
+    if trim:
+        out_shape, out_chunks = shape, chunks
+    else:
+        # dask semantics: the untrimmed result keeps its halo, so every
+        # output block is the EXTENDED block — chunks grow by 2*depth per
+        # axis (numblocks unchanged, so block ids still address the same
+        # source block)
+        out_chunks = tuple(
+            tuple(c + 2 * depths[ax] for c in chunks[ax])
+            for ax in range(ndim)
+        )
+        out_shape = tuple(sum(c) for c in out_chunks)
+
     return map_direct(
         _read_overlap,
         x,
-        shape=shape,
+        shape=out_shape,
         dtype=np.dtype(dtype),
-        chunks=chunks,
+        chunks=out_chunks,
         extra_projected_mem=extra,
         spec=x.spec,
     )

@@ -67,6 +67,15 @@ class WorkerLostError(RuntimeError):
     """The worker executing a task disconnected before reporting a result."""
 
 
+class WorkerDrainedError(WorkerLostError):
+    """A draining worker (scale-down, or a spot preemption notice) abandoned
+    this task before completing it. A subclass of ``WorkerLostError`` so the
+    retry policy classifies it ``REQUEUE``: the task reroutes to a survivor
+    without drawing the user-visible retry budget — chunk-granular resume
+    (PR 3) makes the replay cheap, and the worker's completed chunks are
+    already durable in the shared store."""
+
+
 class TaskTimeoutError(RuntimeError):
     """A task exceeded the coordinator's ``task_timeout`` without a result."""
 
@@ -169,6 +178,13 @@ class _WorkerConn:
         #: total tasks ever routed to this worker (load diagnostics)
         self.tasks_sent = 0
         self.alive = True
+        #: the worker announced (or was asked) to drain: routing passes it
+        #: over while any non-draining worker is live, and its abandoned
+        #: tasks requeue free (WorkerDrainedError)
+        self.draining = False
+        #: guards _drop_worker against double-drops (recv-loop error racing
+        #: a timeout-loop eviction or a clean drained departure)
+        self.dropped = False
         #: last heartbeat-reported RSS (bytes) and memory-pressure flag —
         #: the coordinator stops dispatching to a pressured worker while
         #: any unpressured one is live (runtime/memory.py watermarks)
@@ -207,6 +223,16 @@ class Coordinator:
         #: zero-worker submit reads very differently when 4 joined and died
         #: vs when nothing ever connected)
         self._workers_ever = 0
+        #: names of every worker that ever joined — the autoscaler settles
+        #: its pending-spawn bookkeeping against this, so a worker that
+        #: registers and dies between two policy ticks still reads as a
+        #: hole to backfill, not as still-pending capacity (strings only;
+        #: unbounded but tiny even for a fleet churning thousands)
+        self._worker_names_ever: set = set()
+        #: set (>0) by an attached Autoscaler: a momentarily-empty fleet is
+        #: expected to be backfilled, so submit() waits up to this long for
+        #: a replacement to register before raising NoWorkersError
+        self.backfill_grace_s: float = 0.0
         self._lock = threading.Lock()
         self._next_task_id = 0
         self._closed = threading.Event()
@@ -232,7 +258,8 @@ class Coordinator:
         #: diagnostics: blob bytes actually sent vs referenced by id
         self.stats: Dict[str, int] = {
             "blobs_sent": 0, "tasks_sent": 0, "task_timeouts": 0,
-            "workers_lost": 0,
+            "workers_lost": 0, "drains_completed": 0, "workers_preempted": 0,
+            "tasks_abandoned_on_drain": 0,
         }
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="coordinator-accept", daemon=True
@@ -267,6 +294,7 @@ class Coordinator:
             with self._lock:
                 self._workers.append(conn)
                 self._workers_ever += 1
+                self._worker_names_ever.add(conn.name)
                 self._worker_joined.notify_all()
             threading.Thread(
                 target=self._recv_loop,
@@ -300,9 +328,20 @@ class Coordinator:
         with self._lock:
             return len([w for w in self._workers if w.alive])
 
-    def _drop_worker(self, conn: _WorkerConn, reason: str) -> None:
+    def _drop_worker(
+        self, conn: _WorkerConn, reason: str, clean: bool = False
+    ) -> None:
+        """Remove a worker. ``clean=True`` marks an orderly departure (a
+        completed drain): it is not counted as ``workers_lost`` — the fleet
+        asked it to leave (or it left within its preemption notice), and
+        its in-flight work was already handed back explicitly."""
+        with self._lock:
+            if conn.dropped:
+                return  # recv-loop error racing another drop: already done
+            conn.dropped = True
         if (
             self.exit_probe is not None
+            and not clean
             and reason != "shutdown"
             and not reason.startswith("hung")
         ):
@@ -316,11 +355,14 @@ class Coordinator:
             except Exception:
                 code = None
             if code is not None:
-                hint = (
-                    " — likely OOM-killed (SIGKILL)"
-                    if code in (-9, 137)
-                    else ""
-                )
+                if code in (-9, 137) and conn.draining:
+                    # the drain protocol's own hard-kill deadline exits
+                    # 137 — a worker we KNEW was draining did not OOM
+                    hint = " — hard-killed at end of drain/preemption notice"
+                elif code in (-9, 137):
+                    hint = " — likely OOM-killed (SIGKILL)"
+                else:
+                    hint = ""
                 reason = f"{reason} (worker process exitcode {code}{hint})"
         with self._lock:
             conn.alive = False
@@ -334,6 +376,7 @@ class Coordinator:
                 "outstanding": 0,
                 "ghosts": len(conn.ghost_ids),
                 "tasks_sent": conn.tasks_sent,
+                "drained": clean,
                 "clock_offset": conn.clock_offset,
                 "clock_rtt": conn.clock_rtt,
             }
@@ -345,17 +388,29 @@ class Coordinator:
             conn.sock.close()
         except OSError:
             pass
+        exc_cls = WorkerDrainedError if clean else WorkerLostError
         for task_id, fut in orphans:
             _fail_future(
-                fut, WorkerLostError(f"worker {conn.name} lost: {reason}")
+                fut, exc_cls(f"worker {conn.name} lost: {reason}")
             )
-        if orphans or reason != "shutdown":
-            self.stats["workers_lost"] += 1
+        if clean and orphans:
+            # tasks still queued on the worker when its drain closed the
+            # socket: abandoned like the in-flight ones, requeued free
+            with self._lock:
+                self.stats["tasks_abandoned_on_drain"] += len(orphans)
+            get_registry().counter("tasks_abandoned_on_drain").inc(
+                len(orphans)
+            )
+        if (orphans or reason != "shutdown") and not clean:
+            with self._lock:
+                self.stats["workers_lost"] += 1
             get_registry().counter("workers_lost").inc()
             logger.warning(
                 "worker %s dropped (%s); failed %d in-flight tasks",
                 conn.name, reason, len(orphans),
             )
+        elif clean:
+            logger.info("worker %s departed cleanly (%s)", conn.name, reason)
 
     def _recv_loop(self, conn: _WorkerConn) -> None:
         try:
@@ -447,6 +502,48 @@ class Coordinator:
                     with self._lock:
                         conn.clock_offset = msg.get("clock_offset")
                         conn.clock_rtt = msg.get("clock_rtt")
+                elif mtype == "draining":
+                    # the worker stops accepting work NOW (scale-down drain
+                    # or a spot preemption notice); routing passes it over,
+                    # in-flight tasks finish or come back as "abandoned"
+                    from ..observability.collect import record_decision
+
+                    reason = msg.get("reason") or "drain"
+                    with self._lock:
+                        conn.draining = True
+                        if reason == "preempted":
+                            self.stats["workers_preempted"] += 1
+                    if reason == "preempted":
+                        get_registry().counter("workers_preempted").inc()
+                    record_decision(
+                        "worker_draining", worker=conn.name, reason=reason,
+                        grace_s=msg.get("grace_s"),
+                    )
+                    logger.info(
+                        "worker %s draining (%s, grace %.3fs)",
+                        conn.name, reason, msg.get("grace_s", 0) or 0,
+                    )
+                elif mtype == "abandoned":
+                    # a task that reached a draining worker before routing
+                    # noticed: handed back unexecuted — a free requeue
+                    with self._lock:
+                        fut = conn.outstanding.pop(msg["task_id"], None)
+                        conn.deadlines.pop(msg["task_id"], None)
+                        conn.ghost_ids.discard(msg["task_id"])
+                    if fut is not None:
+                        with self._lock:
+                            self.stats["tasks_abandoned_on_drain"] += 1
+                        get_registry().counter("tasks_abandoned_on_drain").inc()
+                        _fail_future(
+                            fut,
+                            WorkerDrainedError(
+                                f"worker {conn.name} draining: task "
+                                f"{msg['task_id']} abandoned before start"
+                            ),
+                        )
+                elif mtype == "drained":
+                    self._on_drained(conn, msg)
+                    return  # the worker closes its socket right after
                 elif mtype == "blob_dropped":
                     # the worker evicted this blob from its bounded caches;
                     # forget we sent it so the next task of that op
@@ -463,6 +560,101 @@ class Coordinator:
         except Exception:
             logger.exception("receiver for %s crashed", conn.name)
             self._drop_worker(conn, "receiver crash")
+
+    def _on_drained(self, conn: _WorkerConn, msg: dict) -> None:
+        """A worker finished its drain: fail its abandoned in-flight tasks
+        with ``WorkerDrainedError`` (free requeue), count the drain, and
+        remove the worker cleanly (not a ``workers_lost``)."""
+        from ..observability.collect import record_decision
+
+        reason = msg.get("reason") or "drain"
+        abandoned = list(msg.get("abandoned") or [])
+        pairs = []
+        with self._lock:
+            for tid in abandoned:
+                pairs.append((tid, conn.outstanding.pop(tid, None)))
+                conn.deadlines.pop(tid, None)
+                conn.ghost_ids.discard(tid)
+        n_abandoned = 0
+        for tid, fut in pairs:
+            if fut is None:
+                continue  # its late result won the race: nothing to requeue
+            n_abandoned += 1
+            _fail_future(
+                fut,
+                WorkerDrainedError(
+                    f"worker {conn.name} drained ({reason}): in-flight task "
+                    f"{tid} abandoned at the end of the drain window"
+                ),
+            )
+        with self._lock:
+            # stats increments stay under the coordinator lock: concurrent
+            # per-worker recv threads (a coordinated reclaim drains many
+            # workers at once) must not lose dict '+=' interleavings
+            if n_abandoned:
+                self.stats["tasks_abandoned_on_drain"] += n_abandoned
+            self.stats["drains_completed"] += 1
+        if n_abandoned:
+            get_registry().counter("tasks_abandoned_on_drain").inc(n_abandoned)
+        get_registry().counter("drains_completed").inc()
+        record_decision(
+            "worker_drained", worker=conn.name, reason=reason,
+            abandoned=n_abandoned,
+        )
+        self._drop_worker(conn, f"drained ({reason})", clean=True)
+
+    def request_drain(
+        self, name: str, grace_s: float = 30.0, reason: str = "scale_down"
+    ) -> bool:
+        """Ask worker ``name`` to drain: stop accepting tasks, finish (or
+        abandon) in-flight work within ``grace_s``, report ``drained`` and
+        leave. Routing passes the worker over from this call on. Returns
+        False when no live worker has that name (already gone)."""
+        from ..observability.collect import record_decision
+
+        with self._lock:
+            conn = next(
+                (w for w in self._workers if w.alive and w.name == name), None
+            )
+            if conn is None:
+                return False
+            conn.draining = True  # stop routing immediately, not on the ack
+        try:
+            send_frame(
+                conn.sock,
+                {"type": "drain", "grace_s": grace_s, "reason": reason},
+                conn.send_lock,
+            )
+        except (ConnectionError, OSError) as e:
+            self._drop_worker(conn, f"drain send failed: {e}")
+            return False
+        record_decision(
+            "worker_drain_requested", worker=name, reason=reason,
+            grace_s=grace_s,
+        )
+        return True
+
+    def known_worker_names(self) -> set:
+        """Every worker name that ever joined (live or departed)."""
+        with self._lock:
+            return set(self._worker_names_ever)
+
+    def load_view(self) -> list:
+        """Per-worker load rows for the autoscaler's policy loop: one dict
+        per live worker (name, draining, pressured, outstanding incl. ghost
+        slots, nthreads). Cheap — one pass under the lock."""
+        with self._lock:
+            return [
+                {
+                    "name": w.name,
+                    "draining": w.draining,
+                    "pressured": w.pressured,
+                    "outstanding": len(w.outstanding) + len(w.ghost_ids),
+                    "nthreads": w.nthreads,
+                }
+                for w in self._workers
+                if w.alive
+            ]
 
     def _timeout_loop(self) -> None:
         """Fail tasks that exceed ``task_timeout`` so the caller's retry
@@ -547,6 +739,23 @@ class Coordinator:
         while True:
             with self._lock:
                 live = [w for w in self._workers if w.alive]
+                if (
+                    not live
+                    and self.backfill_grace_s > 0
+                    and self._workers_ever > 0
+                    and not self._closed.is_set()
+                ):
+                    # an attached autoscaler owes the fleet a replacement
+                    # (e.g. the LAST worker was preempted/drained and the
+                    # backfill subprocess is still booting): wait for it to
+                    # register instead of failing the compute the drain
+                    # protocol promised to protect
+                    self._worker_joined.wait_for(
+                        lambda: any(w.alive for w in self._workers)
+                        or self._closed.is_set(),
+                        timeout=self.backfill_grace_s,
+                    )
+                    live = [w for w in self._workers if w.alive]
                 if not live:
                     host, port = self.address
                     ever = self._workers_ever
@@ -570,15 +779,42 @@ class Coordinator:
                         f"cannot submit task: no live workers connected to "
                         f"coordinator {host}:{port}; {hint}"
                     )
+                if (
+                    self.backfill_grace_s > 0
+                    and not self._closed.is_set()
+                    and all(w.draining for w in live)
+                ):
+                    # every live worker is draining (a coordinated spot
+                    # reclaim hit the whole fleet): routing to a drainer
+                    # is an instant abandon->requeue ping-pong that burns
+                    # the free requeue allowance in milliseconds — far
+                    # faster than any replacement can boot. Wait for the
+                    # backfill to register; drainers remain the fallback
+                    # if none arrives within the grace window.
+                    self._worker_joined.wait_for(
+                        lambda: any(
+                            w.alive and not w.draining for w in self._workers
+                        )
+                        or self._closed.is_set(),
+                        timeout=self.backfill_grace_s,
+                    )
+                    live = [w for w in self._workers if w.alive]
+                    if not live:
+                        continue  # drainers gone: the no-live path decides
+                # draining workers are passed over while any non-draining
+                # one is live (an all-draining fleet still takes the task:
+                # it may be abandoned and requeued, which beats failing the
+                # compute outright when no replacement can come)
+                active = [w for w in live if not w.draining] or live
                 # memory-pressured workers are passed over while any
                 # unpressured one is live (never deadlock: an all-pressured
                 # fleet still gets the least-loaded worker — the admission
                 # controller is what sheds load in that state)
-                unpressured = [w for w in live if not w.pressured]
-                if unpressured and len(unpressured) < len(live):
+                unpressured = [w for w in active if not w.pressured]
+                if unpressured and len(unpressured) < len(active):
                     get_registry().counter("dispatch_skipped_pressured").inc()
                 conn = min(
-                    unpressured or live,
+                    unpressured or active,
                     key=lambda w: (len(w.outstanding) + len(w.ghost_ids))
                     / max(w.nthreads, 1),
                 )
@@ -669,6 +905,7 @@ class Coordinator:
                     "tasks_sent": w.tasks_sent,
                     "rss": w.rss,
                     "pressured": w.pressured,
+                    "draining": w.draining,
                     "clock_offset": w.clock_offset,
                     "clock_rtt": w.clock_rtt,
                 }
@@ -679,6 +916,8 @@ class Coordinator:
         self._closed.set()
         with self._lock:
             workers = list(self._workers)
+            # wake any submit() blocked on a backfill wait: closed wins
+            self._worker_joined.notify_all()
         for conn in workers:
             try:
                 send_frame(conn.sock, {"type": "shutdown"}, conn.send_lock)
@@ -700,14 +939,23 @@ def run_worker(
     coordinator: str,
     nthreads: int = 1,
     name: Optional[str] = None,
+    drain_grace_s: float = 10.0,
 ) -> None:
     """Connect to ``host:port`` and execute tasks until shutdown/EOF.
 
     One process per host; ``nthreads`` concurrent task slots (chunk tasks are
     IO + numpy/jax compute, so a few threads per host overlap IO with
     compute the same way the threaded local executor does).
-    """
+
+    The worker honors a graceful **drain** (used by autoscaler scale-down
+    and by spot preemption): stop accepting tasks, finish — or, at the end
+    of the grace window, abandon — in-flight work, report ``drained`` with
+    the abandoned task ids, and exit. ``SIGTERM`` triggers the same path
+    with spot semantics (``drain_grace_s`` models the preemption notice;
+    the platform's hard kill at the end of the notice is modelled by a
+    hard-exit timer so a wedged task can't outlive its notice)."""
     import cloudpickle
+    import signal as _signal
     from concurrent.futures import ThreadPoolExecutor
 
     from ..observability import clock as obs_clock
@@ -763,8 +1011,121 @@ def run_worker(
         decoded_cap = 256
     blob_lock = threading.Lock()
     stop = threading.Event()
+    #: drain state: once armed, no new task starts; in-flight tasks get the
+    #: grace window, then are abandoned. ``grace`` is mutable so an injected
+    #: preemption can carry its own (shorter) notice window
+    drain = {"on": False, "grace": float(drain_grace_s)}
+    inflight: set[int] = set()
+    inflight_lock = threading.Lock()
+
+    def _drain_loop(reason: str, grace_s: float) -> None:
+        deadline = time.monotonic() + max(0.0, grace_s)
+        while time.monotonic() < deadline:
+            with inflight_lock:
+                if not inflight:
+                    break
+            time.sleep(0.02)
+        with inflight_lock:
+            abandoned = sorted(inflight)
+        try:
+            send_frame(
+                sock,
+                {"type": "drained", "reason": reason, "abandoned": abandoned},
+                send_lock,
+            )
+        except (ConnectionError, OSError):
+            pass
+        stop.set()
+        try:
+            sock.close()  # unblocks the main recv loop
+        except OSError:
+            pass
+        if abandoned and sigterm_installed:
+            # abandoned tasks are still running on pool threads; the process
+            # must not linger joining them past its drain window (the
+            # "drained" frame is already in the kernel send buffer — a
+            # graceful FIN flushes it). An embedded (non-main-thread)
+            # worker does not own its process: leave the orphans to their
+            # daemon threads instead of exiting the host
+            os._exit(0)
+
+    def _begin_drain(reason: str, grace_s: float) -> None:
+        with inflight_lock:
+            if drain["on"]:
+                return
+            drain["on"] = True
+        logger.warning(
+            "worker %s: draining (%s, grace %.3fs, %d in flight)",
+            wname, reason, grace_s, len(inflight),
+        )
+        try:
+            send_frame(
+                sock,
+                {"type": "draining", "reason": reason, "grace_s": grace_s},
+                send_lock,
+            )
+        except (ConnectionError, OSError):
+            stop.set()
+        if reason == "preempted" and sigterm_installed:
+            # spot semantics: the platform hard-kills at the end of the
+            # notice window regardless of progress — model it so a wedged
+            # in-flight task cannot outlive its preemption notice (small
+            # epsilon lets a just-finished drain report first). Embedded
+            # workers don't own the process: no hard-kill modelling
+            t = threading.Timer(grace_s + 0.5, os._exit, args=(137,))
+            t.daemon = True
+            t.start()
+        threading.Thread(
+            target=_drain_loop, args=(reason, grace_s),
+            name=f"worker-drain-{wname}", daemon=True,
+        ).start()
+
+    def _on_sigterm(signum, frame):
+        # the spot preemption notice: drain inside the window, then die.
+        # Hand off to a thread — the handler interrupts the main thread
+        # mid-anything, and _begin_drain takes send_lock/inflight_lock,
+        # which the interrupted frame may be holding (a non-reentrant
+        # lock acquired from the handler would self-deadlock)
+        threading.Thread(
+            target=_begin_drain, args=("preempted", drain["grace"]),
+            name=f"worker-sigterm-{wname}", daemon=True,
+        ).start()
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_sigterm)
+        sigterm_installed = True
+    except ValueError:
+        # not the main thread (embedded use): no spot semantics — injected
+        # preemptions must then drain directly instead of raising a
+        # default-disposition SIGTERM that would kill the HOST process
+        sigterm_installed = False
 
     def run_task(msg: dict) -> None:
+        task_id = msg["task_id"]
+        with inflight_lock:
+            if drain["on"]:
+                rejected = True
+            else:
+                rejected = False
+                inflight.add(task_id)
+        if rejected:
+            # raced the drain start: hand the task back unexecuted so the
+            # coordinator requeues it free instead of waiting for a timeout
+            try:
+                send_frame(
+                    sock, {"type": "abandoned", "task_id": task_id},
+                    send_lock,
+                )
+            except (ConnectionError, OSError):
+                stop.set()
+            return
+        try:
+            _run_task_inner(msg)
+        finally:
+            with inflight_lock:
+                inflight.discard(task_id)
+
+    def _run_task_inner(msg: dict) -> None:
         task_id = msg["task_id"]
         # correlate every log line/span this task emits with the client's
         # compute (the id rides each task message; None clears stale state)
@@ -793,6 +1154,21 @@ def run_worker(
                 elif action == "hang":
                     logger.warning("worker %s: injected hang", wname)
                     time.sleep(injector.config.worker_hang_s)
+                elif action == "preempt":
+                    # injected spot preemption: SIGTERM ourselves so the
+                    # REAL handler runs (notice -> drain -> hard kill); the
+                    # current task stays in flight and races the window
+                    logger.warning(
+                        "worker %s: injected spot preemption (notice %.2fs)",
+                        wname, injector.config.preempt_notice_s,
+                    )
+                    drain["grace"] = float(injector.config.preempt_notice_s)
+                    if sigterm_installed:
+                        os.kill(os.getpid(), _signal.SIGTERM)
+                    else:
+                        # embedded (non-main-thread) worker: no handler to
+                        # receive the signal — drain directly
+                        _begin_drain("preempted", drain["grace"])
             blob_id = msg["blob_id"]
             # decode under a lock (concurrent same-blob tasks must not race
             # the decode/pop), inside the task try: an undeserializable op
@@ -949,7 +1325,8 @@ def run_worker(
         target=heartbeat_loop, name=f"worker-heartbeat-{wname}", daemon=True
     ).start()
 
-    with ThreadPoolExecutor(max_workers=max(nthreads, 1)) as pool:
+    pool = ThreadPoolExecutor(max_workers=max(nthreads, 1))
+    try:
         try:
             while not stop.is_set():
                 msg = recv_frame(sock)
@@ -958,6 +1335,16 @@ def run_worker(
                     if msg.get("blob") is not None:
                         raw_blobs[msg["blob_id"]] = msg["blob"]
                     pool.submit(run_task, msg)
+                elif mtype == "drain":
+                    # graceful scale-down (or an operator-initiated drain):
+                    # same path as the SIGTERM handler, reason carried over
+                    # (grace_s=0.0 is a legitimate "abandon immediately" —
+                    # only an ABSENT grace falls back to the default)
+                    g = msg.get("grace_s")
+                    _begin_drain(
+                        msg.get("reason") or "scale_down",
+                        float(drain["grace"] if g is None else g),
+                    )
                 elif mtype == "heartbeat_echo":
                     # NTP-style: the coordinator echoed our t0 with its own
                     # clock; offset = t_coord - midpoint(t0, t1), accurate
@@ -1000,8 +1387,29 @@ def run_worker(
                 else:
                     logger.warning("worker: unknown message %r", mtype)
         except (ConnectionError, OSError):
-            pass  # coordinator gone: drain and exit
+            pass  # coordinator gone (or our drain closed the socket): exit
+    finally:
+        # every exit from the recv loop — shutdown frame, coordinator
+        # gone, or our own drain — means the coordinator has already
+        # failed this worker's outstanding futures, so queued tasks
+        # produce results nobody can receive: cancel them instead of
+        # running them out
+        pool.shutdown(wait=False, cancel_futures=True)
     try:
         sock.close()
     except OSError:
         pass
+    if sigterm_installed:
+        # give RUNNING tasks a moment to finish (their threads are
+        # non-daemon: the interpreter would join them at exit), then
+        # leave without blocking on a hung one — close() escalates to
+        # SIGKILL after 10s otherwise, which is strictly worse. Embedded
+        # (non-main-thread) workers don't own the process: they return
+        # and leave stragglers to their own threads
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            with inflight_lock:
+                if not inflight:
+                    return
+            time.sleep(0.02)
+        os._exit(0)

@@ -28,6 +28,7 @@ import logging
 import os
 import subprocess
 import sys
+import threading
 from typing import Optional
 
 from ...observability import accounting
@@ -93,6 +94,10 @@ class DistributedDagExecutor(DagExecutor):
         n_local_workers: Optional[int] = None,
         listen: Optional[str] = None,
         min_workers: Optional[int] = None,
+        max_workers: Optional[int] = None,
+        autoscale: Optional[bool] = None,
+        autoscale_policy=None,
+        drain_grace_s: float = 30.0,
         worker_threads: int = 1,
         worker_start_timeout: float = 60.0,
         task_timeout: Optional[float] = None,
@@ -111,6 +116,26 @@ class DistributedDagExecutor(DagExecutor):
         self.min_workers = min_workers if min_workers is not None else (
             n_local_workers or 1
         )
+        self.max_workers = max_workers
+        if max_workers is not None:
+            floor = max(self.min_workers, n_local_workers or 0)
+            if max_workers < floor:
+                raise ValueError(
+                    f"max_workers={max_workers} is below the fleet floor "
+                    f"(min_workers={self.min_workers}, n_local_workers="
+                    f"{n_local_workers}): the ceiling could never be "
+                    "honored — lower the initial fleet or raise max_workers"
+                )
+        # the autoscaler is on when asked for explicitly, or implied by a
+        # max_workers ceiling / a full policy object; a plain fixed-size
+        # fleet (the historical constructor) keeps its exact old behavior
+        self.autoscale = (
+            autoscale
+            if autoscale is not None
+            else (max_workers is not None or autoscale_policy is not None)
+        )
+        self.autoscale_policy = autoscale_policy
+        self.drain_grace_s = drain_grace_s
         self.worker_threads = worker_threads
         self.worker_start_timeout = worker_start_timeout
         self.task_timeout = task_timeout
@@ -122,7 +147,13 @@ class DistributedDagExecutor(DagExecutor):
         self.retry_policy = retry_policy
         self.kwargs = kwargs
         self._coordinator: Optional[Coordinator] = None
+        #: append-only spawn log: worker ``local-<i>`` is ``_procs[i]``
+        #: forever (replacements append with fresh indices), which keeps
+        #: the exit probe correct across the autoscaler's churn; retired/
+        #: dead entries stay (a reaped Popen costs nothing to re-wait)
         self._procs: list[subprocess.Popen] = []
+        self._procs_lock = threading.Lock()
+        self._autoscaler = None
 
     @property
     def name(self) -> str:
@@ -133,11 +164,16 @@ class DistributedDagExecutor(DagExecutor):
     @property
     def stats(self) -> dict:
         """Coordinator counters (blobs_sent, tasks_sent, task_timeouts,
-        workers_lost) plus a per-worker load snapshot; empty before the
-        fleet starts."""
+        workers_lost, drains_completed, workers_preempted,
+        tasks_abandoned_on_drain) plus a per-worker load snapshot and, when
+        the autoscaler runs, its scale counters; empty before the fleet
+        starts."""
         if self._coordinator is None:
             return {}
-        return self._coordinator.stats_snapshot()
+        out = self._coordinator.stats_snapshot()
+        if self._autoscaler is not None:
+            out["autoscale"] = dict(self._autoscaler.stats)
+        return out
 
     @property
     def coordinator_address(self) -> Optional[str]:
@@ -162,35 +198,116 @@ class DistributedDagExecutor(DagExecutor):
             coord = Coordinator("127.0.0.1", 0, task_timeout=self.task_timeout,
                                 timeout_strikes=self.timeout_strikes)
         self._coordinator = coord
+        initial_names: list = []
         if self.n_local_workers:
-            host, port = coord.address
-            env = _worker_env()
-            for i in range(self.n_local_workers):
-                self._procs.append(
-                    subprocess.Popen(
-                        [
-                            sys.executable,
-                            "-m",
-                            "cubed_tpu.runtime.worker",
-                            f"{host}:{port}",
-                            "--threads",
-                            str(self.worker_threads),
-                            "--name",
-                            f"local-{i}",
-                        ],
-                        env=env,
-                    )
-                )
+            for _ in range(self.n_local_workers):
+                initial_names.append(self._spawn_local_worker())
             # locally spawned workers have inspectable exit codes: a
             # dropped connection plus -9/137 reads as OOM-killed, and the
             # WorkerLostError message says so instead of a bare reset
             coord.exit_probe = self._local_worker_exitcode
+        if self.autoscale:
+            from ..autoscale import Autoscaler, AutoscalePolicy
+
+            initial = self.n_local_workers or self.min_workers or 1
+            mw = max(1, self.min_workers or 1)
+            policy = self.autoscale_policy or AutoscalePolicy(
+                min_workers=mw,
+                max_workers=self.max_workers or max(8, initial, mw),
+                drain_grace_s=self.drain_grace_s,
+            )
+            factory = (
+                _LocalWorkerFactory(self) if self.n_local_workers else None
+            )
+            self._autoscaler = Autoscaler(
+                coord, factory=factory, policy=policy,
+                initial_workers=initial, pending_workers=initial_names,
+            )
+            self._autoscaler.start()
         try:
             coord.wait_for_workers(self.min_workers, self.worker_start_timeout)
         except TimeoutError:
             self.close()
             raise
         return coord
+
+    def _spawn_local_worker(self) -> str:
+        """Spawn one local worker subprocess; returns its name. Used for
+        the initial fleet and as the autoscaler's ``WorkerFactory`` — the
+        single-host stand-in for asking the cloud for another (spot)
+        instance."""
+        coord = self._coordinator
+        assert coord is not None
+        host, port = coord.address
+        cmd = [
+            sys.executable,
+            "-m",
+            "cubed_tpu.runtime.worker",
+            f"{host}:{port}",
+            "--threads",
+            str(self.worker_threads),
+        ]
+        # operator convention: the env knob wins (it feeds the worker
+        # CLI's --drain-grace default); only without it does the
+        # executor's configured grace ride the command line
+        if "CUBED_TPU_DRAIN_GRACE_S" not in os.environ:
+            cmd += ["--drain-grace", str(self.drain_grace_s)]
+        with self._procs_lock:
+            i = len(self._procs)
+            name = f"local-{i}"
+            self._procs.append(
+                subprocess.Popen(
+                    cmd + ["--name", name], env=_worker_env()
+                )
+            )
+        return name
+
+    def _proc_for(self, name: str) -> Optional[subprocess.Popen]:
+        """Popen for a locally spawned worker name (``local-<i>``), or
+        None for out-of-band names / unknown indices."""
+        if not name.startswith("local-"):
+            return None
+        try:
+            i = int(name.split("-", 1)[1])
+        except ValueError:
+            return None
+        with self._procs_lock:
+            try:
+                return self._procs[i]
+            except IndexError:
+                return None
+
+    def _retire_local_worker(self, name: str) -> None:
+        """Reap a worker whose graceful drain was already requested: wait
+        for it to exit on its own inside the grace window, escalate to
+        SIGTERM/SIGKILL if it lingers. Runs on a daemon thread so the
+        autoscaler's policy loop never blocks on a slow exit."""
+        proc = self._proc_for(name)
+        if proc is None:
+            return
+        # the reap deadline must cover the grace the DRAIN was granted —
+        # the autoscaler's policy grace when it initiated the retirement,
+        # which may exceed this executor's own drain_grace_s default
+        scaler = self._autoscaler
+        grace = (
+            scaler.policy.drain_grace_s if scaler is not None
+            else self.drain_grace_s
+        )
+
+        def reap() -> None:
+            try:
+                proc.wait(timeout=grace + 10)
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=5)
+
+        threading.Thread(
+            target=reap, name=f"reap-{name}", daemon=True
+        ).start()
 
     def _local_worker_exitcode(self, name: str):
         """Exit code of a locally spawned worker (names ``local-<i>``), or
@@ -199,11 +316,8 @@ class DistributedDagExecutor(DagExecutor):
         resetting, and a definite code is worth a short wait."""
         import time
 
-        if not name.startswith("local-"):
-            return None
-        try:
-            proc = self._procs[int(name.split("-", 1)[1])]
-        except (ValueError, IndexError):
+        proc = self._proc_for(name)
+        if proc is None:
             return None
         for _ in range(10):
             code = proc.poll()
@@ -213,17 +327,25 @@ class DistributedDagExecutor(DagExecutor):
         return None
 
     def close(self) -> None:
-        """Tear down the coordinator and any locally spawned workers."""
+        """Tear down the autoscaler, the coordinator, and every locally
+        spawned worker — including ones mid-drain or retired earlier (the
+        spawn log is append-only, so nothing is ever orphaned)."""
+        if self._autoscaler is not None:
+            # first, so it cannot backfill workers we are tearing down
+            self._autoscaler.stop()
+            self._autoscaler = None
         if self._coordinator is not None:
             self._coordinator.close()
             self._coordinator = None
-        for p in self._procs:
+        with self._procs_lock:
+            procs = list(self._procs)
+            self._procs.clear()
+        for p in procs:
             try:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait(timeout=10)
-        self._procs.clear()
 
     def __enter__(self):
         self._ensure_fleet()
@@ -245,7 +367,13 @@ class DistributedDagExecutor(DagExecutor):
         state = self.__dict__.copy()
         state["_coordinator"] = None
         state["_procs"] = []
+        state["_procs_lock"] = None
+        state["_autoscaler"] = None
         return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._procs_lock = threading.Lock()
 
     # -- execution -----------------------------------------------------
 
@@ -354,6 +482,26 @@ class DistributedDagExecutor(DagExecutor):
                     callbacks, "on_operation_end",
                     OperationEndEvent(name, primitive_op.num_tasks),
                 )
+
+
+class _LocalWorkerFactory:
+    """The autoscaler's :class:`~cubed_tpu.runtime.autoscale.WorkerFactory`
+    for locally spawned fleets: another worker subprocess on this host
+    (the single-host stand-in for another spot instance), reaped after its
+    graceful drain."""
+
+    def __init__(self, executor: DistributedDagExecutor):
+        self._executor = executor
+
+    def start_worker(self):
+        return self._executor._spawn_local_worker()
+
+    def stop_worker(self, name: str) -> None:
+        self._executor._retire_local_worker(name)
+
+    def spawn_failed(self, name: str) -> bool:
+        proc = self._executor._proc_for(name)
+        return proc is not None and proc.poll() is not None
 
 
 class _OpPool:

@@ -112,6 +112,15 @@ class FaultConfig:
     worker_hang_names: tuple = field(default_factory=tuple)
     worker_hang_after_tasks: int = 0
     worker_hang_s: float = 3600.0
+    #: probability a fleet worker is SPOT-PREEMPTED: decided once per
+    #: worker name (seeded, so ~rate of the fleet is hit deterministically),
+    #: fired when that worker's executed-task count reaches
+    #: worker_preempt_after_tasks. The worker SIGTERMs itself — exercising
+    #: the real spot path: preemption notice (preempt_notice_s) -> graceful
+    #: drain -> hard kill at the end of the notice window
+    worker_preempt_rate: float = 0.0
+    worker_preempt_after_tasks: int = 2
+    preempt_notice_s: float = 1.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "FaultConfig":
@@ -146,6 +155,7 @@ class FaultConfig:
             or (self.task_mem_spike_rate and self.task_mem_spike_bytes)
             or (self.worker_crash_names and self.worker_crash_after_tasks)
             or (self.worker_hang_names and self.worker_hang_after_tasks)
+            or (self.worker_preempt_rate and self.worker_preempt_after_tasks)
         )
 
 
@@ -254,12 +264,17 @@ class FaultInjector:
 
     def worker_task_tick(self, worker_name: str) -> Optional[str]:
         """Called once per executed task on a fleet worker; returns
-        ``"crash"``/``"hang"`` exactly when this worker's per-process task
-        count reaches the configured threshold (one-shot per process)."""
+        ``"crash"``/``"hang"``/``"preempt"`` exactly when this worker's
+        per-process task count reaches the configured threshold (one-shot
+        per process). Preemption is decided by a seeded per-name roll
+        rather than an explicit name list: at ``worker_preempt_rate=0.3``
+        about 30% of the fleet — the SAME ~30% in every replay — gets a
+        SIGTERM-then-hard-kill spot preemption mid-compute."""
         cfg = self.config
         if not (
             (cfg.worker_crash_names and cfg.worker_crash_after_tasks)
             or (cfg.worker_hang_names and cfg.worker_hang_after_tasks)
+            or (cfg.worker_preempt_rate and cfg.worker_preempt_after_tasks)
         ):
             return None
         with self._lock:
@@ -281,6 +296,21 @@ class FaultInjector:
             reg.counter("faults_injected").inc()
             reg.counter("faults_injected_worker_hang").inc()
             return "hang"
+        if (
+            cfg.worker_preempt_rate
+            and n == cfg.worker_preempt_after_tasks
+            # decided per NAME at occurrence 0 (no count consumed by other
+            # ticks): deterministic per (seed, worker) — the fleet loses
+            # the same ~rate fraction in every replay, and a replacement
+            # worker (fresh name) rolls its own fate
+            # _hit counts the injection (faults_injected +
+            # faults_injected_worker_preempt) — unlike the name-list
+            # branches above, nothing to count here
+            and self._hit(
+                "worker_preempt", worker_name, cfg.worker_preempt_rate
+            )
+        ):
+            return "preempt"
         return None
 
 

@@ -11,8 +11,24 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 
 from .distributed import run_worker
+
+
+def _default_drain_grace() -> float:
+    """``CUBED_TPU_DRAIN_GRACE_S`` or 10.0; a malformed value must not
+    crash every worker at argparse construction (the fleet would fail to
+    boot with only a wait_for_workers timeout as the diagnostic)."""
+    raw = os.environ.get("CUBED_TPU_DRAIN_GRACE_S", "")
+    try:
+        return float(raw) if raw else 10.0
+    except ValueError:
+        logging.getLogger(__name__).warning(
+            "ignoring malformed CUBED_TPU_DRAIN_GRACE_S=%r "
+            "(want a float of seconds); using default 10.0", raw,
+        )
+        return 10.0
 
 
 def main(argv=None) -> None:
@@ -23,6 +39,14 @@ def main(argv=None) -> None:
         help="concurrent task slots in this worker process (default 1)",
     )
     parser.add_argument("--name", default=None, help="worker display name")
+    parser.add_argument(
+        "--drain-grace", type=float, default=_default_drain_grace(),
+        help="seconds allowed to finish in-flight tasks when draining "
+        "(scale-down, or the SIGTERM spot-preemption notice window); "
+        "in-flight work still running at the end of the window is "
+        "abandoned and requeued by the coordinator (default 10, env "
+        "CUBED_TPU_DRAIN_GRACE_S)",
+    )
     parser.add_argument(
         "-v", "--verbose", action="store_true", help="log at INFO level"
     )
@@ -42,7 +66,10 @@ def main(argv=None) -> None:
             level=level,
             format="%(asctime)s %(name)s %(levelname)s %(message)s",
         )
-    run_worker(args.coordinator, nthreads=args.threads, name=args.name)
+    run_worker(
+        args.coordinator, nthreads=args.threads, name=args.name,
+        drain_grace_s=args.drain_grace,
+    )
 
 
 if __name__ == "__main__":

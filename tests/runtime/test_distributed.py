@@ -453,6 +453,165 @@ def test_distributed_blob_eviction_self_heals(spec, monkeypatch):
     assert np.isclose(r3, (an * 2.0).mean())
 
 
+from ..utils import SlowAdd as _SlowAdd  # noqa: E402
+
+
+def test_distributed_graceful_drain_requeues_free(spec, tmp_path):
+    """Graceful scale-down contract: draining a worker mid-compute never
+    loses a completed chunk (the result stays bitwise-correct), abandoned
+    in-flight/queued tasks requeue WITHOUT drawing the user-visible retry
+    budget, and the drain is observable in ``stats_snapshot()`` and in the
+    exported trace."""
+    import json
+
+    from cubed_tpu.observability import get_registry
+    from cubed_tpu.observability.collect import TraceCollector
+
+    an = np.arange(256, dtype=np.float64).reshape(16, 16)
+    ex = DistributedDagExecutor(n_local_workers=2)
+    before = get_registry().snapshot()
+    try:
+        coord = ex._ensure_fleet()
+        a = ct.from_array(an, chunks=(4, 4), spec=spec)  # 16 slow tasks
+        r = ct.map_blocks(_SlowAdd(0.4), a, dtype=np.float64)
+
+        seen = {"n": 0}
+
+        class DrainMidOp:
+            def on_task_end(self, event):
+                seen["n"] += 1
+                if seen["n"] == 3:  # create-array + 2 slow tasks: mid-op
+                    coord.request_drain(
+                        "local-0", grace_s=0.05, reason="scale_down"
+                    )
+
+        collector = TraceCollector(trace_dir=str(tmp_path))
+        result = r.compute(executor=ex, callbacks=[DrainMidOp(), collector])
+        np.testing.assert_array_equal(result, an + 1.0)  # nothing lost
+
+        snap = coord.stats_snapshot()
+        assert snap["drains_completed"] == 1, snap
+        assert snap["tasks_abandoned_on_drain"] >= 1, snap
+        assert snap["workers_lost"] == 0, snap  # a drain is not a loss
+        row = snap["workers"]["local-0"]
+        assert row["drained"] is True and "drained" in row["reason"], row
+        delta = get_registry().snapshot_delta(before)
+        # abandoned tasks rerouted free: requeues, not budget-drawing retries
+        assert delta.get("worker_loss_requeues", 0) >= 1, delta
+        assert delta.get("task_retries", 0) == 0, delta
+        assert delta.get("drains_completed", 0) == 1, delta
+        # ...and the drain decisions landed in the exported merged trace
+        with open(collector.trace_path) as f:
+            trace = f.read()
+        assert "worker_drain_requested" in trace
+        assert "worker_drained" in trace
+        json.loads(trace)  # still a valid Perfetto/Chrome trace
+    finally:
+        ex.close()
+
+
+def test_wait_for_workers_races_late_autoscaler_worker():
+    """``wait_for_workers`` blocking on the joined-condition must be woken
+    by workers the AUTOSCALER spawns (not only by the executor's initial
+    spawn loop) — the late-arrival race a backfill always creates."""
+    import threading as _threading
+
+    from cubed_tpu.runtime.autoscale import (
+        Autoscaler,
+        AutoscalePolicy,
+        WorkerFactory,
+    )
+    from cubed_tpu.runtime.distributed import run_worker
+
+    coord = Coordinator("127.0.0.1", 0)
+    host, port = coord.address
+
+    class ThreadWorkerFactory(WorkerFactory):
+        """In-process workers over the real socket path (fast: no
+        subprocess boot); SIGTERM spot semantics are simply absent off the
+        main thread, which run_worker tolerates."""
+
+        def __init__(self):
+            self.n = 0
+
+        def start_worker(self):
+            name = f"t-{self.n}"
+            self.n += 1
+            _threading.Thread(
+                target=run_worker, args=(f"{host}:{port}",),
+                kwargs=dict(nthreads=1, name=name), daemon=True,
+            ).start()
+            return name
+
+        def stop_worker(self, name):
+            pass
+
+    scaler = Autoscaler(
+        coord, factory=ThreadWorkerFactory(),
+        policy=AutoscalePolicy(min_workers=2, max_workers=2, interval_s=0.05),
+        initial_workers=2,
+    )
+    try:
+        scaler.start()  # begins backfilling toward desired=2 immediately
+        coord.wait_for_workers(2, timeout=30)  # woken by the late arrivals
+        assert coord.n_workers == 2
+        assert scaler.stats["workers_scaled_up"] == 2
+        # the registered workers settle the pending-spawn bookkeeping: no
+        # further spawns on subsequent ticks
+        time.sleep(0.3)
+        assert scaler.stats["workers_scaled_up"] == 2
+    finally:
+        scaler.stop()
+        coord.close()
+
+
+def test_close_during_drain_and_exit_probe_after_replacement(spec):
+    """Satellite: ``close()`` while a drain is in progress leaves no
+    orphaned local worker subprocess, and ``_procs`` bookkeeping stays
+    exit-probe-correct after the autoscaler replaces a crashed worker."""
+    from cubed_tpu.runtime.autoscale import AutoscalePolicy
+
+    ex = DistributedDagExecutor(
+        n_local_workers=2,
+        autoscale_policy=AutoscalePolicy(
+            min_workers=2, max_workers=3, interval_s=0.1,
+            idle_rounds_before_down=10**6, cooldown_down_s=3600,
+        ),
+    )
+    try:
+        coord = ex._ensure_fleet()
+        # crash local-0: the autoscaler must backfill local-2
+        os.kill(ex._procs[0].pid, signal.SIGKILL)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            names = {
+                n for n, row in coord.stats_snapshot()["workers"].items()
+                if row.get("alive")
+            }
+            if "local-2" in names and coord.n_workers >= 2:
+                break
+            time.sleep(0.1)
+        assert "local-2" in names, names
+        # exit-probe-correct after the replacement: local-<i> is _procs[i]
+        assert len(ex._procs) == 3
+        assert ex._local_worker_exitcode("local-0") == -signal.SIGKILL
+        assert ex._local_worker_exitcode("local-2") is None  # still running
+        # put a slow task in flight, then drain with a grace far longer
+        # than close() is willing to wait
+        fut = coord.submit(None, _SlowAdd(5.0), 1.0)
+        time.sleep(0.3)
+        assert coord.request_drain("local-1", grace_s=60.0, reason="scale_down")
+        procs = list(ex._procs)
+    finally:
+        ex.close()
+    assert ex._procs == []
+    deadline = time.time() + 15
+    while time.time() < deadline and any(p.poll() is None for p in procs):
+        time.sleep(0.1)
+    codes = [p.poll() for p in procs]
+    assert all(c is not None for c in codes), codes  # nothing orphaned
+
+
 def test_no_workers_error_is_actionable():
     """Satellite: zero-worker submits and worker-wait timeouts carry real
     diagnostics — address, counts seen, timeout used, and a how-to hint —
@@ -491,3 +650,121 @@ def test_compute_with_zero_workers_fails_fast(spec):
             xp.sum(a).compute(executor=ex)
     finally:
         ex.close()
+
+
+def test_last_worker_drained_submit_waits_for_backfill():
+    """Regression for the last-worker race: (a) ``grace_s=0`` is a
+    legitimate 'abandon immediately' — the worker must not substitute its
+    default drain grace and sit out the in-flight task; (b) with an
+    autoscaler-armed ``backfill_grace_s``, a submit that finds the fleet
+    momentarily empty waits for the replacement to register instead of
+    failing the compute with ``NoWorkersError``."""
+    import threading as _threading
+
+    from cubed_tpu.runtime.distributed import WorkerDrainedError, run_worker
+
+    coord = Coordinator("127.0.0.1", 0)
+    host, port = coord.address
+
+    def start_worker(name):
+        _threading.Thread(
+            target=run_worker, args=(f"{host}:{port}",),
+            kwargs=dict(nthreads=1, name=name, drain_grace_s=10.0),
+            daemon=True,
+        ).start()
+
+    try:
+        start_worker("w-0")
+        coord.wait_for_workers(1, timeout=30)
+        coord.backfill_grace_s = 10.0  # what Autoscaler.start() arms
+
+        # (a) catch a slow task in flight, drain with grace_s=0: it must be
+        # abandoned immediately, not after the worker's 10s default grace
+        # (nor after the 2s the task itself would take to finish)
+        fut = coord.submit(None, _SlowAdd(2.0), 1.0)
+        time.sleep(0.5)  # let the worker pull the task into flight
+        t0 = time.monotonic()
+        assert coord.request_drain("w-0", grace_s=0.0, reason="scale_down")
+        with pytest.raises(WorkerDrainedError):
+            fut.result(timeout=5)
+        assert time.monotonic() - t0 < 1.5  # abandoned, not waited out
+        # the drain completed cleanly and the fleet is now empty
+        deadline = time.time() + 10
+        while time.time() < deadline and coord.n_workers > 0:
+            time.sleep(0.02)
+        snap = coord.stats_snapshot()
+        assert snap["drains_completed"] == 1, snap
+        assert coord.n_workers == 0
+
+        # (b) submit against the empty fleet from a thread; it must block
+        # on the backfill grace, then land on the late replacement
+        fut2_box = {}
+
+        def _submit():
+            fut2_box["fut"] = coord.submit(None, _SlowAdd(0.0), 41.0)
+
+        t = _threading.Thread(target=_submit, daemon=True)
+        t.start()
+        time.sleep(0.3)  # let submit() reach the backfill wait
+        start_worker("w-1")  # the autoscaler's replacement registers late
+        t.join(timeout=30)
+        assert not t.is_alive()
+        result, _stats = fut2_box["fut"].result(timeout=30)
+        assert result == 42.0
+    finally:
+        coord.backfill_grace_s = 0.0
+        coord.close()
+
+
+def test_all_draining_fleet_submit_waits_for_replacement():
+    """Regression: when EVERY live worker is draining (a coordinated spot
+    reclaim of the whole fleet) and the autoscaler has armed
+    ``backfill_grace_s``, submit must wait for a non-draining replacement
+    instead of routing to a drainer — that path is an instant
+    abandon→requeue ping-pong that exhausts the free requeue allowance in
+    milliseconds, far faster than any replacement can boot."""
+    import threading as _threading
+
+    from cubed_tpu.runtime.distributed import run_worker
+
+    coord = Coordinator("127.0.0.1", 0)
+    host, port = coord.address
+
+    def start_worker(name):
+        _threading.Thread(
+            target=run_worker, args=(f"{host}:{port}",),
+            kwargs=dict(nthreads=1, name=name, drain_grace_s=10.0),
+            daemon=True,
+        ).start()
+
+    try:
+        start_worker("w-0")
+        coord.wait_for_workers(1, timeout=30)
+        coord.backfill_grace_s = 10.0  # what Autoscaler.start() arms
+
+        # keep the drain window open: an in-flight slow task means w-0
+        # stays alive-and-draining instead of reporting drained instantly
+        fut = coord.submit(None, _SlowAdd(3.0), 1.0)
+        time.sleep(0.5)
+        assert coord.request_drain("w-0", grace_s=30.0, reason="scale_down")
+
+        box = {}
+
+        def _submit():
+            box["fut"] = coord.submit(None, _SlowAdd(0.0), 41.0)
+
+        t = _threading.Thread(target=_submit, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        assert t.is_alive()  # blocked waiting, NOT handed to the drainer
+        start_worker("w-1")  # the backfill replacement registers
+        t.join(timeout=30)
+        assert not t.is_alive()
+        result, _stats = box["fut"].result(timeout=30)
+        assert result == 42.0
+        # the drainer finished its in-flight task inside the grace window
+        r0, _ = fut.result(timeout=30)
+        assert r0 == 2.0
+    finally:
+        coord.backfill_grace_s = 0.0
+        coord.close()

@@ -91,6 +91,35 @@ def test_map_overlap_on_jax_executor(spec):
     np.testing.assert_allclose(got, expected(an, "symmetric"), atol=1e-10)
 
 
+def test_map_overlap_trim_false_grows_chunks(spec):
+    """Regression: ``trim=False`` used to declare the output with the
+    SOURCE chunks while each task produced the extended (halo-kept) block
+    — a broadcast failure at write time. Dask semantics: the untrimmed
+    output keeps its halo, so chunks grow by ``2*depth`` per axis."""
+    an = np.arange(48, dtype=np.float64).reshape(8, 6)
+    a = ct.from_array(an, chunks=(4, 3), spec=spec)
+
+    def ident(b):
+        return np.asarray(b)
+
+    r = ct.map_overlap(ident, a, depth=1, boundary="nearest", trim=False)
+    assert r.chunks == ((6, 6), (5, 5))
+    assert r.shape == (12, 10)
+    got = asnp(r)
+    # every output block is the source block + its 1-deep padded halo
+    pe = np.pad(an, 1, mode="edge")
+    for bi, r0 in enumerate((0, 4)):
+        for bj, c0 in enumerate((0, 3)):
+            block = got[bi * 6:(bi + 1) * 6, bj * 5:(bj + 1) * 5]
+            np.testing.assert_array_equal(
+                block, pe[r0:r0 + 6, c0:c0 + 5]
+            )
+    # per-axis depth: only the deep axis grows
+    r2 = ct.map_overlap(ident, a, depth={0: 2}, trim=False)
+    assert r2.chunks == ((8, 8), (3, 3))
+    assert r2.shape == (16, 6)
+
+
 def test_map_overlap_1d_diffusion_step(spec):
     # heat-equation step: the canonical halo-exchange workload
     an = np.random.default_rng(4).standard_normal(1000)

@@ -161,6 +161,56 @@ def test_topk_argtopk(spec):
         xp.topk(a, 99, axis=1)
 
 
+@pytest.mark.parametrize(
+    "dtype",
+    [np.uint8, np.uint16, np.uint64, np.int8, np.int64, np.float32],
+)
+def test_topk_argtopk_descending_integer_dtypes(spec, dtype):
+    """Regression (ROADMAP item 5): descending top-k used key negation,
+    which WRAPS for unsigned dtypes (-1 -> UINT_MAX) and for INT_MIN —
+    silently wrong results, worst exactly at the extremes a top-k is asked
+    to find. The fix orders via flip-identity/native-descending argsort
+    and pads short blocks with dtype-aware sentinels (±inf doesn't exist
+    for ints)."""
+    dt = np.dtype(dtype)
+    if dt.kind in "iu":
+        info = np.iinfo(dt)
+        pool = np.array(
+            [info.min, info.max, info.min + 1, info.max - 1, 0, 1, 2, 7],
+            dtype=dt,
+        )
+    else:
+        pool = np.array([-3.0, -1.5, 0.0, 1.0, 2.5, 7.0, -8.0, 9.0], dtype=dt)
+    rng = np.random.default_rng(11)
+    an = pool[rng.integers(0, len(pool), size=(4, 24))]
+    a = ct.from_array(an, chunks=(2, 6), spec=spec)  # multi-chunk axis
+
+    # k > 0: the LARGEST k, descending — the wrap-bug case
+    got = asnp(xp.topk(a, 3, axis=1))
+    want = np.flip(np.sort(an, axis=1), axis=1)[:, :3]
+    np.testing.assert_array_equal(got, want)
+    # k < 0: the SMALLEST |k|, ascending
+    got_small = asnp(xp.topk(a, -3, axis=1))
+    np.testing.assert_array_equal(got_small, np.sort(an, axis=1)[:, :3])
+    # argtopk indices must point at genuinely-largest values
+    gi = asnp(xp.argtopk(a, 3, axis=1))
+    np.testing.assert_array_equal(np.take_along_axis(an, gi, axis=1), want)
+
+
+def test_topk_short_blocks_pad_with_integer_sentinels(spec):
+    """Blocks shorter than k force sentinel padding; with an unsigned
+    dtype the old ±inf fill is unrepresentable (and the negated sort order
+    wrong). Extremes must still win."""
+    an = np.array([[250, 255, 0, 3, 128, 2, 254, 1, 127, 129]], dtype=np.uint8)
+    a = ct.from_array(an, chunks=(1, 3), spec=spec)  # last block is ragged
+    got = asnp(xp.topk(a, 4, axis=1))  # k > several block lengths
+    np.testing.assert_array_equal(
+        got, np.flip(np.sort(an, axis=1), axis=1)[:, :4]
+    )
+    got_small = asnp(xp.topk(a, -4, axis=1))
+    np.testing.assert_array_equal(got_small, np.sort(an, axis=1)[:, :4])
+
+
 def test_topk_one_pass_engine(tmp_path):
     # k << n with a tight budget: the one-pass path must fire (the full
     # sort network would also work, but the plan should carry topk ops)

@@ -10,6 +10,21 @@ import platform
 from cubed_tpu.runtime.types import Callback
 
 
+class SlowAdd:
+    """Picklable deterministic task body with a wall-clock footprint: slow
+    enough for a drain to catch it in flight, and fleet-capacity changes
+    show up in elapsed time."""
+
+    def __init__(self, delay_s: float):
+        self.delay_s = delay_s
+
+    def __call__(self, x):
+        import time
+
+        time.sleep(self.delay_s)
+        return x + 1.0
+
+
 _ALL_EXECUTORS = None
 
 
