@@ -256,6 +256,103 @@ print(json.dumps(out), flush=True)
 """
 
 
+#: deep-chain critical-path config (pangeo-vorticity-style depth without
+#: its volume): DEPTH non-fusable map_blocks steps over an NxN grid of
+#: CHUNKxCHUNK blocks, with a ROTATING straggler — at depth d, block
+#: (d mod nblocks) sleeps DELAY. Under the op-level scheduler every op
+#: waits for its own straggler (wall ≈ DEPTH x DELAY); under the dataflow
+#: scheduler the straggler chains are independent 1:1 chunk chains, so
+#: wall ≈ DELAY + work. The ratio is the number the barrier kill is on
+#: the hook for.
+SCHED_DEPTH = 6
+SCHED_N = 8
+SCHED_CHUNK = 2
+SCHED_DELAY_S = 0.4
+
+SCHEDULER_OVERLAP = r"""
+import json, sys, tempfile, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import cubed_tpu as ct
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+
+DEPTH, N, CHUNK, DELAY = {depth!r}, {n!r}, {chunk!r}, {delay!r}
+NBR = N // CHUNK
+
+
+class StragglerStep:
+    def __init__(self, depth):
+        self.depth = depth
+
+    def __call__(self, x, block_id=None):
+        if block_id[0] * NBR + block_id[1] == self.depth % (NBR * NBR):
+            time.sleep(DELAY)
+        return x + 1.0
+
+
+an = np.arange(N * N, dtype=np.float64).reshape(N, N)
+out = {{}}
+for mode in ("oplevel", "dataflow"):
+    spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="2GB",
+                   scheduler=mode)
+    a = ct.from_array(an, chunks=(CHUNK, CHUNK), spec=spec)
+    r = a
+    for d in range(DEPTH):
+        r = ct.map_blocks(StragglerStep(d), r, dtype=np.float64)
+    reg = get_registry()
+    before = reg.snapshot()
+    t0 = time.perf_counter()
+    # optimize_graph=False keeps the chain DEEP (fusion would collapse a
+    # pure elementwise chain into one op and hide the barrier question)
+    val = np.asarray(r.compute(executor=AsyncPythonDagExecutor(),
+                               optimize_graph=False))
+    elapsed = time.perf_counter() - t0
+    delta = reg.snapshot_delta(before)
+    assert (val == an + DEPTH).all()
+    out[mode] = {{
+        "elapsed": elapsed,
+        "tasks_dispatched_early": delta.get("tasks_dispatched_early", 0),
+        "op_barrier_waits": delta.get("op_barrier_waits", 0),
+    }}
+    print("scheduler", mode, round(elapsed, 2), "s",
+          file=sys.stderr, flush=True)
+out["speedup"] = out["oplevel"]["elapsed"] / max(
+    out["dataflow"]["elapsed"], 1e-9
+)
+print(json.dumps(out), flush=True)
+"""
+
+
+def measure_scheduler_overlap(timeout: float):
+    """Deep-chain critical path: op-level vs dataflow wall clock.
+
+    Runs tunnel-free (threaded executor, host compute only). Returns
+    ``{"oplevel": {...}, "dataflow": {...}, "speedup": x}`` or None on
+    failure — additive, never the reason a bench run dies."""
+    script = SCHEDULER_OVERLAP.format(
+        repo=REPO, depth=SCHED_DEPTH, n=SCHED_N, chunk=SCHED_CHUNK,
+        delay=SCHED_DELAY_S,
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            env=_scrubbed_cpu_env(),
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"scheduler overlap failed (rc={out.returncode}): "
+                f"{out.stderr[-2000:]}"
+            )
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        print(f"scheduler overlap sweep skipped: {e}", file=sys.stderr)
+        return None
+
+
 def measure_fleet_scaling(timeout: float):
     """tasks/sec on the distributed fleet at 1→2→4→8 local workers.
 
@@ -685,21 +782,76 @@ def main() -> None:
     else:
         print("fleet scaling sweep skipped: out of budget", file=sys.stderr)
 
+    # scheduler overlap: the deep-chain critical path, op-level vs
+    # dataflow (~DEPTH x DELAY + DELAY of sleeping, well under a minute)
+    if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 45:
+        sched = measure_scheduler_overlap(_remaining(90))
+        if sched is not None:
+            metrics_record["scheduler_deepchain"] = sched
+    else:
+        print("scheduler overlap sweep skipped: out of budget",
+              file=sys.stderr)
+
     # per-op timing / IO-byte trajectories ride alongside the headline
     # numbers so future rounds can localize regressions without re-profiling
     prev_trajectory = _previous_trajectory()
+    record = {
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"), "configs": metrics_record
+    }
     try:
         path = os.path.join(REPO, "BENCH_METRICS.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(
-                {"t": time.strftime("%Y-%m-%dT%H:%M:%S"), "configs": metrics_record},
-                f, indent=1, default=str,
-            )
+            json.dump(record, f, indent=1, default=str)
         os.replace(tmp, path)
     except OSError as e:
         print(f"could not write BENCH_METRICS.json: {e}", file=sys.stderr)
+    _append_history(record)
     _print_trajectory_deltas(metrics_record, prev_trajectory)
+
+
+#: bound on retained history records (one JSON line per bench run); the
+#: perf-regression gate (tests/test_perf_gate.py) compares the last two
+HISTORY_PATH = os.path.join(REPO, "BENCH_METRICS_HISTORY.jsonl")
+HISTORY_KEEP = 50
+
+
+def _append_history(record: dict) -> None:
+    """Append this run to the rolling history the perf gate reads.
+
+    BENCH_METRICS.json is overwrite-per-run, so by itself a regression is
+    only visible to whoever ran both benches; the history file keeps the
+    trajectory on disk (bounded), compactly — per-config scalars only,
+    no nested executor_stats blobs."""
+    slim_cfgs = {}
+    for name, cfg in (record.get("configs") or {}).items():
+        if not isinstance(cfg, dict):
+            continue
+        slim = {
+            k: v for k, v in cfg.items()
+            if isinstance(v, (int, float, str)) or k in (
+                "tasks_per_s", "efficiency", "oplevel", "dataflow",
+            )
+        }
+        slim.pop("executor_stats", None)
+        slim_cfgs[name] = slim
+    line = json.dumps({"t": record.get("t"), "configs": slim_cfgs},
+                      default=str)
+    try:
+        lines = []
+        try:
+            with open(HISTORY_PATH) as f:
+                lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        except OSError:
+            pass
+        lines.append(line)
+        tmp = HISTORY_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("\n".join(lines[-HISTORY_KEEP:]) + "\n")
+        os.replace(tmp, HISTORY_PATH)
+    except OSError as e:
+        print(f"could not append BENCH_METRICS_HISTORY.jsonl: {e}",
+              file=sys.stderr)
 
 
 def _previous_trajectory():
@@ -799,6 +951,110 @@ def _print_scaling_deltas(cur: dict, old: dict, label: str) -> None:
               file=sys.stderr)
 
 
+#: relative change beyond which the perf gate calls a regression (the
+#: container's own run-to-run noise is ~±15%)
+PERF_GATE_THRESHOLD_PCT = 20.0
+
+
+def perf_regressions(prev: dict, cur: dict) -> list:
+    """Compare two bench records' configs; return regression strings.
+
+    The contract the tier-1 gate (tests/test_perf_gate.py) enforces: no
+    config's wall clock grows >20%, no fleet-scaling throughput drops
+    >20%, and the dataflow scheduler keeps beating the op barrier within
+    20% of its recorded margin. Shared here so bench.py's delta printer
+    and the test gate can never disagree about what a regression is."""
+    out = []
+    pcfgs = prev.get("configs") or {}
+    for name, cfg in (cur.get("configs") or {}).items():
+        old = pcfgs.get(name)
+        if not isinstance(old, dict) or not isinstance(cfg, dict):
+            continue
+        if name == "fleet_scaling":
+            old_tps = old.get("tasks_per_s") or {}
+            for size, tp in (cfg.get("tasks_per_s") or {}).items():
+                pct = _delta_pct(tp, old_tps.get(size))
+                if pct is not None and pct <= -PERF_GATE_THRESHOLD_PCT:
+                    out.append(
+                        f"fleet_scaling {size}w throughput {tp:.1f} vs "
+                        f"{old_tps[size]:.1f} tasks/s ({pct:+.1f}%)"
+                    )
+            continue
+        if name == "scheduler_deepchain":
+            pct = _delta_pct(cfg.get("speedup"), old.get("speedup"))
+            if pct is not None and pct <= -PERF_GATE_THRESHOLD_PCT:
+                out.append(
+                    f"scheduler_deepchain speedup {cfg['speedup']:.2f}x vs "
+                    f"{old['speedup']:.2f}x ({pct:+.1f}%)"
+                )
+            cur_df = (cfg.get("dataflow") or {}).get("elapsed")
+            old_df = (old.get("dataflow") or {}).get("elapsed")
+            pct = _delta_pct(cur_df, old_df)
+            if pct is not None and pct >= PERF_GATE_THRESHOLD_PCT:
+                out.append(
+                    f"scheduler_deepchain dataflow wall {cur_df:.2f}s vs "
+                    f"{old_df:.2f}s ({pct:+.1f}%)"
+                )
+            continue
+        pct = _delta_pct(cfg.get("elapsed"), old.get("elapsed"))
+        if pct is not None and pct >= PERF_GATE_THRESHOLD_PCT:
+            out.append(
+                f"{name} wall {cfg['elapsed']:.2f}s vs "
+                f"{old['elapsed']:.2f}s ({pct:+.1f}%)"
+            )
+    return out
+
+
+def _print_scheduler_deltas(cur: dict, old: dict, label: str) -> None:
+    """Scheduler trajectory: deep-chain wall clock per mode plus the
+    dataflow speedup, with a LOUD flag when the dataflow path stops
+    beating the op barrier (>20 % speedup drop or wall-clock regression)
+    — the number the chunk-granular scheduler is on the hook for."""
+    op = (cur.get("oplevel") or {}).get("elapsed")
+    df = (cur.get("dataflow") or {}).get("elapsed")
+    speedup = cur.get("speedup")
+    early = (cur.get("dataflow") or {}).get("tasks_dispatched_early", 0)
+    print(
+        f"trajectory scheduler_deepchain: oplevel {op:.2f}s, dataflow "
+        f"{df:.2f}s, speedup {speedup:.2f}x, {early} task(s) dispatched "
+        "early" if isinstance(op, (int, float)) and isinstance(
+            df, (int, float)
+        ) else "trajectory scheduler_deepchain: incomplete record",
+        file=sys.stderr,
+    )
+    if isinstance(speedup, (int, float)) and speedup < 1.05:
+        print(
+            "SCHEDULER REGRESSION: dataflow no longer beats the op-level "
+            f"barrier on the deep chain (speedup {speedup:.2f}x)",
+            file=sys.stderr,
+        )
+    if not old:
+        print("trajectory scheduler_deepchain: no prior record to compare "
+              f"against in {label}" if label else
+              "trajectory scheduler_deepchain: first record",
+              file=sys.stderr)
+        return
+    # same rules (and threshold) as the tier-1 gate, via the shared helper
+    regressed = [
+        r for r in perf_regressions(
+            {"configs": {"scheduler_deepchain": old}},
+            {"configs": {"scheduler_deepchain": cur}},
+        )
+    ]
+    if regressed:
+        print(
+            f"SCHEDULER REGRESSION (>{PERF_GATE_THRESHOLD_PCT:.0f}% vs "
+            + (label or "prior record") + "): " + "; ".join(regressed),
+            file=sys.stderr,
+        )
+    else:
+        print(
+            f"trajectory scheduler_deepchain: within "
+            f"{PERF_GATE_THRESHOLD_PCT:.0f}% of {label}",
+            file=sys.stderr,
+        )
+
+
 def _print_trajectory_deltas(metrics_record: dict, prev_trajectory) -> None:
     """One line per config vs the previous trajectory (stderr — stdout's
     last line belongs to the driver), so the bench history stops being
@@ -814,6 +1070,11 @@ def _print_trajectory_deltas(metrics_record: dict, prev_trajectory) -> None:
         if metric == "fleet_scaling":
             _print_scaling_deltas(cur, old if isinstance(old, dict) else {},
                                   label)
+            continue
+        if metric == "scheduler_deepchain":
+            _print_scheduler_deltas(
+                cur, old if isinstance(old, dict) else {}, label
+            )
             continue
         if not isinstance(old, dict):
             print(f"trajectory {metric}: new config (no prior record in "

@@ -33,7 +33,67 @@ _TIMELINE_GROUPS = {
     "memory guard": ("admission_step_down", "admission_restore",
                      "guard_soft_exceeded", "device_memory"),
     "stragglers": ("straggler",),
+    "scheduling": ("scheduler_mode", "dataflow_graph", "dispatch_early"),
 }
+
+
+def _merge_intervals(intervals: list) -> list:
+    """Coalesce [start, end) intervals into a sorted disjoint union."""
+    out: list = []
+    for s, e in sorted(i for i in intervals if i[1] > i[0]):
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return out
+
+
+def _intersection_s(a: list, b: list) -> float:
+    """Total length of the intersection of two disjoint interval unions."""
+    total, i, j = 0.0, 0, 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def op_overlap_rows(trace: Optional[dict]) -> list:
+    """Per-op overlap with its predecessors, from the bundle's task spans.
+
+    For each op (in first-task-start order): how long its tasks ran
+    CONCURRENTLY with tasks of any earlier-starting op. Under the
+    op-level scheduler this is ~0 by construction; under
+    ``scheduler="dataflow"`` it is the barrier time the scheduler won
+    back — the post-mortem proof the overlap actually happened."""
+    events = [
+        e for e in ((trace or {}).get("traceEvents") or [])
+        if e.get("ph") == "X" and e.get("cat") == "task"
+        and e.get("dur") is not None
+    ]
+    by_op: dict = {}
+    for e in events:
+        s = e["ts"] / 1e6
+        by_op.setdefault(e.get("name"), []).append((s, s + e["dur"] / 1e6))
+    order = sorted(by_op, key=lambda op: min(s for s, _ in by_op[op]))
+    rows = []
+    earlier: list = []
+    for op in order:
+        iv = _merge_intervals(by_op[op])
+        busy = sum(e - s for s, e in iv)
+        rows.append({
+            "op": op,
+            "tasks": len(by_op[op]),
+            "busy_s": busy,
+            "overlap_s": _intersection_s(iv, earlier),
+        })
+        earlier = _merge_intervals(earlier + iv)
+    return rows
 
 
 def render_report(bundle: dict, timeline_limit: int = 20) -> str:
@@ -85,6 +145,31 @@ def render_report(bundle: dict, timeline_limit: int = 20) -> str:
                 f"({(s.get('factor') or 0):.1f}x op median "
                 f"{_fmt_s(s.get('op_median_s'))}) on {s.get('worker')}"
             )
+
+    overlap = op_overlap_rows(bundle.get("trace"))
+    if len(overlap) >= 2:
+        mode_rows = [
+            d for d in (m.get("decisions") or [])
+            if d.get("kind") == "scheduler_mode"
+        ]
+        mode = mode_rows[-1].get("mode") if mode_rows else None
+        out.append(_section(
+            "per-op overlap" + (f" (scheduler={mode})" if mode else "")
+        ))
+        total = 0.0
+        for r in overlap:
+            pct = r["overlap_s"] / r["busy_s"] if r["busy_s"] else 0.0
+            total += r["overlap_s"]
+            out.append(
+                f"  {r['op']:<28} tasks={r['tasks']:<6} "
+                f"busy {_fmt_s(r['busy_s']):>10}  "
+                f"ran concurrently with predecessors "
+                f"{_fmt_s(r['overlap_s'])} ({pct:.0%})"
+            )
+        out.append(
+            f"  total cross-op overlap: {_fmt_s(total)}"
+            + ("  (op barrier held: no overlap)" if total < 1e-6 else "")
+        )
 
     decisions = m.get("decisions") or []
     for title, kinds in _TIMELINE_GROUPS.items():

@@ -1,0 +1,518 @@
+"""Chunk-granular dataflow scheduling: kill the op barrier.
+
+The op-level execution model (``visit_nodes``/``visit_node_generations``)
+runs the plan op by op: every task of op N must finish before any task of
+op N+1 starts, so one straggler stalls the entire fleet — the live
+straggler watch (PR 5) shows this happening in real time. But the
+readiness information needed to do better already exists: a blockwise op's
+``block_function`` maps each output chunk key to the exact input chunk
+keys it consumes, and tasks only communicate through (idempotent,
+whole-chunk) storage writes. This module turns that into a scheduler:
+
+- :func:`build_chunk_graph` expands the op-level DAG into a chunk-level
+  task graph — one node per task, with a per-task dependency set derived
+  from the op's ``block_function``. Ops without chunk-level structure
+  (rechunk copy regions, ``create-arrays``, any pipeline whose task body
+  is not ``apply_blockwise``) become conservative op-level barriers: all
+  their tasks wait for every predecessor task, and all their consumers
+  wait for all of their tasks.
+- :class:`DataflowScheduler` drives a whole compute through ONE
+  ``map_unordered`` call: tasks of every op are merged into a single
+  completion-ordered map whose ``dependencies`` gate each task until its
+  specific input chunks are written — so a downstream task dispatches the
+  moment its inputs land, across op boundaries, while the rest of the
+  upstream op is still running.
+
+Correctness rests on the same two properties every other reliability
+feature here leans on: tasks are idempotent whole-chunk writes, and the
+chunk a consumer needs is durably in storage once its producing task
+completes (the PR 3 integrity manifest records validity at write time, and
+chunk-granular resume uses the same records to mark already-satisfied
+tasks done before dispatch). Classified retries, speculative backups,
+RECOMPUTE repair and memory-guard admission all apply unchanged, because
+the dataflow path reuses the very same ``map_unordered`` machinery — the
+existing same-generation interleave paths (``merge_generation``) are the
+degenerate case of this graph where only intra-generation edges are empty.
+
+Mode resolution mirrors integrity/memory-guard: the
+``CUBED_TPU_SCHEDULER`` env var (operator override) wins over
+``Spec(scheduler=...)``, and the default is ``"oplevel"`` — the exact
+historical behavior. The sequential oracle and the jax executor always
+keep op ordering (the oracle is the bitwise reference; the jax executor
+fuses whole segments into single XLA programs where the barrier question
+does not arise).
+
+Observability: the resolved mode lands on the ``scheduler_mode`` gauge and
+the decision ring; ``tasks_dispatched_early`` counts tasks dispatched
+while their op's upstream producers still had unfinished tasks (the
+overlap the barrier kill buys); ``op_barrier_waits`` counts tasks whose
+dispatch was gated by a conservative op-level barrier (excluding the
+``create-arrays`` metadata bootstrap, which gates everything by design).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Iterator, List, Optional, Set
+
+import networkx as nx
+
+from ..observability.metrics import get_registry
+from .pipeline import (
+    ResumeState,
+    _task_chunk_key,
+    already_computed,
+    iter_op_nodes,
+    pending_mappable,
+)
+from .types import OperationEndEvent, OperationStartEvent, callbacks_on
+
+logger = logging.getLogger(__name__)
+
+MODES = ("oplevel", "dataflow")
+DEFAULT_MODE = "oplevel"
+SCHEDULER_ENV_VAR = "CUBED_TPU_SCHEDULER"
+
+#: the metadata bootstrap op injected by Plan.create_lazy_zarr_arrays; it
+#: gates every other op by design, so it is excluded from barrier metrics
+CREATE_ARRAYS_OP = "create-arrays"
+
+
+def _validate(mode: str) -> str:
+    if mode not in MODES:
+        raise ValueError(
+            f"invalid scheduler mode {mode!r}; expected one of {MODES}"
+        )
+    return mode
+
+
+def resolve_scheduler(spec: Any = None) -> str:
+    """The effective scheduler mode (env > Spec > default).
+
+    A malformed env value raises loudly — a typo silently falling back to
+    the op-level default would hide the very overlap the operator asked
+    for."""
+    raw = os.environ.get(SCHEDULER_ENV_VAR)
+    if raw:
+        return _validate(raw)
+    s = getattr(spec, "scheduler", None)
+    if s is not None:
+        return _validate(s)
+    return DEFAULT_MODE
+
+
+def record_scheduler_mode(mode: str, executor: Optional[str] = None) -> None:
+    """Land the resolved mode on the gauge and the decision ring, so every
+    trace/bundle says which scheduler drove the compute."""
+    from ..observability.collect import record_decision
+
+    get_registry().gauge("scheduler_mode").set(1 if mode == "dataflow" else 0)
+    record_decision("scheduler_mode", mode=mode, executor=executor)
+
+
+def _iter_keys(structure) -> Iterator[tuple]:
+    """All chunk keys in a (possibly nested / lazy) block-function value.
+
+    Mirrors the read path (``blockwise._read_keys``): plain keys, nested
+    lists (contracted dims), ``PredKeys`` (fused predecessors — a list
+    subclass), and iterators (streaming tree-reduce reads). The structure
+    walked here is a fresh one built for this call, so consuming iterators
+    is safe."""
+    from ..primitive.blockwise import _is_key
+
+    if structure is None:
+        return
+    if _is_key(structure):
+        yield structure
+        return
+    if isinstance(structure, (list, tuple)):
+        for entry in structure:
+            yield from _iter_keys(entry)
+        return
+    if isinstance(structure, Iterator):
+        for entry in structure:
+            yield from _iter_keys(entry)
+        return
+    # anything else (scalars baked into the structure) reads no chunks
+
+
+def _store_of(target) -> str:
+    return str(getattr(target, "store", "") or "")
+
+
+# an input chunk key (name, i, j, ...) has the same shape as a blockwise
+# mappable item, so the producing task's key string IS _task_chunk_key of
+# the read key — one format contract, not two copies that could drift
+# (a drift would silently degrade every edge to an op barrier)
+_key_str = _task_chunk_key
+
+
+class ChunkGraph:
+    """The chunk-level task graph of one finalized plan.
+
+    ``items[i]`` is ``(op_name, task_input)``; ``dependencies[i]`` the set
+    of item indices that must complete before item *i* may dispatch
+    (absent = dispatch immediately). ``op_order`` preserves topological op
+    order; ``op_num_tasks``/``op_pending`` are per-op totals (full op size
+    vs tasks actually in the graph after resume skips)."""
+
+    def __init__(self) -> None:
+        self.items: List[tuple] = []
+        self.array_names: List[str] = []
+        self.dependencies: Dict[int, Set[int]] = {}
+        self.op_order: List[str] = []
+        self.op_num_tasks: Dict[str, int] = {}
+        self.op_pending: Dict[str, int] = {}
+        #: op -> upstream op names with tasks in this graph (create-arrays
+        #: included: overlap with the bootstrap is not "early")
+        self.op_upstream: Dict[str, Set[str]] = {}
+        self.pipelines: Dict[str, Any] = {}
+        #: tasks gated by a conservative op-level barrier (non-bootstrap)
+        self.barrier_tasks: int = 0
+        #: ops that became barriers (for logs/decisions)
+        self.barrier_ops: List[str] = []
+
+
+def _op_predecessor_ops(dag, name: str, nodes: dict) -> Set[str]:
+    """Direct producing ops of *name*'s inputs: array predecessors resolve
+    to the op that writes them; op->op edges (create-arrays) pass through."""
+    out: Set[str] = set()
+    for pred in dag.predecessors(name):
+        d = nodes[pred]
+        if d.get("type") == "op":
+            out.add(pred)
+        else:
+            for producer in dag.predecessors(pred):
+                if nodes[producer].get("type") == "op":
+                    out.add(producer)
+    return out
+
+
+def build_chunk_graph(
+    dag,
+    resume: Optional[bool] = None,
+    state: Optional[ResumeState] = None,
+) -> ChunkGraph:
+    """Expand an op-level DAG into a :class:`ChunkGraph`.
+
+    Resume composes exactly as in the op-level path: ops whose outputs are
+    complete-and-valid are dropped (``already_computed``), and a partially
+    complete blockwise op contributes only its still-pending tasks
+    (``pending_mappable``) — a dependency on an already-valid chunk is
+    born satisfied, because the integrity manifest is the readiness
+    oracle for work that predates this compute.
+    """
+    from ..primitive.blockwise import apply_blockwise
+
+    g = ChunkGraph()
+    nodes = dict(dag.nodes(data=True))
+    if resume and state is None:
+        state = ResumeState(quarantine=True)
+
+    # store -> producing op, over ALL op nodes (a consumer's input may be
+    # produced by an op that resume dropped — that dep is then satisfied)
+    store_to_op: Dict[str, str] = {}
+    for name, d in iter_op_nodes(dag):
+        op = d["primitive_op"]
+        targets = op.target_arrays or (
+            [op.target_array] if op.target_array is not None else []
+        )
+        for t in targets:
+            store = _store_of(t)
+            if store:
+                store_to_op[store] = name
+
+    chunk_structured: Dict[str, bool] = {}
+    #: chunk-structured op -> {chunk key str -> item index} over its FULL
+    #: mappable (missing key = genuinely unknown, not resume-skipped)
+    key_index: Dict[str, Dict[str, Optional[int]]] = {}
+    op_item_indices: Dict[str, List[int]] = {}
+
+    order = [
+        name
+        for name in nx.topological_sort(dag)
+        if nodes[name].get("type") == "op"
+        and nodes[name].get("primitive_op") is not None
+        and not already_computed(name, dag, nodes, resume, state)
+    ]
+
+    for name in order:
+        node = nodes[name]
+        primitive_op = node["primitive_op"]
+        pipeline = primitive_op.pipeline
+        mappable, _skipped = pending_mappable(name, node, resume, state)
+        mappable = list(mappable)
+        structured = pipeline.function is apply_blockwise
+        chunk_structured[name] = structured
+        g.op_order.append(name)
+        g.op_num_tasks[name] = primitive_op.num_tasks
+        g.op_pending[name] = len(mappable)
+        g.pipelines[name] = pipeline
+        indices: List[int] = []
+        keys: Dict[str, Optional[int]] = {}
+        if structured:
+            for m in pipeline.mappable:
+                keys[_task_chunk_key(m)] = None  # satisfied unless pending
+        for m in mappable:
+            idx = len(g.items)
+            g.items.append((name, m))
+            g.array_names.append(name)
+            indices.append(idx)
+            if structured:
+                keys[_task_chunk_key(m)] = idx
+        op_item_indices[name] = indices
+        key_index[name] = keys
+
+    in_graph = set(g.op_order)
+
+    for name in g.op_order:
+        pipeline = g.pipelines[name]
+        pred_ops = _op_predecessor_ops(dag, name, nodes)
+        upstream = {p for p in pred_ops if p in in_graph and g.op_pending[p]}
+        g.op_upstream[name] = upstream
+
+        #: producers that must be barriers for THIS op's tasks: direct
+        #: op->op edges (create-arrays) plus any unstructured producer
+        barrier_producers = {
+            p for p in upstream
+            if not chunk_structured.get(p, False)
+        }
+
+        def add_deps(idx: int, deps: Set[int]) -> None:
+            if deps:
+                g.dependencies.setdefault(idx, set()).update(deps)
+
+        if not chunk_structured[name]:
+            # no chunk-level structure: every task waits for every pending
+            # predecessor task — the conservative op-level barrier
+            barrier = set()
+            for p in upstream:
+                barrier.update(op_item_indices[p])
+            n_gated = len(op_item_indices[name]) if barrier else 0
+            if n_gated and any(p != CREATE_ARRAYS_OP for p in upstream):
+                g.barrier_tasks += n_gated
+                g.barrier_ops.append(name)
+            for idx in op_item_indices[name]:
+                add_deps(idx, barrier)
+            continue
+
+        barrier_base: Set[int] = set()
+        for p in barrier_producers:
+            barrier_base.update(op_item_indices[p])
+        non_bootstrap_barrier = any(
+            p != CREATE_ARRAYS_OP for p in barrier_producers
+        )
+        if non_bootstrap_barrier:
+            g.barrier_ops.append(name)
+
+        covered_ops: Set[str] = set()
+        for idx in op_item_indices[name]:
+            _, m = g.items[idx]
+            deps = set(barrier_base)
+            if non_bootstrap_barrier:
+                g.barrier_tasks += 1
+            try:
+                structure = pipeline.config.block_function(m)
+                for key in _iter_keys(structure):
+                    proxy = pipeline.config.reads_map.get(key[0])
+                    if proxy is None:
+                        raise KeyError(key[0])
+                    producer = store_to_op.get(_store_of(proxy.array))
+                    if producer is None or producer not in in_graph:
+                        continue  # source array, or op satisfied by resume
+                    covered_ops.add(producer)
+                    if not chunk_structured[producer]:
+                        continue  # already in barrier_base
+                    entry = key_index[producer].get(_key_str(key))
+                    if entry is None:
+                        if _key_str(key) in key_index[producer]:
+                            continue  # resume-satisfied chunk
+                        # unknown chunk key: the key functions disagree —
+                        # fall back to a barrier on that producer rather
+                        # than risk reading a chunk that was never ordered
+                        logger.warning(
+                            "dataflow: task %s of %s reads unknown chunk "
+                            "%s of %s; degrading that edge to an op "
+                            "barrier", _task_chunk_key(m), name,
+                            _key_str(key), producer,
+                        )
+                        deps.update(op_item_indices[producer])
+                    else:
+                        deps.add(entry)
+            except Exception:
+                # a block function we cannot walk: conservative barrier on
+                # every upstream producer (exactly op-level semantics for
+                # this one task)
+                logger.warning(
+                    "dataflow: could not derive chunk deps for task %s of "
+                    "%s; using an op-level barrier", _task_chunk_key(m),
+                    name, exc_info=True,
+                )
+                for p in upstream:
+                    deps.update(op_item_indices[p])
+                if not non_bootstrap_barrier and any(
+                    p != CREATE_ARRAYS_OP for p in upstream
+                ):
+                    g.barrier_tasks += 1
+            add_deps(idx, deps)
+
+        # safety net: a pending producer the walk never saw means the
+        # block function under-reports its reads — barrier it. Active
+        # under resume too (covered_ops is populated even for
+        # resume-satisfied reads, so the only resume cost is a spurious —
+        # conservative, still correct — barrier when an op's ENTIRE read
+        # set from a partially-pending producer happens to be valid)
+        missed = {
+            p for p in upstream
+            if chunk_structured.get(p, False) and p not in covered_ops
+        }
+        for p in missed:
+            logger.warning(
+                "dataflow: op %s never referenced producer %s in its "
+                "block function; adding an op-level barrier on it",
+                name, p,
+            )
+            for idx in op_item_indices[name]:
+                g.dependencies.setdefault(idx, set()).update(
+                    op_item_indices[p]
+                )
+
+    return g
+
+
+class DataflowScheduler:
+    """Drives one compute's chunk graph through a single unordered map.
+
+    The executor builds one of these, fires :meth:`start`, runs
+    ``map_unordered`` over :attr:`items` with :attr:`dependencies` and the
+    :meth:`on_submit`/:meth:`on_done` hooks, then calls :meth:`finish`.
+    Hooks are idempotent per item index, so a multiprocess pool-crash
+    re-run (which re-maps every input) cannot double-fire operation events
+    or double-count overlap metrics.
+    """
+
+    def __init__(self, dag, resume=None, state=None, callbacks=None):
+        self.callbacks = callbacks
+        self.graph = build_chunk_graph(dag, resume=resume, state=state)
+        self._pending = dict(self.graph.op_pending)
+        self._submitted: Set[int] = set()
+        self._done: Set[int] = set()
+        self._started_ops: Set[str] = set()
+        self._ended_ops: Set[str] = set()
+        self._early_noted_ops: Set[str] = set()
+
+    # convenience pass-throughs the executors use
+    @property
+    def items(self) -> List[tuple]:
+        return self.graph.items
+
+    @property
+    def array_names(self) -> List[str]:
+        return self.graph.array_names
+
+    @property
+    def dependencies(self) -> Dict[int, Set[int]]:
+        return self.graph.dependencies
+
+    @property
+    def pipelines(self) -> Dict[str, Any]:
+        return self.graph.pipelines
+
+    @property
+    def completed(self) -> Set[int]:
+        """LIVE set of completed item indices. Passed to ``map_unordered``
+        as ``completed_inputs`` so a crash-recovery re-run (multiprocess
+        pool rebuild re-maps the same index space) resumes from where the
+        previous attempt died instead of re-running every task."""
+        return self._done
+
+    def start(self) -> None:
+        """Land the graph shape on the metrics registry and decision ring,
+        and close out ops with nothing to run (fully resume-satisfied).
+        Operation starts fire lazily at each op's FIRST dispatch — in
+        dataflow mode an op's lifetime is first-dispatch → last-complete,
+        which keeps per-op wall clocks and trace lanes meaningful under
+        overlap."""
+        from ..observability.collect import record_decision
+
+        metrics = get_registry()
+        if self.graph.barrier_tasks:
+            metrics.counter("op_barrier_waits").inc(self.graph.barrier_tasks)
+        record_decision(
+            "dataflow_graph",
+            ops=len(self.graph.op_order),
+            tasks=len(self.graph.items),
+            barrier_ops=[
+                o for o in self.graph.barrier_ops if o != CREATE_ARRAYS_OP
+            ][:16],
+            barrier_tasks=self.graph.barrier_tasks,
+        )
+        for name in self.graph.op_order:
+            if self._pending[name] == 0:
+                self._start_op(name)
+                self._end_op(name)
+
+    def on_submit(self, i: int) -> None:
+        """First-dispatch hook: fires the op's start event and counts
+        tasks that start while an upstream producer op still has
+        unfinished tasks — the overlap the op barrier used to forbid."""
+        op = self.graph.array_names[i]
+        self._start_op(op)
+        if i in self._submitted:
+            return
+        self._submitted.add(i)
+        if any(
+            self._pending.get(p, 0) > 0 for p in self.graph.op_upstream[op]
+        ):
+            get_registry().counter("tasks_dispatched_early").inc()
+            if op not in self._early_noted_ops:
+                # one ring entry per op (the counter has the totals): the
+                # moment this op first overlapped its upstream
+                self._early_noted_ops.add(op)
+                from ..observability.collect import record_decision
+
+                _, m = self.graph.items[i]
+                record_decision(
+                    "dispatch_early", op=op, chunk=_task_chunk_key(m),
+                    upstream_pending=sum(
+                        self._pending.get(p, 0)
+                        for p in self.graph.op_upstream[op]
+                    ),
+                )
+
+    def on_done(self, i: int) -> None:
+        if i in self._done:
+            return
+        self._done.add(i)
+        op = self.graph.array_names[i]
+        self._pending[op] -= 1
+        if self._pending[op] == 0:
+            self._end_op(op)
+
+    def _start_op(self, name: str) -> None:
+        if name in self._started_ops:
+            return
+        self._started_ops.add(name)
+        callbacks_on(
+            self.callbacks, "on_operation_start",
+            OperationStartEvent(name, self.graph.op_num_tasks[name]),
+        )
+
+    def _end_op(self, name: str) -> None:
+        if name in self._ended_ops:
+            return
+        self._ended_ops.add(name)
+        callbacks_on(
+            self.callbacks, "on_operation_end",
+            OperationEndEvent(name, self.graph.op_num_tasks[name]),
+        )
+
+    def finish(self) -> None:
+        """Close out operation events (a failed compute may leave ops
+        open or never-started; observers still want balanced lifecycle
+        events — same contract as ``on_compute_end`` firing for FAILED
+        computes)."""
+        for name in self.graph.op_order:
+            self._start_op(name)
+            self._end_op(name)

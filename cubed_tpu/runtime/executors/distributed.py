@@ -33,6 +33,11 @@ from typing import Optional
 
 from ...observability import accounting
 from ...observability import logs as obs_logs
+from ..dataflow import (
+    DataflowScheduler,
+    record_scheduler_mode,
+    resolve_scheduler,
+)
 from ..distributed import Coordinator, NoWorkersError
 from ..memory import AdmissionController
 from ..pipeline import (
@@ -429,7 +434,43 @@ class DistributedDagExecutor(DagExecutor):
         # corrupt chunk's (store, key); the repair task runs client-side
         # against the shared store the whole fleet reads
         resolver = RecomputeResolver(dag)
-        if compute_arrays_in_parallel:
+        scheduler = resolve_scheduler(spec)
+        record_scheduler_mode(scheduler, executor=self.name)
+        if scheduler == "dataflow":
+            # the coordinator already routes per-item (op, task) pairs
+            # (_InterleavedPool); dataflow just widens the item set to the
+            # whole DAG and gates each on its own input chunks
+            if batch_size:
+                logger.warning(
+                    "batch_size=%s is ignored under scheduler=\"dataflow\" "
+                    "(the whole DAG is one dependency-gated map)",
+                    batch_size,
+                )
+            sched = DataflowScheduler(
+                dag, resume=resume, state=state, callbacks=callbacks
+            )
+            sched.start()
+            try:
+                if sched.items:
+                    map_unordered(
+                        _InterleavedPool(coord, sched.pipelines),
+                        None,
+                        sched.items,
+                        retry_policy=policy,
+                        retry_budget=budget,
+                        use_backups=use_backups,
+                        callbacks=callbacks,
+                        array_names=sched.array_names,
+                        executor_name=self.name,
+                        recompute_resolver=resolver,
+                        admission=admission,
+                        dependencies=sched.dependencies,
+                        on_input_submit=sched.on_submit,
+                        on_input_done=sched.on_done,
+                    )
+            finally:
+                sched.finish()
+        elif compute_arrays_in_parallel:
             for generation in visit_node_generations(
                 dag, resume=resume, state=state
             ):

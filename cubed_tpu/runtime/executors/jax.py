@@ -25,6 +25,12 @@ Design (SURVEY.md section 7; north star in BASELINE.json):
 - **Spill path.** If HBM residency would exceed ``device_mem``, least-recently
   used arrays are flushed to their Zarr targets and dropped; reads fall back
   to storage. This keeps the bounded-memory story for arrays larger than HBM.
+- **Scheduling.** This executor always keeps op ordering and ignores
+  ``Spec(scheduler="dataflow")``: whole (fused) segments compile to single
+  XLA programs over HBM-resident arrays, so there is no per-chunk task
+  frontier for the chunk-granular scheduler to overlap — XLA's own
+  scheduler already overlaps at the instruction level inside each program
+  (``runtime/dataflow.py`` is the multi-host fleet's analogue).
 
 Reference parity: replaces cubed's serverless executors
 (cubed/runtime/executors/*) with a device-mesh substrate.

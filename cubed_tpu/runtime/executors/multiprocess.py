@@ -30,6 +30,11 @@ import os
 from typing import Optional
 
 from ...observability.metrics import get_registry
+from ..dataflow import (
+    DataflowScheduler,
+    record_scheduler_mode,
+    resolve_scheduler,
+)
 from ..memory import AdmissionController
 from ..pipeline import (
     RecomputeResolver,
@@ -238,8 +243,51 @@ class MultiprocessDagExecutor(DagExecutor):
         pool = concurrent.futures.ProcessPoolExecutor(
             max_workers=self.max_workers, mp_context=ctx
         )
+        scheduler = resolve_scheduler(spec)
+        record_scheduler_mode(scheduler, executor=self.name)
         try:
-            if compute_arrays_in_parallel:
+            if scheduler == "dataflow":
+                # one dependency-gated map over the whole DAG: workers
+                # receive the same per-op (function, config) blobs as the
+                # interleaved path; a pool-crash re-run resumes from the
+                # scheduler's done-set instead of re-running the world
+                if batch_size:
+                    logger.warning(
+                        "batch_size=%s is ignored under scheduler="
+                        "\"dataflow\" (the whole DAG is one dependency-"
+                        "gated map)", batch_size,
+                    )
+                sched = DataflowScheduler(
+                    dag, resume=resume, state=state, callbacks=callbacks
+                )
+                sched.start()
+                try:
+                    runners = {
+                        name: _ProcessTaskRunner(p.function, p.config)
+                        for name, p in sched.pipelines.items()
+                    }
+                    pool = self._map_surviving_pool_crash(
+                        pool,
+                        ctx,
+                        _GenerationTask(runners),
+                        sched.items,
+                        policy=policy,
+                        budget=budget,
+                        use_backups=use_backups,
+                        batch_size=None,
+                        callbacks=callbacks,
+                        array_names=sched.array_names,
+                        executor_name=self.name,
+                        recompute_resolver=resolver,
+                        admission=admission,
+                        dependencies=sched.dependencies,
+                        on_input_submit=sched.on_submit,
+                        on_input_done=sched.on_done,
+                        completed_inputs=sched.completed,
+                    )
+                finally:
+                    sched.finish()
+            elif compute_arrays_in_parallel:
                 for generation in visit_node_generations(
                     dag, resume=resume, state=state
                 ):

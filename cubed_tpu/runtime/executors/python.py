@@ -69,6 +69,15 @@ class PythonDagExecutor(DagExecutor):
         retries = self.retries if retries is None else retries
         policy = resolve_policy(retry_policy or self.retry_policy, retries)
         budget = compute_retry_budget(policy, dag)
+        from ..dataflow import resolve_scheduler
+
+        if resolve_scheduler(spec) == "dataflow":
+            # the oracle's value IS its strict op ordering (bitwise
+            # reference for the overlapped executors) — documented no-op
+            logger.debug(
+                "scheduler=dataflow requested; the sequential oracle "
+                "keeps op-level ordering by design"
+            )
         metrics = get_registry()
         state = ResumeState(quarantine=True) if resume else None
         resolver = RecomputeResolver(dag)

@@ -26,6 +26,11 @@ from typing import Callable, Dict, Iterable, Optional
 from ...observability.collect import record_decision, record_failed_task
 from ...observability.metrics import get_registry
 from ..backup import should_launch_backup
+from ..dataflow import (
+    DataflowScheduler,
+    record_scheduler_mode,
+    resolve_scheduler,
+)
 from ..memory import (
     AdmissionController,
     count_resource_failure,
@@ -101,6 +106,10 @@ def map_unordered(
     retry_budget: Optional[RetryBudget] = None,
     recompute_resolver=None,
     admission: Optional[AdmissionController] = None,
+    dependencies: Optional[Dict[int, set]] = None,
+    on_input_submit: Optional[Callable[[int], None]] = None,
+    on_input_done: Optional[Callable[[int], None]] = None,
+    completed_inputs: Optional[set] = None,
     **kwargs,
 ) -> None:
     """Run function over inputs, handling completion order, retries, backups.
@@ -134,10 +143,29 @@ def map_unordered(
     limit multiplicatively. A task that fails RESOURCE even when admitted
     at concurrency 1 aborts the compute with an actionable
     measured-vs-allowed error instead of burning the budget.
+
+    ``dependencies`` (the chunk-granular dataflow scheduler,
+    ``runtime/dataflow.py``) maps an input index to the set of input
+    indices that must COMPLETE before it may be submitted: blocked inputs
+    are held back and released the moment their last dependency lands, so
+    tasks of a downstream op dispatch while the upstream op is still
+    running. Requires the un-batched path (one index space).
+    ``on_input_submit``/``on_input_done`` are per-index hooks the dataflow
+    scheduler uses for operation lifecycle events and overlap metrics.
+    ``completed_inputs`` (indices, read once at entry) marks inputs done
+    before anything dispatches — a crash-recovery re-run over the same
+    index space (the multiprocess pool rebuild) resumes from where the
+    previous attempt died instead of re-running the whole map; their
+    dependents' edges count as satisfied.
     """
     policy = resolve_policy(retry_policy, retries)
     if admission is None:
         admission = AdmissionController()
+    if dependencies and batch_size is not None:
+        raise ValueError(
+            "dependencies (dataflow scheduling) and batch_size are mutually "
+            "exclusive: batching would split the dependency index space"
+        )
     if array_names is not None:
         inputs = list(inputs)
         assert len(array_names) == len(inputs)
@@ -146,6 +174,10 @@ def map_unordered(
             executor, function, list(inputs), policy, retry_budget,
             use_backups, callbacks, array_name, array_names, executor_name,
             recompute_resolver, admission,
+            dependencies=dependencies,
+            on_input_submit=on_input_submit,
+            on_input_done=on_input_done,
+            completed_inputs=completed_inputs,
             **kwargs,
         )
     elif array_names is None:
@@ -192,6 +224,10 @@ def _map_unordered_batch(
     executor_name: Optional[str] = None,
     recompute_resolver=None,
     admission: Optional[AdmissionController] = None,
+    dependencies: Optional[Dict[int, set]] = None,
+    on_input_submit: Optional[Callable[[int], None]] = None,
+    on_input_done: Optional[Callable[[int], None]] = None,
+    completed_inputs: Optional[set] = None,
     **kwargs,
 ) -> None:
     metrics = get_registry()
@@ -230,6 +266,31 @@ def _map_unordered_batch(
     repairing: Dict[int, concurrent.futures.Future] = {}
     repair_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
 
+    # crash-recovery resume: indices a previous attempt over this same
+    # input list already completed (snapshotted once at entry) start out
+    # done — never resubmitted, and never blocking their dependents
+    if completed_inputs:
+        done_inputs.update(
+            i for i in completed_inputs if 0 <= i < len(inputs)
+        )
+
+    #: dataflow gating: input -> still-unmet dependency indices, and the
+    #: reverse map releasing dependents the moment an input completes
+    blocked: Dict[int, set] = {}
+    dependents: Dict[int, list] = {}
+    if dependencies:
+        for i, deps in dependencies.items():
+            if i in done_inputs:
+                continue
+            rem = {
+                d for d in deps
+                if d != i and 0 <= d < len(inputs) and d not in done_inputs
+            }
+            if rem:
+                blocked[i] = set(rem)
+                for d in rem:
+                    dependents.setdefault(d, []).append(i)
+
     key_cache: Dict[int, str] = {}
 
     def op_of(i: int) -> str:
@@ -246,6 +307,8 @@ def _map_unordered_batch(
         return key
 
     def submit(i: int, is_backup: bool = False):
+        if on_input_submit is not None:
+            on_input_submit(i)
         create_times.setdefault(i, time.time())
         fire_task_start(
             callbacks, op_of(i), key_fn=lambda: key_of(i),
@@ -292,11 +355,25 @@ def _map_unordered_batch(
             if i not in done_inputs:
                 resubmit(i)
 
+    def release_dependents(i_done: int) -> None:
+        """Unblock tasks whose last dependency just completed: they admit
+        immediately — the whole point of the dataflow scheduler."""
+        for j in dependents.get(i_done, ()):
+            rem = blocked.get(j)
+            if rem is None:
+                continue
+            rem.discard(i_done)
+            if not rem:
+                del blocked[j]
+                if j not in done_inputs:
+                    admit(j)
+
     for i in range(len(inputs)):
-        admit(i)
+        if i not in blocked and i not in done_inputs:
+            admit(i)
 
     try:
-        while pending or delayed or repairing or admit_queue:
+        while pending or delayed or repairing or admit_queue or blocked:
             now = time.time()
             # launch retries whose backoff has elapsed
             while delayed and delayed[0][0] <= now:
@@ -334,6 +411,19 @@ def _map_unordered_batch(
                 elif repairing:
                     concurrent.futures.wait(
                         list(repairing.values()), timeout=0.25
+                    )
+                elif admit_queue:
+                    # throttled to zero in flight: keep draining
+                    continue
+                elif blocked:
+                    # nothing runs, nothing is scheduled to run, yet tasks
+                    # still wait on dependencies: a cyclic or miswired
+                    # chunk graph — fail loudly instead of spinning
+                    raise RuntimeError(
+                        f"dataflow deadlock: {len(blocked)} task(s) blocked "
+                        "on dependencies that can no longer complete "
+                        "(first blocked inputs: "
+                        f"{sorted(blocked)[:5]})"
                     )
                 continue
             timeout = 2.0
@@ -507,6 +597,14 @@ def _map_unordered_batch(
                         executor=executor_name,
                     ),
                 )
+                # dataflow hooks and dependent release fire AFTER the task
+                # end event: observers see a completion before any of its
+                # consequences (an op's end event still follows its last
+                # task's end event), and a callback mutating storage for
+                # chaos tests cannot race the released consumer's read
+                if on_input_done is not None:
+                    on_input_done(i)
+                release_dependents(i)
             if use_backups and not admission.throttling:
                 # no speculative duplicates while degraded for memory: a
                 # backup twin is pure extra footprint
@@ -584,11 +682,39 @@ class AsyncPythonDagExecutor(DagExecutor):
         # scan are quarantined so their tasks re-run
         state = ResumeState(quarantine=True) if resume else None
         resolver = RecomputeResolver(dag)
+        scheduler = resolve_scheduler(spec)
+        record_scheduler_mode(scheduler, executor=self.name)
 
         with concurrent.futures.ThreadPoolExecutor(
             max_workers=self.max_workers
         ) as pool:
-            if compute_arrays_in_parallel:
+            if scheduler == "dataflow":
+                # chunk-granular dataflow: the whole DAG becomes ONE map
+                # whose dependencies gate each task on its own input
+                # chunks — subsumes generation interleaving (batch_size
+                # does not apply: one dependency index space)
+                if batch_size:
+                    logger.warning(
+                        "batch_size=%s is ignored under scheduler="
+                        "\"dataflow\" (the whole DAG is one dependency-"
+                        "gated map); use admission control / max_workers "
+                        "to bound in-flight tasks", batch_size,
+                    )
+                sched = DataflowScheduler(
+                    dag, resume=resume, state=state, callbacks=callbacks
+                )
+                sched.start()
+                try:
+                    self._run_tasks(
+                        pool, sched.items, sched.pipelines, policy, budget,
+                        use_backups, None, callbacks, resolver, admission,
+                        dependencies=sched.dependencies,
+                        on_input_submit=sched.on_submit,
+                        on_input_done=sched.on_done,
+                    )
+                finally:
+                    sched.finish()
+            elif compute_arrays_in_parallel:
                 # ops in the same topological generation interleave their tasks
                 for generation in visit_node_generations(
                     dag, resume=resume, state=state
@@ -633,6 +759,7 @@ class AsyncPythonDagExecutor(DagExecutor):
     def _run_tasks(
         self, pool, merged, pipelines, policy, budget, use_backups,
         batch_size, callbacks, recompute_resolver=None, admission=None,
+        **dataflow_kwargs,
     ):
         def fn(item):
             name, m = item
@@ -652,4 +779,5 @@ class AsyncPythonDagExecutor(DagExecutor):
             executor_name=self.name,
             recompute_resolver=recompute_resolver,
             admission=admission,
+            **dataflow_kwargs,
         )

@@ -33,6 +33,7 @@ class Spec:
         fault_injection: Optional[Any] = None,
         integrity: Optional[str] = None,
         memory_guard: Optional[str] = None,
+        scheduler: Optional[str] = None,
     ):
         self._work_dir = work_dir
         self._reserved_mem = convert_to_bytes(reserved_mem or 0)
@@ -65,6 +66,15 @@ class Spec:
                     f"one of {GUARD_MODES}"
                 )
         self._memory_guard = memory_guard
+        if scheduler is not None:
+            from .runtime.dataflow import MODES as SCHEDULER_MODES
+
+            if scheduler not in SCHEDULER_MODES:
+                raise ValueError(
+                    f"invalid scheduler mode {scheduler!r}; expected one "
+                    f"of {SCHEDULER_MODES}"
+                )
+        self._scheduler = scheduler
 
     @property
     def work_dir(self) -> Optional[str]:
@@ -136,6 +146,20 @@ class Spec:
         ``Plan.execute`` arms the mode together with this spec's
         ``allowed_mem`` for the compute's duration (runtime/memory.py)."""
         return self._memory_guard
+
+    @property
+    def scheduler(self) -> Optional[str]:
+        """Task-scheduling mode on the async executors (threads /
+        processes / distributed): ``"oplevel"`` (the effective default —
+        every task of op N finishes before any task of op N+1 starts) or
+        ``"dataflow"`` (chunk-granular: a downstream task dispatches the
+        moment its specific input chunks are written, across op
+        boundaries; ops without chunk-level structure — rechunk,
+        create-arrays — remain conservative barriers). ``None`` defers to
+        the ``CUBED_TPU_SCHEDULER`` env var (operator override, wins) or
+        the op-level default. The sequential oracle and the jax executor
+        always keep op ordering (runtime/dataflow.py)."""
+        return self._scheduler
 
     def __repr__(self) -> str:
         return (

@@ -1,0 +1,680 @@
+"""Chunk-granular dataflow scheduler (``runtime/dataflow.py``).
+
+Covers: mode resolution (env > Spec > default), chunk-graph construction
+(1:1 elementwise edges, contraction fan-in, rechunk/create-arrays
+barriers), dependency gating inside ``map_unordered`` (ordering + cycle
+deadlock detection), the overlap proof (a downstream task STARTS before
+its upstream op finishes), chaos-matrix bitwise correctness on every
+async executor, corruption-RECOMPUTE repair mid-overlap, chunk-granular
+resume consistency across the cross-op frontier, and the diagnose
+overlap report.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.core.plan import arrays_to_plan
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime import faults
+from cubed_tpu.runtime.dataflow import (
+    DEFAULT_MODE,
+    SCHEDULER_ENV_VAR,
+    DataflowScheduler,
+    build_chunk_graph,
+    resolve_scheduler,
+)
+from cubed_tpu.runtime.executors.python_async import (
+    AsyncPythonDagExecutor,
+    map_unordered,
+)
+from cubed_tpu.runtime.pipeline import _task_chunk_key
+from cubed_tpu.runtime.resilience import RetryPolicy
+from cubed_tpu.runtime.types import Callback
+
+from ..utils import TaskCounter
+
+
+def _dataflow_spec(tmp_path, **kwargs):
+    return ct.Spec(
+        work_dir=str(tmp_path), allowed_mem="500MB", scheduler="dataflow",
+        **kwargs,
+    )
+
+
+def _finalized_dag(arr):
+    return arrays_to_plan(arr)._finalize(optimize_graph=False).dag
+
+
+class _StatsCapture:
+    stats: dict = {}
+
+    def on_compute_end(self, event):
+        self.stats = event.executor_stats or {}
+
+
+# -- mode resolution -----------------------------------------------------
+
+
+def test_resolve_scheduler_default_and_spec(tmp_path):
+    assert resolve_scheduler(None) == DEFAULT_MODE == "oplevel"
+    assert resolve_scheduler(_dataflow_spec(tmp_path)) == "dataflow"
+
+
+def test_resolve_scheduler_env_wins(tmp_path, monkeypatch):
+    monkeypatch.setenv(SCHEDULER_ENV_VAR, "oplevel")
+    assert resolve_scheduler(_dataflow_spec(tmp_path)) == "oplevel"
+    monkeypatch.setenv(SCHEDULER_ENV_VAR, "dataflow")
+    assert resolve_scheduler(None) == "dataflow"
+
+
+def test_resolve_scheduler_invalid_raises(monkeypatch):
+    with pytest.raises(ValueError, match="invalid scheduler"):
+        ct.Spec(scheduler="chunkwise")
+    monkeypatch.setenv(SCHEDULER_ENV_VAR, "bogus")
+    with pytest.raises(ValueError, match="invalid scheduler"):
+        resolve_scheduler(None)
+
+
+# -- chunk-graph construction --------------------------------------------
+
+
+def test_chunk_graph_elementwise_one_to_one(tmp_path):
+    """Each task of an elementwise consumer depends on exactly ONE task of
+    its producer — the matching chunk — plus the create-arrays bootstrap."""
+    spec = _dataflow_spec(tmp_path)
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    c = xp.multiply(xp.add(a, 1.0), 2.0)
+    g = build_chunk_graph(_finalized_dag(c))
+
+    assert g.op_order[0] == "create-arrays"
+    op1, op2 = g.op_order[1], g.op_order[2]
+    by_op = {}
+    for idx, (name, m) in enumerate(g.items):
+        by_op.setdefault(name, []).append(idx)
+    create_idxs = set(by_op["create-arrays"])
+    op1_key_to_idx = {
+        _task_chunk_key(g.items[i][1]): i for i in by_op[op1]
+    }
+    assert len(by_op[op1]) == len(by_op[op2]) == 16
+    for idx in by_op[op2]:
+        deps = g.dependencies[idx]
+        chunk_deps = deps - create_idxs
+        key = _task_chunk_key(g.items[idx][1])
+        assert chunk_deps == {op1_key_to_idx[key]}, (key, chunk_deps)
+    # a pure elementwise chain has no conservative barriers beyond the
+    # metadata bootstrap
+    assert g.barrier_tasks == 0
+
+
+def test_chunk_graph_reduction_fan_in(tmp_path):
+    """A tree-reduce consumer fans in: its tasks depend on SEVERAL
+    producer chunks each (streamed via iterator key structures), and no
+    producer task is left unconsumed — every edge of the frontier exists."""
+    spec = _dataflow_spec(tmp_path)
+    an = np.arange(256, dtype=np.float64).reshape(16, 16)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1.0)
+    s = xp.sum(b)
+    g = build_chunk_graph(_finalized_dag(s))
+
+    by_op = {}
+    for idx, (name, _m) in enumerate(g.items):
+        by_op.setdefault(name, []).append(idx)
+    create_idxs = set(by_op["create-arrays"])
+    # somewhere in the reduce chain a stage must fan in: one task
+    # consuming MANY producer chunks (the 64->4 partial_reduce round),
+    # with the union of the stage's deps covering the producer entirely
+    # (no dropped edges)
+    fan_in_pairs = []
+    for producer in g.op_order[1:]:
+        p_idxs = set(by_op[producer])
+        for consumer in g.op_order[2:]:
+            if consumer == producer:
+                continue
+            per_task = [
+                (g.dependencies.get(i, set()) - create_idxs) & p_idxs
+                for i in by_op[consumer]
+            ]
+            consumed = set().union(*per_task) if per_task else set()
+            if consumed and max(len(d) for d in per_task) >= 2:
+                fan_in_pairs.append((producer, consumer, consumed == p_idxs))
+    assert fan_in_pairs, g.op_order
+    # at least one fan-in stage consumes its producer COMPLETELY
+    assert any(complete for _, _, complete in fan_in_pairs), fan_in_pairs
+
+
+def test_chunk_graph_rechunk_is_barrier(tmp_path):
+    """Rechunk tasks (no chunk-level structure) wait for every producer
+    task, and their consumers wait for every rechunk task; the bootstrap
+    create-arrays op is excluded from the barrier metric."""
+    spec = _dataflow_spec(tmp_path)
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = xp.add(a, 1.0)
+    r = ct.rechunk(b, (4, 4))
+    c = xp.add(r, 5.0)
+    g = build_chunk_graph(_finalized_dag(c))
+
+    by_op = {}
+    for idx, (name, _m) in enumerate(g.items):
+        by_op.setdefault(name, []).append(idx)
+    structured = {
+        name for name in g.op_order
+        if name in by_op and "rechunk" not in name
+    }
+    rechunk_ops = [n for n in g.op_order if n not in structured]
+    assert rechunk_ops, g.op_order
+    add_op = g.op_order[1]
+    create_idxs = set(by_op["create-arrays"])
+    first_rechunk = rechunk_ops[0]
+    for idx in by_op[first_rechunk]:
+        assert set(by_op[add_op]) <= g.dependencies[idx]
+    # consumer of the rechunked array: barrier on the final rechunk stage
+    final_op = g.op_order[-1]
+    last_rechunk = rechunk_ops[-1]
+    for idx in by_op[final_op]:
+        assert set(by_op[last_rechunk]) <= g.dependencies[idx]
+    assert g.barrier_tasks > 0
+    # deps on create-arrays exist everywhere but never count as barriers
+    for idx in by_op[add_op]:
+        assert g.dependencies[idx] == create_idxs
+
+
+def test_chunk_graph_resume_satisfies_deps(tmp_path):
+    """A dependency on an already-valid chunk is born satisfied: after a
+    full compute, deleting ONE final-output chunk leaves a one-task graph
+    whose deps on the (complete) producer are empty."""
+    spec = _dataflow_spec(tmp_path)
+    an = np.arange(100, dtype=np.float64).reshape(10, 10)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    c = xp.multiply(xp.add(a, 1.0), 2.0)
+    np.testing.assert_array_equal(
+        c.compute(optimize_graph=False), (an + 1.0) * 2.0
+    )
+    stores = sorted(
+        os.path.dirname(p)
+        for p in glob.glob(f"{spec.work_dir}/*/*.zarr/.zarray")
+    )
+    assert len(stores) == 2  # intermediate + final
+    # the final op's store is the one whose op comes last; deleting from
+    # either proves the point — pick the one that still leaves its
+    # consumer runnable (the final output)
+    final_store = stores[-1]
+    os.unlink(os.path.join(final_store, "3.3"))
+    g = build_chunk_graph(_finalized_dag(c), resume=True)
+    # create-arrays always re-runs (cheap metadata recreate, matching the
+    # op-level resume path); beyond it, exactly ONE chunk task remains,
+    # and its only deps are the bootstrap — the producer chunk it reads
+    # is already valid, so that dependency was born satisfied
+    chunk_items = [
+        (i, name) for i, (name, _m) in enumerate(g.items)
+        if name != "create-arrays"
+    ]
+    assert len(chunk_items) == 1, chunk_items
+    idx, _name = chunk_items[0]
+    create_idxs = {
+        i for i, (name, _m) in enumerate(g.items) if name == "create-arrays"
+    }
+    assert g.dependencies.get(idx, set()) <= create_idxs
+
+
+# -- map_unordered dependency gating -------------------------------------
+
+
+def test_map_unordered_dependencies_enforce_order():
+    order: list = []
+    lock = threading.Lock()
+
+    def fn(i):
+        with lock:
+            order.append(i)
+        time.sleep(0.01)
+        return i
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+        map_unordered(
+            pool, fn, list(range(6)),
+            dependencies={0: {4}, 1: {4}, 2: {4}, 4: {5}},
+        )
+    pos = {i: order.index(i) for i in range(6)}
+    assert pos[5] < pos[4]
+    assert all(pos[4] < pos[i] for i in (0, 1, 2))
+
+
+def test_map_unordered_dependency_cycle_raises():
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as pool:
+        with pytest.raises(RuntimeError, match="dataflow deadlock"):
+            map_unordered(
+                pool, lambda i: i, [0, 1],
+                dependencies={0: {1}, 1: {0}},
+            )
+
+
+def test_map_unordered_completed_inputs_resume():
+    """A re-run over the same index space skips completed inputs and
+    treats their dependency edges as satisfied — what the multiprocess
+    pool-crash rebuild passes via the scheduler's live done-set."""
+    ran: list = []
+    lock = threading.Lock()
+
+    def fn(i):
+        with lock:
+            ran.append(i)
+        return i
+
+    done_hook: list = []
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as pool:
+        map_unordered(
+            pool, fn, list(range(4)),
+            dependencies={3: {0, 1}},
+            completed_inputs={0, 1},
+            on_input_done=done_hook.append,
+        )
+    assert sorted(ran) == [2, 3]  # 0/1 never re-ran
+    assert sorted(done_hook) == [2, 3]  # hooks fire only for fresh work
+
+
+def test_map_unordered_dependencies_reject_batching():
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as pool:
+        with pytest.raises(ValueError, match="mutually"):
+            map_unordered(
+                pool, lambda i: i, [0, 1], batch_size=1,
+                dependencies={1: {0}},
+            )
+
+
+# -- the overlap proof ---------------------------------------------------
+
+
+class _SlowBlock:
+    """Deterministic straggler: block (0, 0) sleeps; everything else is
+    instant. Picklable (multiprocess-safe)."""
+
+    def __init__(self, delay_s: float):
+        self.delay_s = delay_s
+
+    def __call__(self, x, block_id=None):
+        if block_id == (0, 0):
+            time.sleep(self.delay_s)
+        return x + 1.0
+
+
+class _LifecycleWatch(Callback):
+    """Wall-clock timestamps of task starts and op ends, per op."""
+
+    def __init__(self):
+        self.task_starts: dict = {}
+        self.op_ends: dict = {}
+
+    def on_task_start(self, event):
+        self.task_starts.setdefault(event.array_name, []).append(time.time())
+
+    def on_operation_end(self, event):
+        self.op_ends[event.name] = time.time()
+
+
+def test_dataflow_overlap_downstream_starts_before_upstream_ends(tmp_path):
+    """The acceptance proof: with one straggler chunk in the upstream op,
+    ≥1 downstream task STARTS while the upstream op is still running —
+    impossible under the op barrier — and the result is bitwise-identical
+    to the sequential oracle's."""
+    spec = _dataflow_spec(tmp_path)
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = ct.map_blocks(_SlowBlock(0.6), a, dtype=np.float64)
+    c = xp.add(b, 1.0)
+
+    watch = _LifecycleWatch()
+    before = get_registry().snapshot()
+    result = c.compute(
+        executor=AsyncPythonDagExecutor(),
+        callbacks=[watch],
+        optimize_graph=False,
+    )
+    np.testing.assert_array_equal(result, an + 2.0)
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("tasks_dispatched_early", 0) >= 1, delta
+
+    ops = [op for op in watch.op_ends if op != "create-arrays"]
+    assert len(ops) == 2
+    upstream = min(ops, key=lambda op: min(watch.task_starts[op]))
+    downstream = [op for op in ops if op != upstream][0]
+    first_down = min(watch.task_starts[downstream])
+    up_end = watch.op_ends[upstream]
+    # the downstream op must have started well inside the straggler's
+    # sleep window, not after the upstream op closed
+    assert first_down < up_end - 0.2, (first_down, up_end)
+
+
+def test_dataflow_env_var_drives_overlap(tmp_path, monkeypatch):
+    """CUBED_TPU_SCHEDULER=dataflow arms the scheduler with no Spec knob."""
+    monkeypatch.setenv(SCHEDULER_ENV_VAR, "dataflow")
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    c = xp.multiply(xp.add(a, 1.0), 2.0)
+    before = get_registry().snapshot()
+    result = c.compute(
+        executor=AsyncPythonDagExecutor(), optimize_graph=False
+    )
+    np.testing.assert_array_equal(result, (an + 1.0) * 2.0)
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("tasks_dispatched_early", 0) >= 1, delta
+
+
+# -- chaos matrix: bitwise-correct results under faults ------------------
+
+CHAOS = dict(
+    seed=42,
+    storage_read_failure_rate=0.08,
+    storage_write_failure_rate=0.12,
+    task_failure_rate=0.08,
+)
+
+
+@pytest.mark.chaos
+def test_dataflow_chaos_threaded_bitwise_correct(tmp_path):
+    spec = _dataflow_spec(tmp_path, fault_injection=CHAOS)
+    an = np.arange(400, dtype=np.float64).reshape(20, 20)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 100 chunks/op
+    c = xp.multiply(xp.add(a, 1.0), 2.0)
+    cap = _StatsCapture()
+    result = c.compute(
+        executor=AsyncPythonDagExecutor(
+            retry_policy=RetryPolicy(retries=6, backoff_base=0.01, seed=0)
+        ),
+        callbacks=[cap],
+        optimize_graph=False,
+    )
+    np.testing.assert_array_equal(result, (an + 1.0) * 2.0)
+    assert cap.stats.get("faults_injected", 0) > 0, cap.stats
+    assert cap.stats.get("task_retries", 0) > 0, cap.stats
+
+
+class _CorruptFirstChunkTask(Callback):
+    """Flips a byte in the chunk written by the FIRST completed chunk task
+    (necessarily an upstream task — consumers cannot finish before their
+    producer). Task-end callbacks fire BEFORE the completion loop releases
+    dependents, so the consumer of this exact chunk has provably not read
+    it yet: the corruption is always detected mid-compute."""
+
+    def __init__(self, work_dir: str):
+        self.work_dir = work_dir
+        self.corrupted = None
+
+    def on_task_end(self, event):
+        import ast
+
+        if self.corrupted is not None or event.array_name == "create-arrays":
+            return
+        try:
+            key = ast.literal_eval(event.chunk_key)
+        except (ValueError, SyntaxError):
+            return
+        name = ".".join(str(i) for i in key[1:])
+        paths = glob.glob(f"{self.work_dir}/*/{key[0]}.zarr/{name}")
+        if not paths:
+            return
+        with open(paths[0], "r+b") as f:
+            data = bytearray(f.read())
+            data[3] ^= 0xFF
+            f.seek(0)
+            f.write(data)
+        self.corrupted = paths[0]
+
+
+@pytest.mark.chaos
+def test_dataflow_chaos_corruption_recompute_mid_overlap(tmp_path):
+    """Corruption of an intermediate chunk detected WHILE the upstream op
+    is still running (a straggler holds it open): the reader's
+    ChunkIntegrityError triggers RECOMPUTE of exactly the producing task,
+    the rest of the frontier keeps flowing, and the result is
+    bitwise-correct."""
+    spec = _dataflow_spec(tmp_path, integrity="verify")
+    an = np.arange(256, dtype=np.float64).reshape(16, 16)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 64 chunks/op
+    b = ct.map_blocks(_SlowBlock(0.5), a, dtype=np.float64)
+    c = xp.multiply(b, 2.0)
+    corruptor = _CorruptFirstChunkTask(str(tmp_path))
+    cap = _StatsCapture()
+    before = get_registry().snapshot()
+    result = c.compute(
+        executor=AsyncPythonDagExecutor(
+            retry_policy=RetryPolicy(retries=4, backoff_base=0.01, seed=0)
+        ),
+        callbacks=[cap, corruptor],
+        optimize_graph=False,
+    )
+    np.testing.assert_array_equal(result, (an + 1.0) * 2.0)
+    assert corruptor.corrupted is not None
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("chunks_corrupt_detected", 0) >= 1, delta
+    assert delta.get("chunks_recomputed", 0) >= 1, delta
+    # ...and the repair happened in an overlapped frontier, not behind a
+    # barrier: downstream tasks had already dispatched early
+    assert delta.get("tasks_dispatched_early", 0) >= 1, delta
+
+
+@pytest.mark.chaos
+def test_dataflow_chaos_multiprocess_bitwise_correct(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        faults.FAULTS_ENV_VAR,
+        faults.FaultConfig(
+            seed=42, storage_write_failure_rate=0.15
+        ).to_env_json(),
+    )
+    from cubed_tpu.runtime.executors.multiprocess import (
+        MultiprocessDagExecutor,
+    )
+
+    spec = _dataflow_spec(tmp_path)
+    an = np.arange(100, dtype=np.float64).reshape(10, 10)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 25 chunks/op
+    c = xp.multiply(xp.add(a, 1.0), 3.0)
+    cap = _StatsCapture()
+    result = c.compute(
+        executor=MultiprocessDagExecutor(
+            max_workers=2,
+            retry_policy=RetryPolicy(retries=6, backoff_base=0.01, seed=0),
+        ),
+        callbacks=[cap],
+        optimize_graph=False,
+    )
+    np.testing.assert_array_equal(result, (an + 1.0) * 3.0)
+    assert cap.stats.get("task_retries", 0) > 0, cap.stats
+
+
+@pytest.mark.chaos
+def test_dataflow_chaos_distributed_worker_crash_mid_overlap(
+    tmp_path, monkeypatch
+):
+    """A worker hard-exits mid-compute while the cross-op frontier is in
+    flight: its tasks requeue for free onto the survivor and the result
+    stays bitwise-correct."""
+    from cubed_tpu.runtime.executors.distributed import (
+        DistributedDagExecutor,
+    )
+
+    monkeypatch.setenv(
+        faults.FAULTS_ENV_VAR,
+        faults.FaultConfig(
+            seed=7,
+            worker_crash_names=("local-0",),
+            worker_crash_after_tasks=3,
+        ).to_env_json(),
+    )
+    spec = _dataflow_spec(tmp_path)
+    an = np.arange(256, dtype=np.float64).reshape(16, 16)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 64 chunks/op
+    c = xp.multiply(xp.add(a, 1.0), 2.0)
+    before = get_registry().snapshot()
+    ex = DistributedDagExecutor(
+        n_local_workers=2,
+        retry_policy=RetryPolicy(retries=6, backoff_base=0.01, seed=0),
+    )
+    try:
+        ex._ensure_fleet()
+        result = c.compute(executor=ex, optimize_graph=False)
+        np.testing.assert_array_equal(result, (an + 1.0) * 2.0)
+        assert ex._coordinator.stats["workers_lost"] >= 1
+        delta = get_registry().snapshot_delta(before)
+        assert delta.get("worker_loss_requeues", 0) >= 1, delta
+    finally:
+        ex.close()
+
+
+# -- resume across the chunk-level frontier ------------------------------
+
+
+def test_dataflow_resume_chunk_granular_frontier(tmp_path):
+    """Chunk-granular resume composes with the dataflow frontier: delete
+    one intermediate chunk and one (different) final chunk — the resumed
+    compute runs only the producing tasks of the missing chunks, skips
+    everything else, and matches the plan's own resume introspection."""
+    spec = _dataflow_spec(tmp_path)
+    an = np.arange(100, dtype=np.float64).reshape(10, 10)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    c = xp.multiply(xp.add(a, 1.0), 2.0)
+    np.testing.assert_array_equal(
+        c.compute(optimize_graph=False), (an + 1.0) * 2.0
+    )
+    inter_store, final_store = sorted(
+        os.path.dirname(p)
+        for p in glob.glob(f"{spec.work_dir}/*/*.zarr/.zarray")
+    )
+    # stores sort by gensym name, which is creation-ordered: first is the
+    # intermediate (add), second the final (multiply)
+    os.unlink(os.path.join(inter_store, "1.1"))
+    os.unlink(os.path.join(final_store, "2.2"))
+
+    plan_tasks = arrays_to_plan(c).num_tasks(
+        optimize_graph=False, resume=True
+    )
+    before = get_registry().snapshot()
+    counter = TaskCounter()
+    result = c.compute(
+        executor=AsyncPythonDagExecutor(),
+        optimize_graph=False,
+        resume=True,
+        callbacks=[counter],
+    )
+    np.testing.assert_array_equal(result, (an + 1.0) * 2.0)
+    delta = get_registry().snapshot_delta(before)
+    # 25 tasks/op: intermediate re-runs 1 (chunk 1.1), final re-runs 1
+    # (chunk 2.2, whose input chunk is still valid) — 48 skips
+    assert delta.get("tasks_skipped_resume") == 48, delta
+    # create-arrays (2 targets) + the two missing-chunk tasks — and the
+    # executor ran exactly what the plan introspection promised
+    assert counter.value == 4 == plan_tasks
+
+
+def test_dataflow_resume_dependency_on_missing_upstream_chunk(tmp_path):
+    """When the SAME chunk is missing in both stores, the final task must
+    wait for the re-run of its producer (a live cross-op dependency in
+    the resumed frontier) — order is enforced, result exact."""
+    spec = _dataflow_spec(tmp_path)
+    an = np.arange(100, dtype=np.float64).reshape(10, 10)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    c = xp.multiply(xp.add(a, 1.0), 2.0)
+    np.testing.assert_array_equal(
+        c.compute(optimize_graph=False), (an + 1.0) * 2.0
+    )
+    inter_store, final_store = sorted(
+        os.path.dirname(p)
+        for p in glob.glob(f"{spec.work_dir}/*/*.zarr/.zarray")
+    )
+    os.unlink(os.path.join(inter_store, "1.1"))
+    os.unlink(os.path.join(final_store, "1.1"))
+
+    g = build_chunk_graph(_finalized_dag(c), resume=True)
+    chunk_items = [
+        i for i, (name, _m) in enumerate(g.items)
+        if name != "create-arrays"
+    ]
+    assert len(chunk_items) == 2, g.items
+    up_idx, down_idx = chunk_items
+    create_idxs = {
+        i for i, (name, _m) in enumerate(g.items) if name == "create-arrays"
+    }
+    assert g.array_names[up_idx] != g.array_names[down_idx]
+    # the live cross-op edge: the final task waits on the re-run producer
+    assert g.dependencies.get(down_idx, set()) - create_idxs == {up_idx}
+
+    counter = TaskCounter()
+    result = c.compute(
+        executor=AsyncPythonDagExecutor(),
+        optimize_graph=False,
+        resume=True,
+        callbacks=[counter],
+    )
+    np.testing.assert_array_equal(result, (an + 1.0) * 2.0)
+    assert counter.value == 4  # create-arrays x2 + the two chunk tasks
+
+
+# -- diagnose: the overlap post-mortem -----------------------------------
+
+
+def test_diagnose_op_overlap_rows():
+    from cubed_tpu.diagnose import op_overlap_rows
+
+    trace = {
+        "traceEvents": [
+            # op A: two tasks, 0-1s and 0-1s
+            {"ph": "X", "cat": "task", "name": "op-a", "ts": 0.0,
+             "dur": 1_000_000},
+            {"ph": "X", "cat": "task", "name": "op-a", "ts": 0.0,
+             "dur": 1_000_000},
+            # op B: one task starting halfway through A
+            {"ph": "X", "cat": "task", "name": "op-b", "ts": 500_000,
+             "dur": 1_000_000},
+            # non-task events are ignored
+            {"ph": "i", "cat": "instant", "name": "noise", "ts": 0},
+            {"ph": "X", "cat": "span", "name": "storage_read", "ts": 0,
+             "dur": 10},
+        ]
+    }
+    rows = op_overlap_rows(trace)
+    assert [r["op"] for r in rows] == ["op-a", "op-b"]
+    assert rows[0]["overlap_s"] == 0.0
+    assert rows[1]["overlap_s"] == pytest.approx(0.5)
+    assert rows[1]["busy_s"] == pytest.approx(1.0)
+
+
+def test_diagnose_report_includes_overlap_section(tmp_path):
+    """End-to-end: a dataflow compute's flight bundle renders a per-op
+    overlap section naming the scheduler mode."""
+    from cubed_tpu.diagnose import render_report
+    from cubed_tpu.observability.flightrecorder import (
+        FlightRecorder,
+        load_bundle,
+    )
+
+    spec = _dataflow_spec(tmp_path)
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    b = ct.map_blocks(_SlowBlock(0.4), a, dtype=np.float64)
+    c = xp.add(b, 1.0)
+    rec = FlightRecorder(bundle_dir=str(tmp_path), always=True)
+    result = c.compute(
+        executor=AsyncPythonDagExecutor(),
+        callbacks=[rec],
+        optimize_graph=False,
+    )
+    np.testing.assert_array_equal(result, an + 2.0)
+    bundles = glob.glob(f"{tmp_path}/bundle-*")
+    assert bundles, os.listdir(tmp_path)
+    report = render_report(load_bundle(bundles[0]))
+    assert "per-op overlap" in report
+    assert "scheduler=dataflow" in report
+    assert "ran concurrently with predecessors" in report

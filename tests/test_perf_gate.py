@@ -1,0 +1,104 @@
+"""Perf-regression gate: the bench trajectory must not rot silently.
+
+``bench.py`` appends one slim record per run to
+``BENCH_METRICS_HISTORY.jsonl``; this tier-1 test compares the two most
+recent records with the same rules bench.py's delta printer uses
+(``bench.perf_regressions``) and fails loudly on a >20% wall-clock or
+throughput regression. With fewer than two records (fresh clone, bench
+never run twice) it skips cleanly — a gate with no trajectory has nothing
+to guard.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:  # bench.py lives at the repo root
+    sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402
+
+
+def _load_history() -> list:
+    path = REPO / "BENCH_METRICS_HISTORY.jsonl"
+    records = []
+    try:
+        lines = path.read_text(encoding="utf-8").splitlines()
+    except OSError:
+        return records
+    for ln in lines:
+        if not ln.strip():
+            continue
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn line: a killed bench run must not fail the gate
+        if isinstance(rec, dict) and rec.get("configs"):
+            records.append(rec)
+    return records
+
+
+def test_perf_regression_gate():
+    records = _load_history()
+    if len(records) < 2:
+        pytest.skip(
+            f"perf gate needs two bench records, found {len(records)} "
+            "(run bench.py twice to arm it)"
+        )
+    prev, cur = records[-2], records[-1]
+    regressions = bench.perf_regressions(prev, cur)
+    assert not regressions, (
+        f"PERF REGRESSION >{bench.PERF_GATE_THRESHOLD_PCT:.0f}% between "
+        f"bench runs {prev.get('t')} and {cur.get('t')}: "
+        + "; ".join(regressions)
+        + " — if intentional, re-run bench.py to re-anchor the trajectory"
+    )
+
+
+# -- gate logic units (synthetic records; run everywhere) ----------------
+
+
+def _rec(**configs):
+    return {"t": "test", "configs": configs}
+
+
+def test_gate_flags_wall_clock_regression():
+    prev = _rec(addsum={"elapsed": 10.0})
+    cur = _rec(addsum={"elapsed": 13.0})
+    out = bench.perf_regressions(prev, cur)
+    assert len(out) == 1 and "addsum" in out[0]
+
+
+def test_gate_tolerates_noise_and_improvement():
+    prev = _rec(addsum={"elapsed": 10.0}, reduce={"elapsed": 8.0})
+    cur = _rec(addsum={"elapsed": 11.0}, reduce={"elapsed": 4.0})
+    assert bench.perf_regressions(prev, cur) == []
+
+
+def test_gate_flags_fleet_throughput_drop():
+    prev = _rec(fleet_scaling={"tasks_per_s": {"1": 100.0, "4": 300.0}})
+    cur = _rec(fleet_scaling={"tasks_per_s": {"1": 99.0, "4": 200.0}})
+    out = bench.perf_regressions(prev, cur)
+    assert len(out) == 1 and "4w" in out[0]
+
+
+def test_gate_flags_scheduler_speedup_drop():
+    prev = _rec(scheduler_deepchain={
+        "speedup": 1.8, "dataflow": {"elapsed": 2.0},
+    })
+    cur = _rec(scheduler_deepchain={
+        "speedup": 1.0, "dataflow": {"elapsed": 2.1},
+    })
+    out = bench.perf_regressions(prev, cur)
+    assert len(out) == 1 and "speedup" in out[0]
+
+
+def test_gate_ignores_new_and_vanished_configs():
+    prev = _rec(old_config={"elapsed": 1.0})
+    cur = _rec(new_config={"elapsed": 99.0})
+    assert bench.perf_regressions(prev, cur) == []
