@@ -392,6 +392,169 @@ def measure_fleet_scaling(timeout: float):
     return {"tasks_per_s": tps, "efficiency": efficiency}
 
 
+#: coordinator-recovery workload: enough sleep-bound tasks that the kill
+#: reliably lands mid-compute, small enough to keep the 3-phase sweep
+#: (uninterrupted / killed-at-50% / resume) under ~30s of compute
+RECOVERY_TASKS = 36
+RECOVERY_TASK_DELAY_S = 0.12
+
+COORD_RECOVERY = r"""
+import json, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import cubed_tpu as ct
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+
+mode = sys.argv[1]
+
+
+def sleep_add(x):
+    time.sleep({delay!r})
+    return x + 1.0
+
+
+spec = ct.Spec(work_dir={work_dir!r}, allowed_mem="2GB",
+               journal={journal!r})
+an = np.arange({tasks!r} * 4, dtype=np.float64).reshape(-1, 4)
+a = ct.from_array(an, chunks=(1, 4), spec=spec)  # one row per task
+r = ct.map_blocks(sleep_add, a, dtype=np.float64)
+total = r.plan.num_tasks()
+
+ex = DistributedDagExecutor(n_local_workers=2)
+try:
+    ex._ensure_fleet()  # boot outside the timed window
+    reg = get_registry()
+    before = reg.snapshot()
+    t0 = time.perf_counter()
+    if mode == "resume":
+        val = ex.resume_compute(r, {journal!r})
+    else:
+        val = np.asarray(r.compute(executor=ex))
+    elapsed = time.perf_counter() - t0
+    delta = reg.snapshot_delta(before)
+    assert (val == an + 1.0).all()
+    print(json.dumps({{
+        "elapsed": elapsed, "total": total,
+        "tasks_skipped_resume": delta.get("tasks_skipped_resume", 0),
+        "resumed_tasks": delta.get("tasks_completed", 0),
+    }}), flush=True)
+finally:
+    ex.close()
+"""
+
+
+def measure_coordinator_recovery(timeout: float):
+    """Kill-the-coordinator-at-50%-then-resume vs an uninterrupted run.
+
+    Three phases over the same plan (deterministic op names via a pinned
+    CUBED_TPU_CONTEXT_ID): (1) uninterrupted with the journal armed — the
+    baseline, journal overhead included; (2) the same compute SIGKILLed
+    when the fsync'd journal shows ~50% of tasks complete; (3)
+    ``resume_compute`` from the journal in a fresh process. ``elapsed`` is
+    the total recovery wall clock (run-to-kill + resume), so the generic
+    perf gate flags a >20% regression like any other config. Returns None
+    on failure — additive, never the reason a bench run dies."""
+    import shutil
+    import signal
+    import tempfile
+
+    deadline = time.monotonic() + timeout
+    work_dir = tempfile.mkdtemp()
+    journal = os.path.join(work_dir, "bench.journal.jsonl")
+    script = COORD_RECOVERY.format(
+        repo=REPO, work_dir=work_dir, journal=journal,
+        tasks=RECOVERY_TASKS, delay=RECOVERY_TASK_DELAY_S,
+    )
+    env = dict(_scrubbed_cpu_env(), CUBED_TPU_CONTEXT_ID="cubed-benchrec")
+    try:
+        from cubed_tpu.runtime.journal import load_journal
+
+        # phase 1: uninterrupted baseline (journal on, like the real run)
+        out = subprocess.run(
+            [sys.executable, "-c", script, "full"], env=env,
+            capture_output=True, text=True,
+            timeout=max(10.0, deadline - time.monotonic()),
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"uninterrupted run failed (rc={out.returncode}): "
+                f"{out.stderr[-2000:]}"
+            )
+        full = json.loads(out.stdout.strip().splitlines()[-1])
+        os.unlink(journal)  # phase 2 writes a fresh journal
+
+        # phase 2: the same compute, coordinator hard-killed at ~50%.
+        # Its own session/process group, so the kill takes the client AND
+        # its local worker subprocesses — orphaned workers would otherwise
+        # burn CPU (and hammer the dead port) throughout the timed resume
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script, "run"], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True,
+        )
+        t0 = time.perf_counter()
+        killed = False
+        try:
+            while time.monotonic() < deadline and proc.poll() is None:
+                if os.path.exists(journal) and len(
+                    load_journal(journal)["completed"]
+                ) >= RECOVERY_TASKS // 2 + 1:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                    killed = True
+                    break
+                time.sleep(0.05)
+            run_to_kill = time.perf_counter() - t0
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                proc.wait(timeout=30)
+        if not killed:
+            raise RuntimeError("compute finished before the kill landed")
+
+        # phase 3: resume from the journal in a fresh process
+        out = subprocess.run(
+            [sys.executable, "-c", script, "resume"], env=env,
+            capture_output=True, text=True,
+            timeout=max(10.0, deadline - time.monotonic()),
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"resume failed (rc={out.returncode}): {out.stderr[-2000:]}"
+            )
+        resume = json.loads(out.stdout.strip().splitlines()[-1])
+        recovery_total = run_to_kill + resume["elapsed"]
+        rec = {
+            # the gated number: kill-at-50% + resume, end to end
+            "elapsed": recovery_total,
+            "uninterrupted_s": full["elapsed"],
+            "interrupted_run_s": run_to_kill,
+            "resume_s": resume["elapsed"],
+            "recovery_overhead_x": (
+                recovery_total / full["elapsed"] if full["elapsed"] else None
+            ),
+            "tasks_skipped_resume": resume["tasks_skipped_resume"],
+            "resumed_tasks": resume["resumed_tasks"],
+            "total_tasks": resume["total"],
+        }
+        print(
+            f"coordinator recovery: uninterrupted {full['elapsed']:.2f}s, "
+            f"kill@50%+resume {recovery_total:.2f}s "
+            f"({resume['tasks_skipped_resume']} task(s) skipped on resume)",
+            file=sys.stderr, flush=True,
+        )
+        return rec
+    except Exception as e:
+        print(f"coordinator recovery sweep skipped: {e}", file=sys.stderr)
+        return None
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+
+
 def _scrubbed_cpu_env() -> dict:
     """Tunnel-free env: no plugin-gating vars, ONE CPU device.
 
@@ -790,6 +953,18 @@ def main() -> None:
             metrics_record["scheduler_deepchain"] = sched
     else:
         print("scheduler overlap sweep skipped: out of budget",
+              file=sys.stderr)
+
+    # coordinator crash recovery: kill-at-50%-then-resume-from-journal vs
+    # an uninterrupted run (three fleet boots + ~3x a short sleep-bound
+    # compute); `elapsed` is the recovery total so the generic perf gate
+    # flags regressions like any other config
+    if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 75:
+        recovery = measure_coordinator_recovery(_remaining(120))
+        if recovery is not None:
+            metrics_record["coordinator_recovery"] = recovery
+    else:
+        print("coordinator recovery sweep skipped: out of budget",
               file=sys.stderr)
 
     # per-op timing / IO-byte trajectories ride alongside the headline

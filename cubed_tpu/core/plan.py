@@ -44,8 +44,14 @@ from ..utils import (  # noqa: F401  (gensym re-exported for plan builders)
 
 logger = logging.getLogger(__name__)
 
-#: unique run id for this client process; work_dir data lives under it
-CONTEXT_ID = f"cubed-{uuid.uuid4().hex[:10]}"
+#: unique run id for this client process; work_dir data lives under it.
+#: Overridable via CUBED_TPU_CONTEXT_ID: a resumable deployment (resume=True
+#: across client restarts, or resume_from_journal after a coordinator
+#: crash) must pin it so the restarted client resolves intermediate-array
+#: paths to the SAME store locations the crashed run wrote
+CONTEXT_ID = (
+    os.environ.get("CUBED_TPU_CONTEXT_ID") or f"cubed-{uuid.uuid4().hex[:10]}"
+)
 
 
 def new_temp_path(name: str, spec=None) -> str:
@@ -219,6 +225,7 @@ class Plan:
         optimize_graph: bool = True,
         optimize_function: Optional[Callable] = None,
         resume: Optional[bool] = None,
+        resume_from_journal: Optional[str] = None,
         array_names: Optional[tuple] = None,
         spec=None,
         **kwargs,
@@ -227,6 +234,16 @@ class Plan:
             from ..runtime.executors.python import PythonDagExecutor
 
             executor = PythonDagExecutor()
+
+        if resume_from_journal is not None:
+            # coordinator-crash recovery: the journal's completed-task set
+            # intersects the chunk-integrity resume scan (the executors
+            # build the ResumeState from this), so only tasks that BOTH
+            # verify on disk AND were journaled complete are skipped
+            from ..runtime.journal import load_journal
+
+            resume = True
+            kwargs["journal"] = load_journal(resume_from_journal)
 
         finalized = self._finalize(optimize_graph, optimize_function, array_names)
         dag = finalized.dag
@@ -248,6 +265,16 @@ class Plan:
         aggregator = _ComputeAggregator()
         all_callbacks = list(callbacks) if callbacks else []
         all_callbacks.append(aggregator)
+        journal_path = getattr(spec, "journal", None)
+        if journal_path:
+            # durable compute journal (runtime/journal.py): compute
+            # metadata, per-task dispatch/completion, and the decision ring
+            # land in an append-only fsync'd JSONL beside the store — what
+            # resume_from_journal rebuilds coordinator state from after a
+            # client crash
+            from ..runtime.journal import JournalCallback
+
+            all_callbacks.append(JournalCallback(journal_path))
         recorder_dir = os.environ.get(FLIGHT_RECORDER_ENV_VAR)
         if recorder_dir and not any(
             isinstance(cb, TraceCollector) for cb in all_callbacks

@@ -34,6 +34,14 @@ _TIMELINE_GROUPS = {
                      "guard_soft_exceeded", "device_memory"),
     "stragglers": ("straggler",),
     "scheduling": ("scheduler_mode", "dataflow_graph", "dispatch_early"),
+    # the control plane's connection lifecycle: partitions, reconnects,
+    # lease expiries, impostor rejections, and the drain/scale events that
+    # change fleet membership (PR 8)
+    "connectivity": ("worker_disconnected", "worker_reconnected",
+                     "lease_expired", "worker_rejected",
+                     "worker_drain_requested", "worker_draining",
+                     "worker_drained", "scale_up", "scale_down",
+                     "spawn_died"),
 }
 
 

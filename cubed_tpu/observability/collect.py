@@ -66,6 +66,27 @@ _samples: deque = deque(maxlen=MAX_SAMPLES)
 _oob_tasks: deque = deque(maxlen=MAX_OOB_TASKS)
 
 
+#: extra consumers of decision entries beyond the bounded ring — the
+#: durable compute journal (runtime/journal.py) registers here so a
+#: coordinator crash still leaves the decision timeline on disk
+_decision_sinks: list = []
+
+
+def add_decision_sink(fn) -> None:
+    """Register a callable receiving every decision entry (a plain dict)."""
+    with _ring_lock:
+        if fn not in _decision_sinks:
+            _decision_sinks.append(fn)
+
+
+def remove_decision_sink(fn) -> None:
+    with _ring_lock:
+        try:
+            _decision_sinks.remove(fn)
+        except ValueError:
+            pass
+
+
 def record_decision(kind: str, **attrs) -> None:
     """Record one scheduler/controller decision (timestamped, correlated).
 
@@ -79,6 +100,12 @@ def record_decision(kind: str, **attrs) -> None:
         entry.update(attrs)
     with _ring_lock:
         _decisions.append(entry)
+        sinks = list(_decision_sinks)
+    for fn in sinks:
+        try:
+            fn(dict(entry))
+        except Exception:  # a broken sink must never fail a decision site
+            logger.exception("decision sink failed")
 
 
 def record_sample(**attrs) -> None:

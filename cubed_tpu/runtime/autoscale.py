@@ -261,6 +261,11 @@ class Autoscaler:
                             "autoscaler: worker %s died before registering;"
                             " reopening its slot", n,
                         )
+            # disconnected-but-leased workers (a network partition, not a
+            # death) still count as capacity: the lease may resolve to a
+            # reconnect, and backfilling on top of one would double the
+            # fleet for every transient blip — if the lease expires the
+            # worker leaves the view and reads as a hole on the next tick
             active = [r for r in view if not r["draining"]]
             n_active = len(active) + len(self._pending_spawns)
             total_threads = sum(max(r["nthreads"], 1) for r in active)
@@ -333,12 +338,19 @@ class Autoscaler:
                 and len(active) > p.min_workers
                 and now - self._last_down >= p.cooldown_down_s
             ):
-                victim = min(active, key=lambda r: r["outstanding"])
-                if not overcapacity:
-                    self.desired = max(p.min_workers, self.desired - 1)
-                self._last_down = now
-                self._idle_rounds = 0
-                self._retire(victim["name"], load)
+                # a drain request cannot reach a disconnected worker; pick
+                # the least-loaded CONNECTED one (a fleet that is entirely
+                # partitioned simply skips this round)
+                reachable = [
+                    r for r in active if r.get("connected", True)
+                ]
+                if reachable:
+                    victim = min(reachable, key=lambda r: r["outstanding"])
+                    if not overcapacity:
+                        self.desired = max(p.min_workers, self.desired - 1)
+                    self._last_down = now
+                    self._idle_rounds = 0
+                    self._retire(victim["name"], load)
             self.stats["desired_workers"] = self.desired
 
     def _spawn(self, k: int, reason: str, load: float) -> None:

@@ -38,6 +38,44 @@ Wire format: 8-byte big-endian length prefix + cloudpickle frame. The
 fabric trusts its peers (same trust model as dask/lithops workers — they
 already execute arbitrary user functions by design); deployments must scope
 the listen address/network accordingly.
+
+**Partition tolerance (PR 8).** The paper's data plane already tolerates
+every failure — all chunk data moves through strongly-consistent storage
+with idempotent whole-chunk writes — but this control plane used to treat
+a socket error as worker death. Now the two are separated:
+
+- **Session tokens + reconnect handshake.** Registration is answered with a
+  ``hello_ack`` carrying a per-session token. A worker that loses its
+  connection keeps running its in-flight tasks, reconnects, and presents
+  the token; the coordinator swaps the socket into the existing
+  ``_WorkerConn`` (same name, same outstanding futures). A hello claiming a
+  live *connected* worker's name without its token is rejected as an
+  impostor.
+- **Lease-based task ownership.** Only lease expiry — never socket EOF —
+  declares ``WorkerLostError``. A disconnect starts a ``lease_s`` clock
+  (renewed by any received frame while connected); a worker that
+  reconnects inside its lease keeps every in-flight task (no requeue, no
+  retry-budget draw), one that stays dark past it is dropped and its tasks
+  requeue exactly once as worker loss. Locally spawned workers whose
+  process has verifiably exited skip the lease (a dead process cannot
+  reconnect).
+- **Sequenced, replayed results.** Every consequential worker→coordinator
+  message (result / error / drained / abandoned) carries a monotonic
+  ``seq``, is acked by the coordinator, and is retained in a bounded
+  worker-side outbox until acked; a reconnect replays unacked messages in
+  order. The coordinator drops any ``seq`` at or below the highest it has
+  processed (``fleet_messages_deduped``), and workers drop re-delivered
+  task assignments by task id (``fleet_assignments_deduped``) — so
+  injected duplication or replay can never apply a result twice.
+- **Frame robustness.** A truncated/garbage frame (bad length prefix,
+  unpicklable payload) raises :class:`CorruptFrameError` — counted
+  (``frames_corrupt``) and treated as a connection-level error on that
+  peer (clean disconnect, lease rules apply) instead of killing the recv
+  thread.
+
+Chaos coverage for all of this lives in ``runtime/faults.py`` (seeded
+message drop/delay/duplication/reset and a timed one-way partition of a
+named worker) and ``tests/runtime/test_partition.py``.
 """
 
 from __future__ import annotations
@@ -50,7 +88,8 @@ import struct
 import threading
 import time
 import traceback
-from collections import OrderedDict
+import uuid
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Any, Dict, Optional
 
@@ -64,7 +103,19 @@ MAX_FRAME = 1 << 31
 
 
 class WorkerLostError(RuntimeError):
-    """The worker executing a task disconnected before reporting a result."""
+    """The worker owning a task is gone for good: its lease expired without
+    a reconnect, its process verifiably exited, or the fleet shut down. A
+    mere socket error is NOT this — a disconnected worker keeps task
+    ownership until its lease runs out (see the module docstring)."""
+
+
+class CorruptFrameError(ConnectionError):
+    """A frame with a hostile length prefix or an undecodable payload.
+
+    A ``ConnectionError`` subclass on purpose: once the stream carries
+    garbage, nothing after it can be trusted — the only safe handling is to
+    drop the connection (counted in ``frames_corrupt``) and let the
+    reconnect/lease machinery decide what the peer's silence means."""
 
 
 class WorkerDrainedError(WorkerLostError):
@@ -103,11 +154,18 @@ class NoWorkersError(RuntimeError):
     """No live workers are connected to the coordinator."""
 
 
-def send_frame(sock: socket.socket, obj: Any, lock: Optional[threading.Lock] = None) -> None:
+def frame_bytes(obj: Any) -> bytes:
+    """One wire frame (length prefix + cloudpickle payload), materialized
+    eagerly so pickling errors surface before anything is queued or sent —
+    the ONE place the frame format lives."""
     import cloudpickle
 
     payload = cloudpickle.dumps(obj)
-    data = _LEN.pack(len(payload)) + payload
+    return _LEN.pack(len(payload)) + payload
+
+
+def send_frame(sock: socket.socket, obj: Any, lock: Optional[threading.Lock] = None) -> None:
+    data = frame_bytes(obj)
     if lock is not None:
         with lock:
             sock.sendall(data)
@@ -121,8 +179,17 @@ def recv_frame(sock: socket.socket) -> Any:
     header = _recv_exact(sock, _LEN.size)
     (n,) = _LEN.unpack(header)
     if n > MAX_FRAME:
-        raise ConnectionError(f"frame length {n} exceeds limit")
-    return cloudpickle.loads(_recv_exact(sock, n))
+        raise CorruptFrameError(f"frame length {n} exceeds limit")
+    payload = _recv_exact(sock, n)
+    try:
+        return cloudpickle.loads(payload)
+    except Exception as e:
+        # torn or garbage payload: the stream is desynchronized — surface a
+        # connection-level error, never an uncaught exception that would
+        # kill the receiving thread
+        raise CorruptFrameError(
+            f"undecodable {n}-byte frame ({type(e).__name__}: {e})"
+        ) from e
 
 
 def _fail_future(fut: Future, exc: BaseException) -> None:
@@ -196,6 +263,22 @@ class _WorkerConn:
         #: spans on the client timeline (observability/collect.py)
         self.clock_offset: Optional[float] = None
         self.clock_rtt: Optional[float] = None
+        #: per-session secret: a reconnecting worker must present it, so a
+        #: stranger claiming a live worker's name cannot steal its tasks
+        self.token = uuid.uuid4().hex
+        #: False while the worker is disconnected-but-leased: routing skips
+        #: it, its task deadlines freeze, and only lease expiry drops it
+        self.connected = True
+        #: bumped on every reconnect; a recv loop whose generation is stale
+        #: was superseded and must exit without touching the conn
+        self.generation = 0
+        #: highest sequenced (important) message processed; replayed or
+        #: duplicated frames at/below it are acked but not re-applied
+        self.last_seq = 0
+        #: monotonic deadline after which a disconnected worker is declared
+        #: lost; renewed by every received frame while connected
+        self.lease_deadline = float("inf")
+        self.disconnect_reason: Optional[str] = None
 
 
 class Coordinator:
@@ -214,6 +297,7 @@ class Coordinator:
         task_timeout: Optional[float] = None,
         timeout_strikes: int = 2,
         blob_cache_size: int = 1024,
+        lease_s: float = 15.0,
     ):
         self._server = socket.create_server((host, port))
         self._server.settimeout(0.2)
@@ -250,6 +334,10 @@ class Coordinator:
         self._departed: OrderedDict[str, dict] = OrderedDict()
         self.task_timeout = task_timeout
         self.timeout_strikes = timeout_strikes
+        #: how long a disconnected worker keeps owning its in-flight tasks;
+        #: a reconnect inside the lease costs nothing, expiry requeues its
+        #: tasks exactly once as worker loss
+        self.lease_s = float(lease_s)
         #: optional hook mapping a worker name to its process exit code
         #: (the executor sets it for locally spawned workers): a dropped
         #: connection plus exitcode -9/137 reads as an OOM-killed worker,
@@ -259,7 +347,9 @@ class Coordinator:
         self.stats: Dict[str, int] = {
             "blobs_sent": 0, "tasks_sent": 0, "task_timeouts": 0,
             "workers_lost": 0, "drains_completed": 0, "workers_preempted": 0,
-            "tasks_abandoned_on_drain": 0,
+            "tasks_abandoned_on_drain": 0, "workers_disconnected": 0,
+            "workers_reconnected": 0, "leases_expired": 0,
+            "frames_corrupt": 0, "workers_rejected": 0,
         }
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="coordinator-accept", daemon=True
@@ -270,6 +360,9 @@ class Coordinator:
                 target=self._timeout_loop, name="coordinator-timeouts",
                 daemon=True,
             ).start()
+        threading.Thread(
+            target=self._lease_loop, name="coordinator-leases", daemon=True
+        ).start()
 
     # -- worker management ---------------------------------------------
 
@@ -284,25 +377,132 @@ class Coordinator:
             try:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 hello = recv_frame(sock)
-                if hello.get("type") != "hello":
+                if not isinstance(hello, dict) or hello.get("type") != "hello":
                     raise ConnectionError(f"bad hello: {hello!r}")
             except Exception:
                 logger.exception("rejecting connection from %s", addr)
                 sock.close()
                 continue
-            conn = _WorkerConn(sock, addr, hello)
+            self._register(sock, addr, hello)
+
+    def _register(self, sock, addr, hello: dict) -> None:
+        """Handle one hello: a token-bearing reconnect re-adopts the
+        existing session; a token-less hello claiming a live CONNECTED
+        worker's name is rejected as an impostor; a token-less hello under
+        a disconnected worker's name supersedes the old session (a
+        restarted process cannot resume work it no longer holds)."""
+        from ..observability.collect import record_decision
+
+        name = hello.get("name") or f"{addr[0]}:{addr[1]}"
+        token = hello.get("token")
+        with self._lock:
+            existing = next(
+                (w for w in self._workers if w.alive and w.name == name), None
+            )
+        if existing is not None and token and token == existing.token:
+            if self._adopt_reconnect(existing, sock, addr):
+                return
+            # the lease expired between the lookup and the adopt: the old
+            # session is gone — fall through to a fresh registration
+            existing = None
+        elif existing is not None and existing.connected:
             with self._lock:
-                self._workers.append(conn)
-                self._workers_ever += 1
-                self._worker_names_ever.add(conn.name)
-                self._worker_joined.notify_all()
-            threading.Thread(
-                target=self._recv_loop,
-                args=(conn,),
-                name=f"coordinator-recv-{conn.name}",
-                daemon=True,
-            ).start()
-            logger.info("worker %s joined (%d threads)", conn.name, conn.nthreads)
+                self.stats["workers_rejected"] += 1
+            get_registry().counter("workers_rejected").inc()
+            record_decision("worker_rejected", worker=name)
+            logger.warning(
+                "rejecting hello from %s claiming live worker %s "
+                "(missing/wrong session token)", addr, name,
+            )
+            try:
+                send_frame(sock, {
+                    "type": "hello_reject",
+                    "reason": f"name {name!r} belongs to a live connected "
+                    "worker (wrong or missing session token)",
+                })
+            except (ConnectionError, OSError):
+                pass
+            sock.close()
+            return
+        elif existing is not None:
+            # disconnected-but-leased, and the newcomer has no (valid)
+            # token: a restarted process under the same name — the old
+            # session's in-flight work is unrecoverable, hand it back now
+            self._drop_worker(
+                existing,
+                "superseded by a new registration under the same name",
+            )
+        conn = _WorkerConn(sock, addr, hello)
+        conn.lease_deadline = time.monotonic() + self.lease_s
+        try:
+            send_frame(sock, {
+                "type": "hello_ack", "token": conn.token, "resume": False,
+                "lease_s": self.lease_s,
+            })
+        except (ConnectionError, OSError) as e:
+            logger.warning("hello_ack to %s failed: %s", name, e)
+            sock.close()
+            return
+        with self._lock:
+            self._workers.append(conn)
+            self._workers_ever += 1
+            self._worker_names_ever.add(conn.name)
+            self._worker_joined.notify_all()
+        threading.Thread(
+            target=self._recv_loop,
+            args=(conn, sock, conn.generation),
+            name=f"coordinator-recv-{conn.name}",
+            daemon=True,
+        ).start()
+        logger.info("worker %s joined (%d threads)", conn.name, conn.nthreads)
+
+    def _adopt_reconnect(self, conn: _WorkerConn, sock, addr) -> bool:
+        """Swap a reconnecting worker's new socket into its live session:
+        outstanding futures, lease, and blob bookkeeping all survive. The
+        superseded recv loop notices its stale generation and exits."""
+        from ..observability.collect import record_decision
+
+        with self._lock:
+            if conn.dropped:
+                return False
+            old_sock = conn.sock
+            conn.sock = sock
+            conn.address = addr
+            conn.connected = True
+            conn.generation += 1
+            gen = conn.generation
+            conn.lease_deadline = time.monotonic() + self.lease_s
+            conn.disconnect_reason = None
+            self.stats["workers_reconnected"] += 1
+            outstanding = len(conn.outstanding)
+            self._worker_joined.notify_all()
+        try:
+            old_sock.close()
+        except OSError:
+            pass
+        get_registry().counter("workers_reconnected").inc()
+        record_decision(
+            "worker_reconnected", worker=conn.name, outstanding=outstanding,
+        )
+        logger.warning(
+            "worker %s reconnected (%d in-flight tasks kept under its "
+            "lease)", conn.name, outstanding,
+        )
+        try:
+            send_frame(sock, {
+                "type": "hello_ack", "token": conn.token, "resume": True,
+                "lease_s": self.lease_s,
+            }, conn.send_lock)
+        except (ConnectionError, OSError) as e:
+            self._on_disconnect(conn, f"hello_ack failed: {e}", gen=gen)
+            return True  # adopted (and immediately disconnected again)
+        threading.Thread(
+            target=self._recv_loop,
+            args=(conn, sock, gen),
+            name=f"coordinator-recv-{conn.name}",
+            daemon=True,
+        ).start()
+        return True
 
     def wait_for_workers(self, count: int, timeout: float = 60.0) -> None:
         with self._lock:
@@ -329,15 +529,22 @@ class Coordinator:
             return len([w for w in self._workers if w.alive])
 
     def _drop_worker(
-        self, conn: _WorkerConn, reason: str, clean: bool = False
-    ) -> None:
-        """Remove a worker. ``clean=True`` marks an orderly departure (a
-        completed drain): it is not counted as ``workers_lost`` — the fleet
-        asked it to leave (or it left within its preemption notice), and
-        its in-flight work was already handed back explicitly."""
+        self, conn: _WorkerConn, reason: str, clean: bool = False,
+        only_if_disconnected: bool = False,
+    ) -> bool:
+        """Remove a worker; True when this call actually dropped it.
+        ``clean=True`` marks an orderly departure (a completed drain): it
+        is not counted as ``workers_lost`` — the fleet asked it to leave
+        (or it left within its preemption notice), and its in-flight work
+        was already handed back explicitly. ``only_if_disconnected=True``
+        (the lease-expiry path) aborts if a reconnect won the race between
+        the expiry check and this call — the re-adopted live session must
+        not be torn down."""
         with self._lock:
             if conn.dropped:
-                return  # recv-loop error racing another drop: already done
+                return False  # recv-loop error racing another drop: done
+            if only_if_disconnected and conn.connected:
+                return False  # a reconnect won: the lease no longer applies
             conn.dropped = True
         if (
             self.exit_probe is not None
@@ -411,11 +618,159 @@ class Coordinator:
             )
         elif clean:
             logger.info("worker %s departed cleanly (%s)", conn.name, reason)
+        return True
 
-    def _recv_loop(self, conn: _WorkerConn) -> None:
+    def _on_disconnect(
+        self, conn: _WorkerConn, reason: str, gen: Optional[int] = None
+    ) -> None:
+        """A worker's socket died. Socket EOF is NOT worker death: unless
+        the worker's process has verifiably exited (local exit probe), was
+        draining, or the fleet is shutting down, the worker enters the
+        disconnected-but-leased state — routing skips it, its task
+        deadlines freeze, and only lease expiry (or a reconnect) resolves
+        it."""
+        from ..observability.collect import record_decision
+
+        with self._lock:
+            if conn.dropped or not conn.connected:
+                return
+            if gen is not None and conn.generation != gen:
+                return  # a reconnect already superseded this socket
+            # pin the generation we are disconnecting: an adopt that lands
+            # during the exit probe below bumps it, and must not have its
+            # freshly installed socket closed by this stale failure
+            gen = conn.generation
+        if self._closed.is_set() or conn.draining:
+            # shutdown, or a drainer that died mid-drain: the old semantics
+            # (and the old diagnostics, e.g. the drain hard-kill hint)
+            self._drop_worker(conn, reason)
+            return
+        if self.exit_probe is not None:
+            # a locally spawned worker whose process already exited can
+            # never reconnect: skip the lease and fail over immediately —
+            # this keeps crash recovery exactly as fast as before leases
+            try:
+                code = self.exit_probe(conn.name)
+            except Exception:
+                code = None
+            if code is not None:
+                self._drop_worker(conn, reason)
+                return
+        with self._lock:
+            if (
+                conn.dropped
+                or not conn.connected
+                or conn.generation != gen
+            ):
+                return  # raced a concurrent drop/reconnect during the probe
+            conn.connected = False
+            conn.disconnect_reason = reason
+            conn.lease_deadline = time.monotonic() + self.lease_s
+            outstanding = len(conn.outstanding)
+            self.stats["workers_disconnected"] += 1
+            # captured under the lock: an adopt racing this close must not
+            # have its freshly installed socket shut by us
+            sock_to_close = conn.sock
+        try:
+            sock_to_close.close()
+        except OSError:
+            pass
+        get_registry().counter("workers_disconnected").inc()
+        record_decision(
+            "worker_disconnected", worker=conn.name, reason=reason,
+            outstanding=outstanding, lease_s=self.lease_s,
+        )
+        logger.warning(
+            "worker %s disconnected (%s); %d in-flight task(s) stay leased "
+            "to it for %.1fs pending a reconnect",
+            conn.name, reason, outstanding, self.lease_s,
+        )
+
+    def _lease_loop(self) -> None:
+        """Declare disconnected workers lost once their lease runs out —
+        the ONLY path (besides a verified process exit and shutdown) that
+        turns a network fault into ``WorkerLostError``.
+
+        A CONNECTED worker whose lease lapses (no frame received for a
+        whole window — heartbeats renew it every second, so this means a
+        vanished host whose TCP stack never sent a reset) is first demoted
+        to the disconnected state, earning one more lease window for its
+        side's watchdog to reconnect; only then does expiry drop it. Total
+        time to declare such a host lost: 2 x lease_s — finite, where it
+        used to hang forever without a ``task_timeout``."""
+        from ..observability.collect import record_decision
+
+        interval = max(0.05, min(1.0, self.lease_s / 5))
+        while not self._closed.wait(interval):
+            now = time.monotonic()
+            with self._lock:
+                expired = [
+                    w for w in self._workers
+                    if w.alive and not w.connected
+                    and now > w.lease_deadline
+                ]
+                silent = [
+                    w for w in self._workers
+                    if w.alive and w.connected and now > w.lease_deadline
+                ]
+            for conn in silent:
+                self._on_disconnect(
+                    conn,
+                    f"no frames received for {self.lease_s}s "
+                    "(half-open link or vanished host)",
+                )
+            for conn in expired:
+                reason = conn.disconnect_reason
+                if not self._drop_worker(
+                    conn,
+                    f"lease expired {self.lease_s}s after disconnect "
+                    f"({reason})",
+                    only_if_disconnected=True,
+                ):
+                    continue  # a reconnect won the race: nothing expired
+                with self._lock:
+                    self.stats["leases_expired"] += 1
+                get_registry().counter("leases_expired").inc()
+                record_decision(
+                    "lease_expired", worker=conn.name, reason=reason,
+                )
+
+    def _recv_loop(self, conn: _WorkerConn, sock, gen: int) -> None:
         try:
             while conn.alive:
-                msg = recv_frame(conn.sock)
+                msg = recv_frame(sock)
+                if not isinstance(msg, dict):
+                    raise CorruptFrameError(
+                        f"non-dict frame from {conn.name}: "
+                        f"{type(msg).__name__}"
+                    )
+                with self._lock:
+                    if conn.generation != gen:
+                        return  # a reconnect superseded this socket
+                    # any frame from a connected worker renews its lease
+                    conn.lease_deadline = time.monotonic() + self.lease_s
+                seq = msg.get("seq")
+                if seq is not None:
+                    with self._lock:
+                        dup = seq <= conn.last_seq
+                        if not dup:
+                            conn.last_seq = seq
+                    # ack even a duplicate: the ack for the original may be
+                    # the very frame the partition ate
+                    try:
+                        send_frame(
+                            conn.sock, {"type": "ack", "seq": seq},
+                            conn.send_lock,
+                        )
+                    except (ConnectionError, OSError):
+                        pass  # recv will notice the dead socket
+                    if dup:
+                        # an outbox replay (or injected duplication) of a
+                        # message already applied: never process twice
+                        get_registry().counter(
+                            "fleet_messages_deduped"
+                        ).inc()
+                        continue
                 mtype = msg.get("type")
                 if mtype in ("result", "error"):
                     with self._lock:
@@ -554,12 +909,27 @@ class Coordinator:
                         conn.blobs_sent.discard(msg.get("blob_id"))
                 else:
                     logger.warning("unknown message from %s: %r", conn.name, mtype)
+        except CorruptFrameError as e:
+            # a torn/garbage frame desynchronizes the stream: count it,
+            # drop THIS connection cleanly, and let the lease decide what
+            # the peer's silence means — never kill the recv thread
+            with self._lock:
+                self.stats["frames_corrupt"] += 1
+            get_registry().counter("frames_corrupt").inc()
+            logger.warning(
+                "corrupt frame from worker %s: %s — dropping the "
+                "connection", conn.name, e,
+            )
+            if not self._closed.is_set():
+                self._on_disconnect(conn, f"corrupt frame: {e}", gen=gen)
         except (ConnectionError, OSError) as e:
             if not self._closed.is_set():
-                self._drop_worker(conn, str(e) or type(e).__name__)
+                self._on_disconnect(
+                    conn, str(e) or type(e).__name__, gen=gen
+                )
         except Exception:
             logger.exception("receiver for %s crashed", conn.name)
-            self._drop_worker(conn, "receiver crash")
+            self._on_disconnect(conn, "receiver crash", gen=gen)
 
     def _on_drained(self, conn: _WorkerConn, msg: dict) -> None:
         """A worker finished its drain: fail its abandoned in-flight tasks
@@ -614,10 +984,14 @@ class Coordinator:
 
         with self._lock:
             conn = next(
-                (w for w in self._workers if w.alive and w.name == name), None
+                (
+                    w for w in self._workers
+                    if w.alive and w.connected and w.name == name
+                ),
+                None,
             )
             if conn is None:
-                return False
+                return False  # gone, or disconnected (a drain can't reach it)
             conn.draining = True  # stop routing immediately, not on the ack
         try:
             send_frame(
@@ -649,6 +1023,10 @@ class Coordinator:
                     "name": w.name,
                     "draining": w.draining,
                     "pressured": w.pressured,
+                    # disconnected-but-leased: NOT a hole to backfill (the
+                    # lease may still resolve to a reconnect), but not a
+                    # drain candidate either — the autoscaler reads this
+                    "connected": w.connected,
                     "outstanding": len(w.outstanding) + len(w.ghost_ids),
                     "nthreads": w.nthreads,
                 }
@@ -669,6 +1047,15 @@ class Coordinator:
             timed_out: list[tuple[Future, str, int]] = []
             with self._lock:
                 for conn in self._workers:
+                    if not conn.connected:
+                        # a partitioned-but-leased worker cannot deliver
+                        # results; the LEASE governs its tasks, not the
+                        # task timeout — freeze their clocks so a
+                        # reconnect resumes them with a full window, and
+                        # never count a partition as a hang
+                        for entry in conn.deadlines.values():
+                            entry[0] = now + self.task_timeout
+                        continue
                     overdue = [
                         (tid, entry[1])
                         for tid, entry in conn.deadlines.items()
@@ -738,7 +1125,26 @@ class Coordinator:
         # routing may need a second try if a send races a worker death
         while True:
             with self._lock:
-                live = [w for w in self._workers if w.alive]
+                live = [w for w in self._workers if w.alive and w.connected]
+                if (
+                    not live
+                    and any(w.alive for w in self._workers)
+                    and not self._closed.is_set()
+                ):
+                    # every worker is disconnected-but-leased (a fleet-wide
+                    # partition): they are not lost yet — wait for a
+                    # reconnect, or for the leases to resolve the question
+                    self._worker_joined.wait_for(
+                        lambda: any(
+                            w.alive and w.connected for w in self._workers
+                        )
+                        or not any(w.alive for w in self._workers)
+                        or self._closed.is_set(),
+                        timeout=self.lease_s,
+                    )
+                    live = [
+                        w for w in self._workers if w.alive and w.connected
+                    ]
                 if (
                     not live
                     and self.backfill_grace_s > 0
@@ -751,11 +1157,15 @@ class Coordinator:
                     # register instead of failing the compute the drain
                     # protocol promised to protect
                     self._worker_joined.wait_for(
-                        lambda: any(w.alive for w in self._workers)
+                        lambda: any(
+                            w.alive and w.connected for w in self._workers
+                        )
                         or self._closed.is_set(),
                         timeout=self.backfill_grace_s,
                     )
-                    live = [w for w in self._workers if w.alive]
+                    live = [
+                        w for w in self._workers if w.alive and w.connected
+                    ]
                 if not live:
                     host, port = self.address
                     ever = self._workers_ever
@@ -793,12 +1203,15 @@ class Coordinator:
                     # if none arrives within the grace window.
                     self._worker_joined.wait_for(
                         lambda: any(
-                            w.alive and not w.draining for w in self._workers
+                            w.alive and w.connected and not w.draining
+                            for w in self._workers
                         )
                         or self._closed.is_set(),
                         timeout=self.backfill_grace_s,
                     )
-                    live = [w for w in self._workers if w.alive]
+                    live = [
+                        w for w in self._workers if w.alive and w.connected
+                    ]
                     if not live:
                         continue  # drainers gone: the no-live path decides
                 # draining workers are passed over while any non-draining
@@ -870,7 +1283,9 @@ class Coordinator:
                 with self._lock:
                     conn.outstanding.pop(task_id, None)
                     conn.deadlines.pop(task_id, None)
-                self._drop_worker(conn, f"send failed: {e}")
+                # a failed send means the socket is dead, not the worker:
+                # lease rules decide its fate while this task re-routes
+                self._on_disconnect(conn, f"send failed: {e}")
                 continue  # pick another worker for the same future
             except Exception:
                 # e.g. an unpicklable task input: the worker never saw the
@@ -899,6 +1314,7 @@ class Coordinator:
             for w in self._workers:
                 workers[w.name] = {
                     "alive": w.alive,
+                    "connected": w.connected,
                     "nthreads": w.nthreads,
                     "outstanding": len(w.outstanding),
                     "ghosts": len(w.ghost_ids),
@@ -935,11 +1351,147 @@ class Coordinator:
 # ----------------------------------------------------------------------
 
 
+#: unacked important messages a worker retains for replay across reconnects;
+#: beyond this the OLDEST is dropped (counted) — results live in the shared
+#: store anyway, so a dropped result frame costs a requeue, never data
+OUTBOX_CAP = 256
+
+#: worker stale-link watchdog thresholds. A healthy link echoes every 1s
+#: heartbeat and acks important frames within ~RTT, so silence past these
+#: windows reads as a half-open link and forces a reconnect. Known
+#: limitation: progress is measured per COMPLETE frame, so a single frame
+#: whose transfer legitimately exceeds the window (a huge op blob on a
+#: very slow link) would be cut and retransmitted from zero — the control
+#: plane ships kilobyte-scale frames by design (blobs once per worker, data
+#: through Zarr), but blob-heavy deployments on constrained links should
+#: raise these
+RX_STALE_S = 4.0
+ACK_STALE_S = 1.5
+
+
+class _WorkerLink:
+    """The worker's side of the coordinator connection.
+
+    Owns the socket, the monotonic ``seq`` counter, and a bounded outbox of
+    unacked *important* frames (result / error / drained / abandoned —
+    anything whose loss would strand coordinator state). ``send`` never
+    raises for link trouble: a failed or injected-away send leaves
+    important frames queued, and the reconnect path replays them in order
+    (the coordinator drops duplicates by ``seq``). Seeded control-plane
+    fault injection (``runtime/faults.py``: message drop / dup / delay /
+    reset, one-way partition) is applied here, per frame, worker-side for
+    both directions of the conversation."""
+
+    def __init__(self, wname: str, sock: Optional[socket.socket] = None,
+                 outbox_cap: int = OUTBOX_CAP):
+        self.wname = wname
+        self.sock = sock
+        self.lock = threading.Lock()
+        self.seq = 0
+        #: (seq, enqueue-monotonic, frame bytes) — refreshed at replay so
+        #: the staleness watchdog measures THIS link's silence
+        self.outbox: deque = deque()
+        self.outbox_cap = int(outbox_cap)
+        #: monotonic time of the last frame actually delivered to us —
+        #: the heartbeat watchdog reconnects when it goes stale
+        self.last_rx = time.monotonic()
+        #: session token from the coordinator's hello_ack; presenting it on
+        #: reconnect is what re-adopts our in-flight leases
+        self.token: Optional[str] = None
+        #: the coordinator's advertised lease window (reconnect sizing hint)
+        self.lease_hint: Optional[float] = None
+
+    def send(self, msg: dict, important: bool = False) -> bool:
+        """Frame and send one message. Important frames are sequenced and
+        retained until acked. False = the link is down (important frames
+        stay queued for replay); pickling errors propagate to the caller
+        BEFORE anything is queued."""
+        from .faults import get_injector
+
+        inj = get_injector()
+        with self.lock:
+            if important:
+                self.seq += 1
+                msg = dict(msg, seq=self.seq)
+            data = frame_bytes(msg)
+            if important:
+                self.outbox.append((self.seq, time.monotonic(), data))
+                while len(self.outbox) > self.outbox_cap:
+                    self.outbox.popleft()
+                    get_registry().counter("outbox_dropped").inc()
+            sock = self.sock
+            if sock is None:
+                return False
+            act = None
+            if inj is not None:
+                if inj.partitioned(self.wname, "tx"):
+                    # the wire ate it; important frames await the replay
+                    return True
+                act = inj.net_fault("tx", self.wname, msg.get("type"))
+                if act == "drop":
+                    return True
+                if act == "reset":
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    return False
+                if act == "delay":
+                    time.sleep(inj.config.net_msg_delay_s)
+            try:
+                sock.sendall(data)
+                if act == "dup":
+                    sock.sendall(data)
+                return True
+            except (ConnectionError, OSError):
+                return False
+
+    def on_ack(self, seq: Optional[int]) -> None:
+        """The coordinator acknowledged everything up to ``seq``."""
+        if seq is None:
+            return
+        with self.lock:
+            while self.outbox and self.outbox[0][0] <= seq:
+                self.outbox.popleft()
+
+    def unacked_age(self) -> float:
+        """Seconds the oldest unacked important frame has been waiting on
+        THIS link (0.0 with an empty outbox) — the half-open-link signal."""
+        with self.lock:
+            if not self.outbox:
+                return 0.0
+            return time.monotonic() - self.outbox[0][1]
+
+    def adopt(self, sock: socket.socket, token: Optional[str],
+              resumed: bool) -> None:
+        """Install a freshly handshaken socket. ``resumed=False`` means the
+        coordinator registered us as a NEW session (our old lease is gone,
+        its tasks were requeued): the outbox is cleared — replaying results
+        nobody is waiting for would only be deduped anyway. ``True``
+        replays every unacked frame in order. Raises on replay failure (the
+        caller treats it as a failed reconnect attempt)."""
+        now = time.monotonic()
+        with self.lock:
+            self.token = token
+            if not resumed:
+                self.outbox.clear()
+            # refresh enqueue stamps: the watchdog must measure the NEW
+            # link's progress, not how long the partition lasted
+            self.outbox = deque(
+                (seq, now, data) for seq, _t, data in self.outbox
+            )
+            for _seq, _t, data in self.outbox:
+                sock.sendall(data)
+            self.sock = sock
+        self.last_rx = now
+
+
 def run_worker(
     coordinator: str,
     nthreads: int = 1,
     name: Optional[str] = None,
     drain_grace_s: float = 10.0,
+    reconnect_give_up_s: float = 30.0,
 ) -> None:
     """Connect to ``host:port`` and execute tasks until shutdown/EOF.
 
@@ -953,7 +1505,16 @@ def run_worker(
     the abandoned task ids, and exit. ``SIGTERM`` triggers the same path
     with spot semantics (``drain_grace_s`` models the preemption notice;
     the platform's hard kill at the end of the notice is modelled by a
-    hard-exit timer so a wedged task can't outlive its notice)."""
+    hard-exit timer so a wedged task can't outlive its notice).
+
+    A lost connection is NOT fatal: in-flight tasks keep running, result
+    frames queue in a bounded outbox, and the worker reconnects —
+    presenting its session token so the coordinator re-adopts its leases —
+    replaying unacked frames in order. A half-open link (one-way
+    partition) is detected by the heartbeat watchdog: no frames received
+    for a few seconds, or an important frame unacked past its window,
+    forces the same reconnect path. Only after ``reconnect_give_up_s`` of
+    failed attempts does the worker exit."""
     import cloudpickle
     import signal as _signal
     from concurrent.futures import ThreadPoolExecutor
@@ -971,9 +1532,6 @@ def run_worker(
     from .utils import execute_with_stats
 
     host, _, port = coordinator.rpartition(":")
-    sock = socket.create_connection((host or "127.0.0.1", int(port)))
-    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    send_lock = threading.Lock()
     wname = name or f"{socket.gethostname()}:{os.getpid()}"
     # stamp this process's task stats with the worker name (its trace lane)
     # and adopt any test-injected clock skew before the first heartbeat
@@ -985,16 +1543,99 @@ def run_worker(
     clock_est: Dict[str, Optional[float]] = {
         "offset": None, "rtt": None, "best": None,
     }
-    send_frame(
-        sock,
-        {
-            "type": "hello",
-            "name": wname,
-            "nthreads": nthreads,
-            "pid": os.getpid(),
-        },
-        send_lock,
-    )
+    link = _WorkerLink(wname)
+    #: task ids ever accepted, bounded: a re-delivered assignment (injected
+    #: duplication, or a frame replay) must be executed at most once —
+    #: idempotent task-assignment, worker-side. Cleared whenever the
+    #: coordinator registers us as a NEW session: a fresh coordinator's
+    #: task-id counter restarts at 0, and its ids must not collide with a
+    #: dead session's
+    seen_tasks: OrderedDict[int, bool] = OrderedDict()
+
+    class _RegistrationRejected(ConnectionError):
+        """The coordinator refused our hello (impostor-name rejection):
+        retrying cannot succeed — give up instead of hammering it."""
+
+    def _connect() -> None:
+        """One connection attempt: TCP connect + hello/hello_ack handshake
+        + outbox replay. Raises on any failure — including an active
+        injected partition, which blackholes new connections exactly like
+        a real one."""
+        inj = get_injector()
+        if inj is not None and (
+            inj.partitioned(wname, "tx") or inj.partitioned(wname, "rx")
+        ):
+            raise ConnectionError("injected network partition")
+        s = socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=10
+        )
+        try:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            hello = {
+                "type": "hello",
+                "name": wname,
+                "nthreads": nthreads,
+                "pid": os.getpid(),
+            }
+            if link.token is not None:
+                hello["token"] = link.token
+            send_frame(s, hello)
+            ack = recv_frame(s)
+            if isinstance(ack, dict) and ack.get("type") == "hello_reject":
+                raise _RegistrationRejected(str(ack.get("reason", "")))
+            if not isinstance(ack, dict) or ack.get("type") != "hello_ack":
+                raise ConnectionError(f"bad handshake reply: {ack!r}")
+            s.settimeout(None)
+            link.lease_hint = ack.get("lease_s")
+            resumed = bool(ack.get("resume"))
+            if not resumed:
+                # a NEW session (first registration, or our old lease is
+                # gone — possibly under a brand-new coordinator whose task
+                # ids restart at 0): stale dedup state must not swallow
+                # the new session's assignments
+                seen_tasks.clear()
+            link.adopt(s, ack.get("token"), resumed)
+        except BaseException:
+            try:
+                s.close()
+            except OSError:
+                pass
+            raise
+
+    def _reconnect() -> bool:
+        """Re-establish the coordinator link after a drop, with backoff,
+        for up to ``reconnect_give_up_s``. In-flight tasks keep running
+        throughout; success replays the outbox. False = give up (exit)."""
+        give_up = time.monotonic() + max(0.0, reconnect_give_up_s)
+        delay = 0.05
+        while not stop.is_set() and not drain["on"]:
+            if time.monotonic() > give_up:
+                logger.error(
+                    "worker %s: could not reach the coordinator for %.0fs; "
+                    "giving up", wname, reconnect_give_up_s,
+                )
+                return False
+            try:
+                _connect()
+            except _RegistrationRejected as e:
+                logger.error(
+                    "worker %s: registration rejected (%s); exiting",
+                    wname, e,
+                )
+                return False
+            except (ConnectionError, OSError):
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+                continue
+            get_registry().counter("worker_link_reconnects").inc()
+            logger.warning(
+                "worker %s: reconnected to the coordinator (%d unacked "
+                "frame(s) replayed)", wname, len(link.outbox),
+            )
+            return True
+        return False
+
+    _connect()  # the initial registration failure stays loud: raise
     raw_blobs: Dict[str, bytes] = {}
     #: LRU of decoded (function, config) pairs, bounded so a worker serving
     #: a long-lived coordinator across many plans doesn't pin every op's
@@ -1027,17 +1668,13 @@ def run_worker(
             time.sleep(0.02)
         with inflight_lock:
             abandoned = sorted(inflight)
-        try:
-            send_frame(
-                sock,
-                {"type": "drained", "reason": reason, "abandoned": abandoned},
-                send_lock,
-            )
-        except (ConnectionError, OSError):
-            pass
+        link.send(
+            {"type": "drained", "reason": reason, "abandoned": abandoned},
+            important=True,
+        )
         stop.set()
         try:
-            sock.close()  # unblocks the main recv loop
+            link.sock.close()  # unblocks the main recv loop
         except OSError:
             pass
         if abandoned and sigterm_installed:
@@ -1058,14 +1695,10 @@ def run_worker(
             "worker %s: draining (%s, grace %.3fs, %d in flight)",
             wname, reason, grace_s, len(inflight),
         )
-        try:
-            send_frame(
-                sock,
-                {"type": "draining", "reason": reason, "grace_s": grace_s},
-                send_lock,
-            )
-        except (ConnectionError, OSError):
-            stop.set()
+        link.send(
+            {"type": "draining", "reason": reason, "grace_s": grace_s},
+            important=True,
+        )
         if reason == "preempted" and sigterm_installed:
             # spot semantics: the platform hard-kills at the end of the
             # notice window regardless of progress — model it so a wedged
@@ -1083,7 +1716,7 @@ def run_worker(
     def _on_sigterm(signum, frame):
         # the spot preemption notice: drain inside the window, then die.
         # Hand off to a thread — the handler interrupts the main thread
-        # mid-anything, and _begin_drain takes send_lock/inflight_lock,
+        # mid-anything, and _begin_drain takes the link lock/inflight_lock,
         # which the interrupted frame may be holding (a non-reentrant
         # lock acquired from the handler would self-deadlock)
         threading.Thread(
@@ -1111,13 +1744,9 @@ def run_worker(
         if rejected:
             # raced the drain start: hand the task back unexecuted so the
             # coordinator requeues it free instead of waiting for a timeout
-            try:
-                send_frame(
-                    sock, {"type": "abandoned", "task_id": task_id},
-                    send_lock,
-                )
-            except (ConnectionError, OSError):
-                stop.set()
+            link.send(
+                {"type": "abandoned", "task_id": task_id}, important=True
+            )
             return
         try:
             _run_task_inner(msg)
@@ -1205,14 +1834,7 @@ def run_worker(
             if missing:
                 dropped.append(blob_id)
             for gone in dropped:
-                try:
-                    send_frame(
-                        sock, {"type": "blob_dropped", "blob_id": gone},
-                        send_lock,
-                    )
-                except (ConnectionError, OSError):
-                    stop.set()
-                    return
+                link.send({"type": "blob_dropped", "blob_id": gone})
             if missing:
                 raise RuntimeError(
                     f"unknown blob {blob_id!r} (evicted or never sent); "
@@ -1221,17 +1843,11 @@ def run_worker(
                 )
             function, config = pair
             if msg.get("ack"):
-                try:
-                    # ack actual execution start (post decode): the
-                    # coordinator restarts this task's timeout clock,
-                    # separating cold-start/queueing delay from a real hang
-                    send_frame(
-                        sock, {"type": "started", "task_id": task_id},
-                        send_lock,
-                    )
-                except (ConnectionError, OSError):
-                    stop.set()
-                    return
+                # ack actual execution start (post decode): the coordinator
+                # restarts this task's timeout clock, separating cold-start
+                # delay from a real hang. Not outbox-retained — a stale
+                # started ack is useless after a reconnect
+                link.send({"type": "started", "task_id": task_id})
             if config is not None:
                 result, stats = execute_with_stats(
                     function, msg["input"], config=config
@@ -1239,21 +1855,23 @@ def run_worker(
             else:
                 result, stats = execute_with_stats(function, msg["input"])
             try:
-                send_frame(
-                    sock,
+                # important: retained in the outbox and replayed across a
+                # reconnect, so a partition between finishing the task and
+                # delivering its result costs nothing
+                link.send(
                     {"type": "result", "task_id": task_id, "result": result,
                      "stats": stats},
-                    send_lock,
+                    important=True,
                 )
-            except (ConnectionError, OSError):
-                stop.set()
             except Exception:
                 # unpicklable result (TypeError, PicklingError, ...): the
                 # value lives in the shared store anyway (tasks communicate
                 # through Zarr) — the task SUCCEEDED, so report completion.
                 # Loud, not silent: this is only safe while pipeline task
                 # RESULTS are never consumed; a future value-returning
-                # pipeline must not quietly receive None
+                # pipeline must not quietly receive None. (link.send frames
+                # BEFORE queueing, so the bad payload never reaches the
+                # outbox.)
                 logger.warning(
                     "task %s: result of type %s is not picklable; "
                     "reporting completion with result=None (safe only "
@@ -1261,16 +1879,14 @@ def run_worker(
                     "not the return value)",
                     task_id, type(result).__name__,
                 )
-                send_frame(
-                    sock,
+                link.send(
                     {"type": "result", "task_id": task_id, "result": None,
                      "stats": stats},
-                    send_lock,
+                    important=True,
                 )
         except Exception as e:
             try:
-                send_frame(
-                    sock,
+                link.send(
                     {"type": "error", "task_id": task_id,
                      "error": traceback.format_exc(),
                      # root class name rides along so the coordinator-side
@@ -1285,10 +1901,17 @@ def run_worker(
                      "task_stats": getattr(
                          e, "cubed_tpu_task_stats", None
                      )},
-                    send_lock,
+                    important=True,
                 )
-            except (ConnectionError, OSError):
-                stop.set()
+            except Exception:
+                # the traceback/payload itself failed to pickle: ship a
+                # minimal but well-formed error frame instead of silence
+                link.send(
+                    {"type": "error", "task_id": task_id,
+                     "error": f"{type(e).__name__}: {e}",
+                     "error_type": type(e).__name__},
+                    important=True,
+                )
         finally:
             obs_logs.compute_id_var.reset(cid_token)
 
@@ -1300,7 +1923,16 @@ def run_worker(
         — exists before the first task completes: even a sub-second compute
         gets aligned worker spans. The coordinator only ever *reads* these;
         a worker that never heartbeats (older build) simply stays eligible
-        for dispatch."""
+        for dispatch.
+
+        Doubles as the **stale-link watchdog**: a healthy link echoes every
+        heartbeat within ~RTT and acks important frames promptly, so
+        receiving NOTHING for a few periods — or an important frame going
+        unacked past its window — means the link is half-open (a one-way
+        partition, a silently dead TCP stream). The watchdog then closes
+        the socket, forcing the main recv loop into its reconnect path;
+        against a healthy coordinator a spurious reconnect is cheap and
+        harmless (the session token re-adopts the lease)."""
         while True:
             rss = current_measured_mem()
             hb = {
@@ -1314,10 +1946,31 @@ def run_worker(
             if clock_est["offset"] is not None:
                 hb["clock_offset"] = clock_est["offset"]
                 hb["clock_rtt"] = clock_est["rtt"]
-            try:
-                send_frame(sock, hb, send_lock)
-            except (ConnectionError, OSError):
-                return
+            link.send(hb)  # link failures heal via the recv loop's reconnect
+            if (
+                not stop.is_set()
+                and not drain["on"]
+                and (
+                    link.unacked_age() > ACK_STALE_S
+                    or time.monotonic() - link.last_rx > RX_STALE_S
+                )
+            ):
+                logger.warning(
+                    "worker %s: link looks half-open (last rx %.1fs ago, "
+                    "oldest unacked %.1fs); forcing a reconnect",
+                    wname, time.monotonic() - link.last_rx,
+                    link.unacked_age(),
+                )
+                with link.lock:
+                    s = link.sock
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
             if stop.wait(1.0):
                 return
 
@@ -1326,77 +1979,138 @@ def run_worker(
     ).start()
 
     pool = ThreadPoolExecutor(max_workers=max(nthreads, 1))
+    SEEN_TASKS_CAP = 4096
+
+    def _handle(msg: dict) -> bool:
+        """Process one delivered frame; False = leave the recv loop."""
+        mtype = msg.get("type")
+        if mtype == "task":
+            task_id = msg.get("task_id")
+            if task_id in seen_tasks:
+                get_registry().counter("fleet_assignments_deduped").inc()
+                return True
+            seen_tasks[task_id] = True
+            while len(seen_tasks) > SEEN_TASKS_CAP:
+                seen_tasks.popitem(last=False)
+            if msg.get("blob") is not None:
+                raw_blobs[msg["blob_id"]] = msg["blob"]
+            pool.submit(run_task, msg)
+        elif mtype == "ack":
+            link.on_ack(msg.get("seq"))
+        elif mtype == "hello_ack":
+            pass  # handshake frames are consumed in _connect; a stray
+            # duplicate (injected) carries nothing new
+        elif mtype == "drain":
+            # graceful scale-down (or an operator-initiated drain):
+            # same path as the SIGTERM handler, reason carried over
+            # (grace_s=0.0 is a legitimate "abandon immediately" —
+            # only an ABSENT grace falls back to the default)
+            g = msg.get("grace_s")
+            _begin_drain(
+                msg.get("reason") or "scale_down",
+                float(drain["grace"] if g is None else g),
+            )
+        elif mtype == "heartbeat_echo":
+            # NTP-style: the coordinator echoed our t0 with its own
+            # clock; offset = t_coord - midpoint(t0, t1), accurate
+            # to ~rtt/2. Accept a sample when its rtt is comparable
+            # to the BEST rtt ever seen (a fixed anchor — never
+            # ratcheted by accepted samples — with a 1ms epsilon so
+            # coarse clocks reporting rtt=0 still refresh), so slow
+            # clock drift heals without estimate quality degrading
+            # under rising load. Ship it back immediately — the
+            # next task's spans may be exported before the next
+            # 1s heartbeat
+            t1 = obs_clock.now()
+            t0, tc = msg.get("t0"), msg.get("t_coord")
+            if t0 is not None and tc is not None:
+                rtt = max(0.0, t1 - t0)
+                best = clock_est.get("best")
+                if best is None or rtt < best:
+                    best = rtt
+                clock_est["best"] = best
+                if (
+                    clock_est["rtt"] is None
+                    or rtt <= best * 1.5 + 1e-3
+                ):
+                    clock_est["offset"] = tc - (t0 + t1) / 2
+                    clock_est["rtt"] = rtt
+                    link.send({
+                        "type": "clock",
+                        "clock_offset": clock_est["offset"],
+                        "clock_rtt": rtt,
+                    })
+        elif mtype == "shutdown":
+            return False
+        else:
+            logger.warning("worker: unknown message %r", mtype)
+        return True
+
     try:
-        try:
-            while not stop.is_set():
-                msg = recv_frame(sock)
-                mtype = msg.get("type")
-                if mtype == "task":
-                    if msg.get("blob") is not None:
-                        raw_blobs[msg["blob_id"]] = msg["blob"]
-                    pool.submit(run_task, msg)
-                elif mtype == "drain":
-                    # graceful scale-down (or an operator-initiated drain):
-                    # same path as the SIGTERM handler, reason carried over
-                    # (grace_s=0.0 is a legitimate "abandon immediately" —
-                    # only an ABSENT grace falls back to the default)
-                    g = msg.get("grace_s")
-                    _begin_drain(
-                        msg.get("reason") or "scale_down",
-                        float(drain["grace"] if g is None else g),
-                    )
-                elif mtype == "heartbeat_echo":
-                    # NTP-style: the coordinator echoed our t0 with its own
-                    # clock; offset = t_coord - midpoint(t0, t1), accurate
-                    # to ~rtt/2. Accept a sample when its rtt is comparable
-                    # to the BEST rtt ever seen (a fixed anchor — never
-                    # ratcheted by accepted samples — with a 1ms epsilon so
-                    # coarse clocks reporting rtt=0 still refresh), so slow
-                    # clock drift heals without estimate quality degrading
-                    # under rising load. Ship it back immediately — the
-                    # next task's spans may be exported before the next
-                    # 1s heartbeat
-                    t1 = obs_clock.now()
-                    t0, tc = msg.get("t0"), msg.get("t_coord")
-                    if t0 is not None and tc is not None:
-                        rtt = max(0.0, t1 - t0)
-                        best = clock_est.get("best")
-                        if best is None or rtt < best:
-                            best = rtt
-                        clock_est["best"] = best
-                        if (
-                            clock_est["rtt"] is None
-                            or rtt <= best * 1.5 + 1e-3
-                        ):
-                            clock_est["offset"] = tc - (t0 + t1) / 2
-                            clock_est["rtt"] = rtt
-                            try:
-                                send_frame(
-                                    sock,
-                                    {
-                                        "type": "clock",
-                                        "clock_offset": clock_est["offset"],
-                                        "clock_rtt": rtt,
-                                    },
-                                    send_lock,
-                                )
-                            except (ConnectionError, OSError):
-                                break
-                elif mtype == "shutdown":
+        while not stop.is_set():
+            try:
+                msg = recv_frame(link.sock)
+            except CorruptFrameError as e:
+                # a torn/garbage frame: the stream is useless from here —
+                # count it, drop the connection, reconnect with a clean one
+                get_registry().counter("frames_corrupt").inc()
+                logger.warning(
+                    "worker %s: corrupt frame from coordinator (%s); "
+                    "reconnecting", wname, e,
+                )
+                try:
+                    link.sock.close()
+                except OSError:
+                    pass
+                if stop.is_set() or drain["on"] or not _reconnect():
                     break
-                else:
-                    logger.warning("worker: unknown message %r", mtype)
-        except (ConnectionError, OSError):
-            pass  # coordinator gone (or our drain closed the socket): exit
+                continue
+            except (ConnectionError, OSError):
+                if stop.is_set() or drain["on"]:
+                    break  # shutdown or our own drain closed the socket
+                if not _reconnect():
+                    break  # coordinator unreachable past the give-up window
+                continue
+            if not isinstance(msg, dict):
+                logger.warning(
+                    "worker %s: non-dict frame %r ignored", wname,
+                    type(msg).__name__,
+                )
+                continue
+            inj = get_injector()
+            if inj is not None and inj.partitioned(wname, "rx"):
+                # one-way partition, coordinator→worker leg: the frame was
+                # never delivered — last_rx must NOT refresh, so the
+                # watchdog sees the silence a real partition would cause
+                continue
+            link.last_rx = time.monotonic()
+            if inj is not None:
+                act = inj.net_fault("rx", wname, msg.get("type"))
+                if act == "drop":
+                    continue
+                if act == "delay":
+                    time.sleep(inj.config.net_msg_delay_s)
+                if act == "reset":
+                    try:
+                        link.sock.close()
+                    except OSError:
+                        pass
+                    continue  # the next recv notices and reconnects
+                if act == "dup":
+                    if not _handle(dict(msg)):
+                        break
+            if not _handle(msg):
+                break
     finally:
         # every exit from the recv loop — shutdown frame, coordinator
-        # gone, or our own drain — means the coordinator has already
-        # failed this worker's outstanding futures, so queued tasks
-        # produce results nobody can receive: cancel them instead of
-        # running them out
+        # unreachable past the reconnect window, or our own drain — means
+        # this worker's outstanding futures are (or will be) failed or
+        # requeued coordinator-side, so queued tasks produce results
+        # nobody can receive: cancel them instead of running them out
         pool.shutdown(wait=False, cancel_futures=True)
+    stop.set()  # silence the heartbeat/watchdog thread
     try:
-        sock.close()
+        link.sock.close()
     except OSError:
         pass
     if sigterm_installed:

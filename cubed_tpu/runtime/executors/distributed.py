@@ -107,6 +107,7 @@ class DistributedDagExecutor(DagExecutor):
         worker_start_timeout: float = 60.0,
         task_timeout: Optional[float] = None,
         timeout_strikes: int = 2,
+        lease_s: float = 15.0,
         retries: int = DEFAULT_RETRIES,
         use_backups: bool = True,
         batch_size: Optional[int] = None,
@@ -145,6 +146,9 @@ class DistributedDagExecutor(DagExecutor):
         self.worker_start_timeout = worker_start_timeout
         self.task_timeout = task_timeout
         self.timeout_strikes = timeout_strikes
+        #: how long a disconnected worker keeps its in-flight tasks before
+        #: they requeue as worker loss (runtime/distributed.py leases)
+        self.lease_s = lease_s
         self.retries = retries
         self.use_backups = use_backups
         self.batch_size = batch_size
@@ -194,14 +198,16 @@ class DistributedDagExecutor(DagExecutor):
             host, _, port = self.listen.rpartition(":")
             coord = Coordinator(host or "0.0.0.0", int(port or 0),
                                 task_timeout=self.task_timeout,
-                                timeout_strikes=self.timeout_strikes)
+                                timeout_strikes=self.timeout_strikes,
+                                lease_s=self.lease_s)
             logger.info(
                 "coordinator listening on %s:%s; waiting for %d workers",
                 coord.address[0], coord.address[1], self.min_workers,
             )
         else:
             coord = Coordinator("127.0.0.1", 0, task_timeout=self.task_timeout,
-                                timeout_strikes=self.timeout_strikes)
+                                timeout_strikes=self.timeout_strikes,
+                                lease_s=self.lease_s)
         self._coordinator = coord
         initial_names: list = []
         if self.n_local_workers:
@@ -382,6 +388,21 @@ class DistributedDagExecutor(DagExecutor):
 
     # -- execution -----------------------------------------------------
 
+    def resume_compute(self, array, journal: str, **kwargs):
+        """Continue a compute whose client/coordinator process crashed.
+
+        Rebuild the SAME plan (same code ⇒ same deterministic op names),
+        then call this with the journal file the crashed run was writing
+        (``Spec(journal=...)``): coordinator-side progress is rebuilt from
+        the journal's completed-task frontier intersected with the
+        chunk-integrity resume scan, and only the remainder re-runs —
+        bitwise-identical to an uninterrupted run. Returns the computed
+        numpy array. Equivalent to
+        ``array.compute(executor=self, resume_from_journal=journal)``."""
+        return array.compute(
+            executor=self, resume_from_journal=str(journal), **kwargs
+        )
+
     def execute_dag(
         self,
         dag,
@@ -394,6 +415,7 @@ class DistributedDagExecutor(DagExecutor):
         batch_size: Optional[int] = None,
         compute_arrays_in_parallel: Optional[bool] = None,
         retry_policy: Optional[RetryPolicy] = None,
+        journal=None,
         **kwargs,
     ) -> None:
         retries = self.retries if retries is None else retries
@@ -429,7 +451,9 @@ class DistributedDagExecutor(DagExecutor):
                 "so the fleet is populated before computing"
             )
 
-        state = ResumeState(quarantine=True) if resume else None
+        state = (
+            ResumeState(quarantine=True, journal=journal) if resume else None
+        )
         # integrity failures cross the wire as RemoteTaskError carrying the
         # corrupt chunk's (store, key); the repair task runs client-side
         # against the shared store the whole fleet reads

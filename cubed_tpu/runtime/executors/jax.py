@@ -365,6 +365,7 @@ class JaxExecutor(DagExecutor):
         array_names=None,
         resume=None,
         spec=None,
+        journal=None,
         **kwargs,
     ) -> None:
         jax = _jax()
@@ -441,8 +442,12 @@ class JaxExecutor(DagExecutor):
         # resume is op-granular here (segments run as whole-array device
         # programs, so per-task skip doesn't apply), but the skip decision
         # is still checksum-verified: a corrupt persisted output re-runs
-        # (and is quarantined by the scan) instead of being trusted
-        resume_state = ResumeState(quarantine=True) if resume else None
+        # (and is quarantined by the scan) instead of being trusted; a
+        # loaded compute journal (resume_from_journal) further requires an
+        # op to be journaled fully complete before it may skip
+        resume_state = (
+            ResumeState(quarantine=True, journal=journal) if resume else None
+        )
         for name, node in visit_nodes(dag, resume=resume, state=resume_state):
             primitive_op = node["primitive_op"]
             kind = self._classify(primitive_op) if self.fuse_plan else "eager"

@@ -215,6 +215,7 @@ class MultiprocessDagExecutor(DagExecutor):
         batch_size: Optional[int] = None,
         compute_arrays_in_parallel: Optional[bool] = None,
         retry_policy: Optional[RetryPolicy] = None,
+        journal=None,
         **kwargs,
     ) -> None:
         retries = self.retries if retries is None else retries
@@ -227,7 +228,9 @@ class MultiprocessDagExecutor(DagExecutor):
         # shared per compute: an OOM-killed worker steps task admission
         # down for every later op, not just the one that crashed
         admission = AdmissionController()
-        state = ResumeState(quarantine=True) if resume else None
+        state = (
+            ResumeState(quarantine=True, journal=journal) if resume else None
+        )
         # integrity failures detected worker-side arrive pickled; the repair
         # (re-running the producing task) runs client-side against the
         # shared store, which is valid for any executor

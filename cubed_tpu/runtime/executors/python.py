@@ -64,6 +64,7 @@ class PythonDagExecutor(DagExecutor):
         spec=None,
         retries: Optional[int] = None,
         retry_policy: Optional[RetryPolicy] = None,
+        journal=None,
         **kwargs,
     ) -> None:
         retries = self.retries if retries is None else retries
@@ -79,7 +80,9 @@ class PythonDagExecutor(DagExecutor):
                 "keeps op-level ordering by design"
             )
         metrics = get_registry()
-        state = ResumeState(quarantine=True) if resume else None
+        state = (
+            ResumeState(quarantine=True, journal=journal) if resume else None
+        )
         resolver = RecomputeResolver(dag)
         for name, node in visit_nodes(dag, resume=resume, state=state):
             primitive_op = node["primitive_op"]

@@ -664,6 +664,7 @@ class AsyncPythonDagExecutor(DagExecutor):
         batch_size: Optional[int] = None,
         compute_arrays_in_parallel: Optional[bool] = None,
         retry_policy: Optional[RetryPolicy] = None,
+        journal=None,
         **kwargs,
     ) -> None:
         retries = self.retries if retries is None else retries
@@ -679,8 +680,12 @@ class AsyncPythonDagExecutor(DagExecutor):
         admission = AdmissionController()
         # chunk-granular resume: one checksum-verified scan per store, shared
         # by the op-level and task-level skips; corrupt chunks found by the
-        # scan are quarantined so their tasks re-run
-        state = ResumeState(quarantine=True) if resume else None
+        # scan are quarantined so their tasks re-run. A loaded compute
+        # journal (resume_from_journal) narrows the skip set to its
+        # completed-task frontier ∩ the integrity scan
+        state = (
+            ResumeState(quarantine=True, journal=journal) if resume else None
+        )
         resolver = RecomputeResolver(dag)
         scheduler = resolve_scheduler(spec)
         record_scheduler_mode(scheduler, executor=self.name)

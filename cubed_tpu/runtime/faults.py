@@ -48,6 +48,15 @@ Injection sites (each counted in the metrics registry under
 - the distributed worker loop (``runtime/distributed.run_worker``) — a
   named worker hard-exits (``os._exit``) or hangs after its nth task,
   modelling OOM-kills and wedged hosts.
+- the control plane's framing layer (``runtime/distributed._WorkerLink``) —
+  seeded per-frame message drop / duplication / delay / connection reset
+  on the worker's side of the coordinator socket (worker tx covers
+  worker→coordinator traffic, worker rx covers coordinator→worker), plus a
+  timed **one-way partition** of a named worker: once its executed-task
+  count reaches ``partition_after_tasks``, frames in
+  ``partition_direction`` vanish for ``partition_duration_s`` — including
+  reconnect attempts, which a real partition also blackholes. This is what
+  the reconnect handshake / lease machinery is chaos-tested against.
 """
 
 from __future__ import annotations
@@ -121,6 +130,29 @@ class FaultConfig:
     worker_preempt_rate: float = 0.0
     worker_preempt_after_tasks: int = 2
     preempt_notice_s: float = 1.0
+    #: control-plane message faults, decided per frame at the worker's
+    #: framing layer ("tx" = worker→coordinator, "rx" = coordinator→worker):
+    #: a dropped frame silently vanishes (the reconnect/outbox/lease
+    #: machinery must absorb it), a duplicated one is delivered twice (the
+    #: seq/task-id dedup must ignore the copy), a delayed one sleeps
+    #: net_msg_delay_s in the framing path, and a reset closes the socket
+    #: mid-conversation (the worker must reconnect and replay)
+    net_msg_drop_rate: float = 0.0
+    net_msg_dup_rate: float = 0.0
+    net_msg_delay_rate: float = 0.0
+    net_msg_delay_s: float = 0.05
+    net_reset_rate: float = 0.0
+    #: one-way partition of named fleet workers: once such a worker's
+    #: executed-task count reaches partition_after_tasks (>=1), frames in
+    #: partition_direction ("tx" | "rx" | "both") stop being delivered for
+    #: partition_duration_s — reconnect attempts included, exactly like a
+    #: real network partition. In-flight tasks keep running; the protocol
+    #: must carry their results across the gap (outbox replay) while the
+    #: coordinator's lease keeps ownership from being requeued
+    partition_worker_names: tuple = field(default_factory=tuple)
+    partition_after_tasks: int = 0
+    partition_duration_s: float = 2.0
+    partition_direction: str = "tx"
 
     @classmethod
     def from_dict(cls, d: dict) -> "FaultConfig":
@@ -132,7 +164,10 @@ class FaultConfig:
                 f"known: {sorted(known)}"
             )
         d = dict(d)
-        for k in ("worker_crash_names", "worker_hang_names"):
+        for k in (
+            "worker_crash_names", "worker_hang_names",
+            "partition_worker_names",
+        ):
             if k in d:
                 d[k] = tuple(d[k])
         return cls(**d)
@@ -156,6 +191,11 @@ class FaultConfig:
             or (self.worker_crash_names and self.worker_crash_after_tasks)
             or (self.worker_hang_names and self.worker_hang_after_tasks)
             or (self.worker_preempt_rate and self.worker_preempt_after_tasks)
+            or self.net_msg_drop_rate
+            or self.net_msg_dup_rate
+            or self.net_msg_delay_rate
+            or self.net_reset_rate
+            or (self.partition_worker_names and self.partition_after_tasks)
         )
 
 
@@ -168,6 +208,9 @@ class FaultInjector:
         #: (site, key) -> occurrence count; the count is part of the hash
         #: input, so a retry of the same operation rolls a fresh decision
         self._counts: dict = {}
+        #: worker name -> monotonic deadline of its active one-way
+        #: partition (armed by worker_task_tick, consulted per frame)
+        self._partition_until: dict = {}
 
     # -- the decision function ------------------------------------------
 
@@ -260,6 +303,53 @@ class FaultInjector:
             return int(cfg.task_mem_spike_bytes)
         return 0
 
+    # -- control plane (coordinator <-> worker framing) -----------------
+
+    def net_fault(self, direction: str, worker_name: str,
+                  msg_type: Optional[str]) -> Optional[str]:
+        """One seeded decision for a control-plane frame: ``"drop"``,
+        ``"reset"``, ``"dup"``, ``"delay"``, or None (deliver faithfully).
+        ``direction`` is the worker's view ("tx" = worker→coordinator).
+        At most one fault per frame, evaluated in severity order."""
+        cfg = self.config
+        if not (
+            cfg.net_msg_drop_rate
+            or cfg.net_msg_dup_rate
+            or cfg.net_msg_delay_rate
+            or cfg.net_reset_rate
+        ):
+            return None
+        key = f"{worker_name}:{direction}:{msg_type}"
+        if self._hit(f"net_{direction}_drop", key, cfg.net_msg_drop_rate):
+            return "drop"
+        if self._hit(f"net_{direction}_reset", key, cfg.net_reset_rate):
+            return "reset"
+        if self._hit(f"net_{direction}_dup", key, cfg.net_msg_dup_rate):
+            return "dup"
+        if self._hit(f"net_{direction}_delay", key, cfg.net_msg_delay_rate):
+            return "delay"
+        return None
+
+    def partitioned(self, worker_name: str, direction: str) -> bool:
+        """True while ``worker_name`` is inside its injected one-way
+        partition window for frames flowing in ``direction``. A reconnect
+        attempt must check both directions — a real partition blackholes
+        the TCP handshake too."""
+        cfg = self.config
+        if not (cfg.partition_worker_names and cfg.partition_after_tasks):
+            return False
+        if worker_name not in cfg.partition_worker_names:
+            return False
+        with self._lock:
+            until = self._partition_until.get(worker_name)
+        if until is None:
+            return False
+        import time
+
+        if time.monotonic() >= until:
+            return False
+        return cfg.partition_direction in ("both", direction)
+
     # -- distributed workers --------------------------------------------
 
     def worker_task_tick(self, worker_name: str) -> Optional[str]:
@@ -275,11 +365,29 @@ class FaultInjector:
             (cfg.worker_crash_names and cfg.worker_crash_after_tasks)
             or (cfg.worker_hang_names and cfg.worker_hang_after_tasks)
             or (cfg.worker_preempt_rate and cfg.worker_preempt_after_tasks)
+            or (cfg.partition_worker_names and cfg.partition_after_tasks)
         ):
             return None
         with self._lock:
             n = self._counts.get(("worker_tick", worker_name), 0) + 1
             self._counts[("worker_tick", worker_name)] = n
+        if (
+            cfg.partition_worker_names
+            and worker_name in cfg.partition_worker_names
+            and n == cfg.partition_after_tasks
+        ):
+            # arm the one-way partition window; the task itself proceeds —
+            # the point is that work completed DURING the partition must
+            # reach the coordinator afterwards via the reconnect/replay path
+            import time
+
+            with self._lock:
+                self._partition_until[worker_name] = (
+                    time.monotonic() + cfg.partition_duration_s
+                )
+            reg = get_registry()
+            reg.counter("faults_injected").inc()
+            reg.counter("faults_injected_partition").inc()
         if (
             worker_name in cfg.worker_crash_names
             and n == cfg.worker_crash_after_tasks

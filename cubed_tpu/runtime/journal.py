@@ -1,0 +1,252 @@
+"""Durable compute journal: coordinator state that survives a client crash.
+
+The coordinator (the client process driving ``Plan.execute``) was the last
+stateful, non-durable, single point of failure in the system: workers are
+stateless, every task is an idempotent whole-chunk write, and chunk-granular
+resume (PR 3) can rebuild progress from the store — but which *compute* was
+running, how far it had gotten, and why the scheduler did what it did all
+died with the client process. This module journals exactly that:
+
+- an **append-only JSONL file beside the Zarr store** (``Spec(journal=
+  "/path/to/file.jsonl")``), one record per line, written by a
+  :class:`JournalCallback` riding the ordinary compute-lifecycle events so
+  every executor journals identically;
+- **fsync'd completion records** — a ``complete`` line is durable before
+  anything depends on it (dispatch/decision lines are forensic and flushed
+  but not individually fsynced);
+- the **same torn-line-tolerant loader discipline as the integrity
+  manifests** (``storage/integrity.py``): a crash mid-append tears at most
+  the final line, which :func:`load_journal` skips without poisoning
+  earlier records — corrupt journal data can cost recomputation, never
+  correctness;
+- the **decision ring**: every ``record_decision`` entry made while the
+  journal is open (retries, requeues, disconnects, lease expiries, scale
+  events) is mirrored into the file, so a post-crash journal doubles as a
+  flight-recorder timeline for a compute whose ``on_compute_end`` never
+  fired.
+
+**Crash recovery.** After the client process is killed mid-compute, rebuild
+the same plan (same code ⇒ same deterministic op names) and resume it:
+
+.. code-block:: python
+
+    spec = cubed_tpu.Spec(work_dir=..., journal="/data/c.journal.jsonl")
+    ...build the identical arrays...
+    executor.resume_compute(result_array, "/data/c.journal.jsonl")
+    # equivalently: result_array.compute(executor=..., resume_from_journal=...)
+
+Resume runs from the intersection of two frontiers: a task is skipped only
+when **the chunk-integrity resume scan verifies every output chunk** AND
+**the journal recorded the task complete** — the journal narrows the skip
+set (e.g. a multi-output task that wrote one side before dying re-runs),
+it never widens it, so the result is bitwise-identical to an uninterrupted
+run. Both re-executions and repeated crashes append to the same file; the
+loader folds every run's completions.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from ..observability.metrics import get_registry
+from .types import Callback
+
+logger = logging.getLogger(__name__)
+
+JOURNAL_VERSION = 1
+
+
+class ComputeJournal:
+    """Append-only JSONL writer with fsync'd load-bearing records.
+
+    Thread-safe (task-end events arrive from the completion loop while
+    decision-ring mirrors arrive from arbitrary threads). ``append`` after
+    ``close`` is a silent no-op — a late decision must not resurrect the
+    file handle."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._f = open(self.path, "ab")
+        self._lock = threading.Lock()
+
+    def append(self, kind: str, fsync: bool = True, **fields) -> None:
+        record = {"kind": kind, "t": time.time()}
+        record.update(fields)
+        try:
+            line = (json.dumps(record, default=str) + "\n").encode()
+        except (TypeError, ValueError):
+            logger.warning("unserializable journal record dropped: %r", kind)
+            return
+        with self._lock:
+            if self._f is None:
+                return
+            try:
+                self._f.write(line)
+                self._f.flush()
+                if fsync:
+                    os.fsync(self._f.fileno())
+            except OSError as e:
+                # journaling is additive: a full disk degrades resume
+                # granularity, it must never fail the compute itself
+                logger.warning("journal append failed (%s): %s", kind, e)
+                return
+        get_registry().counter("journal_appends").inc()
+
+    def close(self) -> None:
+        with self._lock:
+            f, self._f = self._f, None
+        if f is not None:
+            try:
+                f.flush()
+                os.fsync(f.fileno())
+            except OSError:
+                pass
+            try:
+                f.close()
+            except OSError:
+                pass
+
+
+class JournalCallback(Callback):
+    """Journals a compute's lifecycle through the ordinary callback events.
+
+    ``compute_start`` records the plan shape (per-op task counts — what
+    resume validates against), ``dispatch``/``complete`` record per-task
+    progress keyed by ``(op, chunk_key)``, ``decision`` mirrors the
+    decision ring, and ``compute_end`` seals the run. Attached by
+    ``Plan.execute`` when ``Spec(journal=...)`` names a path."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._journal: Optional[ComputeJournal] = None
+        self._sink_registered = False
+
+    def on_compute_start(self, event) -> None:
+        from ..observability.collect import add_decision_sink
+        from .pipeline import iter_op_nodes
+
+        self._journal = ComputeJournal(self.path)
+        ops = {
+            name: d["primitive_op"].num_tasks
+            for name, d in iter_op_nodes(event.dag)
+        }
+        self._journal.append(
+            "compute_start",
+            version=JOURNAL_VERSION,
+            compute_id=getattr(event, "compute_id", None),
+            resume=bool(getattr(event, "resume", None)),
+            tasks_total=sum(ops.values()),
+            ops=ops,
+        )
+        add_decision_sink(self._on_decision)
+        self._sink_registered = True
+        logger.info("journaling compute to %s", self.path)
+
+    def _on_decision(self, entry: dict) -> None:
+        j = self._journal
+        if j is not None:
+            fields = dict(entry)
+            # the ring's "kind" (retry/requeue/lease_expired/...) moves to
+            # "decision" — "kind" is the journal's own record discriminator
+            fields["decision"] = fields.pop("kind", None)
+            j.append("decision", fsync=False, **fields)
+
+    def on_task_start(self, event) -> None:
+        j = self._journal
+        if j is not None:
+            j.append(
+                "dispatch", fsync=False, op=event.array_name,
+                key=event.chunk_key, attempt=event.attempt,
+            )
+
+    def on_task_end(self, event) -> None:
+        j = self._journal
+        if j is not None:
+            # the load-bearing record: fsync'd, so a completion the resume
+            # frontier will skip is durable before the client can crash
+            j.append("complete", op=event.array_name, key=event.chunk_key)
+
+    def on_compute_end(self, event) -> None:
+        from ..observability.collect import remove_decision_sink
+
+        if self._sink_registered:
+            remove_decision_sink(self._on_decision)
+            self._sink_registered = False
+        j = self._journal
+        if j is not None:
+            err = getattr(event, "error", None)
+            j.append(
+                "compute_end",
+                status="failed" if err is not None else "completed",
+                error=(f"{type(err).__name__}: {err}" if err is not None
+                       else None),
+            )
+            j.close()
+            self._journal = None
+
+
+def load_journal(path: str) -> dict:
+    """Fold a journal file into a resume frontier.
+
+    Returns ``{"path", "meta" (the latest compute_start record),
+    "completed" (set of (op, chunk_key)), "decisions" (list), "complete"
+    (True when the latest run sealed with status=completed), "dispatches",
+    "bad_lines"}``. Same tolerance discipline as the manifest loader: any
+    torn/garbage line is skipped and only costs its own record — a lost
+    ``complete`` line means one task re-runs, never a wrong result.
+    """
+    with open(path, "rb") as f:
+        raw = f.read()
+    meta: dict = {}
+    completed: set = set()
+    decisions: list = []
+    complete = False
+    dispatches = 0
+    bad_lines = 0
+    for line in raw.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+            if not isinstance(doc, dict):
+                raise ValueError("not an object")
+        except (ValueError, UnicodeDecodeError):
+            bad_lines += 1
+            continue
+        kind = doc.get("kind")
+        if kind == "compute_start":
+            meta = doc
+            complete = False  # a new run opened; the previous seal is moot
+        elif kind == "complete":
+            op, key = doc.get("op"), doc.get("key")
+            if isinstance(op, str) and isinstance(key, str):
+                completed.add((op, key))
+        elif kind == "dispatch":
+            dispatches += 1
+        elif kind == "decision":
+            decisions.append(doc)
+        elif kind == "compute_end":
+            complete = doc.get("status") == "completed"
+    if bad_lines:
+        logger.warning(
+            "journal %s: skipped %d undecodable line(s) (their tasks will "
+            "re-run)", path, bad_lines,
+        )
+    return {
+        "path": str(path),
+        "meta": meta,
+        "completed": completed,
+        "decisions": decisions,
+        "complete": complete,
+        "dispatches": dispatches,
+        "bad_lines": bad_lines,
+    }

@@ -36,7 +36,9 @@ class ResumeState:
     documented pre-integrity resume behavior.
     """
 
-    def __init__(self, quarantine: bool = False, count: bool = True):
+    def __init__(
+        self, quarantine: bool = False, count: bool = True, journal=None,
+    ):
         from ..storage import integrity
 
         self.quarantine = quarantine
@@ -47,6 +49,30 @@ class ResumeState:
         #: store str -> set of valid chunk keys, or None when the target
         #: is unreadable/uncreated (nothing trustworthy: run everything)
         self._valid: dict = {}
+        #: coordinator-crash recovery (runtime/journal.load_journal): when
+        #: set, the skip frontier is journal ∩ integrity — a task must BOTH
+        #: verify on disk AND be journaled complete to be skipped, so the
+        #: journal only ever narrows resume, never widens it
+        self._journal_completed = None
+        self._journal_op_counts: dict = {}
+        if journal is not None:
+            self._journal_completed = set(journal.get("completed") or ())
+            for op, _key in self._journal_completed:
+                self._journal_op_counts[op] = (
+                    self._journal_op_counts.get(op, 0) + 1
+                )
+
+    def journal_allows_op_skip(self, name: str, num_tasks: int) -> bool:
+        """Without a journal, always True; with one, an op may only be
+        skipped wholesale when the journal recorded every task complete."""
+        if self._journal_completed is None:
+            return True
+        return self._journal_op_counts.get(name, 0) >= num_tasks
+
+    def journal_allows_task_skip(self, name: str, key: str) -> bool:
+        if self._journal_completed is None:
+            return True
+        return (name, key) in self._journal_completed
 
     def valid_chunks(self, target) -> Optional[set]:
         """The set of verified-valid chunk keys of *target*'s store, or
@@ -153,6 +179,13 @@ def already_computed(
     if resume:
         if state is None:
             state = ResumeState()
+        if not state.journal_allows_op_skip(
+            name, pipeline.num_tasks
+        ):
+            # the journal (coordinator-crash recovery) says this op never
+            # finished all its tasks: fall through to the per-task skip
+            # even when every output chunk verifies
+            return False
         for succ in dag.successors(name):
             target = nodes[succ].get("target", None)
             if target is None:
@@ -199,13 +232,19 @@ def pending_mappable(
         if valid is None:
             return pipeline.mappable, 0
         valid_sets.append(valid)
+    from .utils import chunk_key as _mappable_key
+
     pending = []
     skipped = 0
     for m in pipeline.mappable:
         key = _task_chunk_key(m)
         # a task is done only when EVERY output array has its chunk (a
         # multi-output op with one corrupt side output re-runs the task)
-        if all(key in valid for valid in valid_sets):
+        # AND, when resuming from a coordinator-crash journal, the journal
+        # recorded the task complete (journal ∩ integrity frontier)
+        if all(key in valid for valid in valid_sets) and (
+            state.journal_allows_task_skip(name, _mappable_key(m))
+        ):
             skipped += 1
         else:
             pending.append(m)

@@ -14,7 +14,10 @@ worth anything:
   backoff then re-run, consuming one of the task's ``retries``), and
   ``REQUEUE`` (infrastructure took the *worker*, not the task —
   ``WorkerLostError`` — so the task reroutes to a survivor without
-  consuming a user-visible retry), and ``RESOURCE`` (``MemoryError`` /
+  consuming a user-visible retry; since PR 8 the distributed fleet only
+  raises it on **lease expiry** or a verified process exit, never on a
+  bare socket error, so a transient network partition draws nothing at
+  all), and ``RESOURCE`` (``MemoryError`` /
   memory-guard trips / OOM-killed workers: retried only after the
   admission controller steps concurrency down — runtime/memory.py — and
   fatal with an actionable error at concurrency 1). Unknown exception

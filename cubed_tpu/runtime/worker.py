@@ -31,6 +31,20 @@ def _default_drain_grace() -> float:
         return 10.0
 
 
+def _default_reconnect_give_up() -> float:
+    """``CUBED_TPU_RECONNECT_GIVE_UP_S`` or 30.0; malformed values warn and
+    fall back (same argparse-construction hazard as the drain grace)."""
+    raw = os.environ.get("CUBED_TPU_RECONNECT_GIVE_UP_S", "")
+    try:
+        return float(raw) if raw else 30.0
+    except ValueError:
+        logging.getLogger(__name__).warning(
+            "ignoring malformed CUBED_TPU_RECONNECT_GIVE_UP_S=%r "
+            "(want a float of seconds); using default 30.0", raw,
+        )
+        return 30.0
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("coordinator", help="coordinator address, host:port")
@@ -46,6 +60,14 @@ def main(argv=None) -> None:
         "in-flight work still running at the end of the window is "
         "abandoned and requeued by the coordinator (default 10, env "
         "CUBED_TPU_DRAIN_GRACE_S)",
+    )
+    parser.add_argument(
+        "--reconnect-give-up", type=float,
+        default=_default_reconnect_give_up(),
+        help="seconds to keep retrying a lost coordinator connection "
+        "before exiting; in-flight tasks keep running across a disconnect "
+        "and unacked results replay on reconnect (default 30, env "
+        "CUBED_TPU_RECONNECT_GIVE_UP_S)",
     )
     parser.add_argument(
         "-v", "--verbose", action="store_true", help="log at INFO level"
@@ -69,6 +91,7 @@ def main(argv=None) -> None:
     run_worker(
         args.coordinator, nthreads=args.threads, name=args.name,
         drain_grace_s=args.drain_grace,
+        reconnect_give_up_s=args.reconnect_give_up,
     )
 
 

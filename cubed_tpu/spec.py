@@ -34,6 +34,7 @@ class Spec:
         integrity: Optional[str] = None,
         memory_guard: Optional[str] = None,
         scheduler: Optional[str] = None,
+        journal: Optional[str] = None,
     ):
         self._work_dir = work_dir
         self._reserved_mem = convert_to_bytes(reserved_mem or 0)
@@ -75,6 +76,12 @@ class Spec:
                     f"of {SCHEDULER_MODES}"
                 )
         self._scheduler = scheduler
+        if journal is not None and not isinstance(journal, str):
+            raise ValueError(
+                f"journal must be a file path (str) or None, got "
+                f"{type(journal).__name__}"
+            )
+        self._journal = journal
 
     @property
     def work_dir(self) -> Optional[str]:
@@ -160,6 +167,17 @@ class Spec:
         the op-level default. The sequential oracle and the jax executor
         always keep op ordering (runtime/dataflow.py)."""
         return self._scheduler
+
+    @property
+    def journal(self) -> Optional[str]:
+        """Path of the durable compute journal (append-only JSONL beside
+        the Zarr store, fsync'd completion records). ``Plan.execute``
+        attaches a ``runtime.journal.JournalCallback`` writing compute
+        metadata, task dispatch/completion, and the decision ring there —
+        what ``resume_from_journal=`` / ``DistributedDagExecutor.
+        resume_compute`` rebuild coordinator state from after a client
+        crash. ``None`` (the default) journals nothing."""
+        return self._journal
 
     def __repr__(self) -> str:
         return (

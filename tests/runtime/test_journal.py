@@ -1,0 +1,299 @@
+"""Durable compute journal: writer/loader discipline, lifecycle journaling
+through the callback events, the journal ∩ integrity resume frontier, and
+the chaos proof that a hard-killed coordinator process resumes
+bitwise-correct from its journal on the distributed executor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp  # noqa: F401  (parity with sibling suites)
+from cubed_tpu.observability import get_registry
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+from cubed_tpu.runtime.journal import (
+    ComputeJournal,
+    load_journal,
+)
+
+from ..utils import TaskCounter
+
+
+@pytest.fixture()
+def spec_path(tmp_path):
+    return str(tmp_path), str(tmp_path / "compute.journal.jsonl")
+
+
+# ----------------------------------------------------------------------
+# writer / loader units
+# ----------------------------------------------------------------------
+
+
+def test_journal_roundtrip_and_torn_line_tolerance(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = ComputeJournal(path)
+    j.append("compute_start", compute_id="c-1", tasks_total=3,
+             ops={"op-a": 3})
+    j.append("dispatch", fsync=False, op="op-a", key="k0", attempt=0)
+    j.append("complete", op="op-a", key="k0")
+    j.append("complete", op="op-a", key="k1")
+    j.append("decision", fsync=False, kind_detail="retry")
+    j.close()
+    # a crash tears the final line: it must cost only its own record
+    with open(path, "ab") as f:
+        f.write(b'{"kind": "complete", "op": "op-a", "key": "k2"')  # torn
+
+    loaded = load_journal(path)
+    assert loaded["meta"]["compute_id"] == "c-1"
+    assert loaded["meta"]["tasks_total"] == 3
+    assert loaded["completed"] == {("op-a", "k0"), ("op-a", "k1")}
+    assert loaded["dispatches"] == 1
+    assert len(loaded["decisions"]) == 1
+    assert loaded["bad_lines"] == 1  # the torn line, skipped
+    assert loaded["complete"] is False  # never sealed
+
+
+def test_journal_seal_and_multi_run_fold(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = ComputeJournal(path)
+    j.append("compute_start", compute_id="c-1", tasks_total=2)
+    j.append("complete", op="op-a", key="k0")
+    j.close()
+    # run 2 (the resume) appends to the same file
+    j2 = ComputeJournal(path)
+    j2.append("compute_start", compute_id="c-2", tasks_total=2)
+    j2.append("complete", op="op-a", key="k1")
+    j2.append("compute_end", status="completed", error=None)
+    j2.close()
+    loaded = load_journal(path)
+    assert loaded["meta"]["compute_id"] == "c-2"  # the latest run's meta
+    # completions fold across every run
+    assert loaded["completed"] == {("op-a", "k0"), ("op-a", "k1")}
+    assert loaded["complete"] is True
+
+
+def test_append_after_close_is_noop(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = ComputeJournal(path)
+    j.append("compute_start", compute_id="c-1")
+    j.close()
+    j.append("decision", kind_detail="late")  # a late sink call: silent
+    assert len(load_journal(path)["decisions"]) == 0
+
+
+# ----------------------------------------------------------------------
+# lifecycle journaling via Spec(journal=...)
+# ----------------------------------------------------------------------
+
+
+def test_compute_journals_lifecycle_and_decisions(spec_path):
+    work_dir, path = spec_path
+    spec = ct.Spec(work_dir=work_dir, allowed_mem="500MB", journal=path)
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    r = ct.map_blocks(lambda x: x + 1.0, a, dtype=np.float64)
+    total = r.plan.num_tasks()
+    result = r.compute(executor=AsyncPythonDagExecutor())
+    np.testing.assert_array_equal(result, an + 1.0)
+
+    loaded = load_journal(path)
+    assert loaded["meta"]["tasks_total"] == total
+    assert sum(loaded["meta"]["ops"].values()) == total
+    assert len(loaded["completed"]) == total
+    assert loaded["dispatches"] >= total
+    assert loaded["complete"] is True
+    # the decision ring is mirrored while the journal is open (at minimum
+    # the scheduler_mode decision every async executor records)
+    assert any(
+        d.get("decision") == "scheduler_mode" for d in loaded["decisions"]
+    ), loaded["decisions"][:5]
+    assert get_registry().counter("journal_appends").value > 0
+
+
+def test_resume_from_journal_narrows_the_skip_frontier(spec_path):
+    """journal ∩ integrity: chunks that verify on disk but whose tasks the
+    journal never recorded complete must RE-RUN on resume; journaled ones
+    are skipped."""
+    work_dir, path = spec_path
+    spec = ct.Spec(work_dir=work_dir, allowed_mem="500MB", journal=path)
+    an = np.arange(144, dtype=np.float64).reshape(12, 12)
+    a = ct.from_array(an, chunks=(3, 3), spec=spec)
+    r = ct.map_blocks(lambda x: x * 2.0, a, dtype=np.float64)  # 16 tasks
+    result = r.compute(executor=AsyncPythonDagExecutor())
+    np.testing.assert_array_equal(result, an * 2.0)
+
+    # drop half of the big op's complete lines, as if the client crashed
+    # before fsyncing them (every chunk still verifies on disk)
+    with open(path) as f:
+        lines = f.readlines()
+    dropped = 0
+    kept = []
+    for line in lines:
+        doc = json.loads(line)
+        if (
+            doc.get("kind") == "complete"
+            and doc.get("op", "").startswith("op-")
+            and dropped < 8
+        ):
+            dropped += 1
+            continue
+        kept.append(line)
+    assert dropped == 8
+    with open(path, "w") as f:
+        f.writelines(kept)
+
+    reg = get_registry()
+    before = reg.snapshot()
+    counter = TaskCounter()
+    result2 = r.compute(
+        executor=AsyncPythonDagExecutor(), callbacks=[counter],
+        resume_from_journal=path,
+    )
+    np.testing.assert_array_equal(result2, an * 2.0)
+    delta = reg.snapshot_delta(before)
+    # exactly the 8 un-journaled tasks re-ran, plus the create-arrays
+    # metadata op (which always re-runs on resume, idempotently); the
+    # journaled 8 were skipped
+    assert counter.value == 9, counter.value
+    assert delta.get("tasks_skipped_resume", 0) >= 8, delta
+
+    # and with the now-complete journal: only create-arrays re-runs
+    before = reg.snapshot()
+    counter2 = TaskCounter()
+    result3 = r.compute(
+        executor=AsyncPythonDagExecutor(), callbacks=[counter2],
+        resume_from_journal=path,
+    )
+    np.testing.assert_array_equal(result3, an * 2.0)
+    assert counter2.value == 1, counter2.value
+
+
+# ----------------------------------------------------------------------
+# chaos proof B: hard-kill the coordinator process, resume from journal
+# ----------------------------------------------------------------------
+
+
+_CRASH_SCRIPT = r"""
+import json, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+import cubed_tpu as ct
+from cubed_tpu.observability import get_registry
+from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+
+mode = sys.argv[1]
+work_dir = {work_dir!r}
+journal = {journal!r}
+
+def slow_add(x):
+    import time
+    time.sleep(0.12)
+    return x + 1.0
+
+spec = ct.Spec(work_dir=work_dir, allowed_mem="500MB", journal=journal)
+an = np.arange(144, dtype=np.float64).reshape(12, 12)
+a = ct.from_array(an, chunks=(2, 2), spec=spec)   # 36 tasks
+r = ct.map_blocks(slow_add, a, dtype=np.float64)
+total = r.plan.num_tasks()
+
+ex = DistributedDagExecutor(n_local_workers=2, worker_threads=1)
+try:
+    if mode == "run":
+        print(json.dumps({{"phase": "run", "total": total}}), flush=True)
+        r.compute(executor=ex)
+        print(json.dumps({{"phase": "run", "done": True}}), flush=True)
+    else:
+        reg = get_registry()
+        before = reg.snapshot()
+        result = ex.resume_compute(r, journal)
+        delta = reg.snapshot_delta(before)
+        print(json.dumps({{
+            "phase": "resume",
+            "correct": bool(np.array_equal(result, an + 1.0)),
+            "total": total,
+            "resumed_tasks": delta.get("tasks_completed", 0),
+            "skipped": delta.get("tasks_skipped_resume", 0),
+        }}), flush=True)
+finally:
+    ex.close()
+"""
+
+
+@pytest.mark.chaos
+def test_chaos_coordinator_crash_resume_from_journal(tmp_path):
+    """Acceptance proof: SIGKILL the client/coordinator process at ~50%
+    task completion (observed live from the fsync'd journal), rebuild the
+    same plan in a fresh process, and ``resume_compute(journal)`` — the
+    result is bitwise-correct and strictly fewer tasks re-ran than the
+    full count, asserted via metrics."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    journal = str(tmp_path / "crash.journal.jsonl")
+    script = _CRASH_SCRIPT.format(
+        repo=repo, work_dir=str(tmp_path), journal=journal,
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               # cross-process resume needs stable intermediate-array paths
+               CUBED_TPU_CONTEXT_ID="cubed-crashtest")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    # own process group: the SIGKILL must take the client AND its local
+    # worker subprocesses — orphaned workers would keep executing (and
+    # retry the dead coordinator for 30s) while the resume phase runs
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script, "run"], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
+    try:
+        # watch the journal grow; kill at ~50% of the big op's completions
+        deadline = time.time() + 120
+        killed_at = None
+        while time.time() < deadline and proc.poll() is None:
+            if os.path.exists(journal):
+                done = len(load_journal(journal)["completed"])
+                if done >= 19:  # create-arrays + ~half of the 36 chunk tasks
+                    os.killpg(proc.pid, signal.SIGKILL)
+                    killed_at = done
+                    break
+            time.sleep(0.05)
+        proc.wait(timeout=30)
+        assert killed_at is not None, (
+            "compute finished before the kill landed; make the tasks "
+            f"slower (rc={proc.returncode})"
+        )
+    finally:
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait(timeout=30)
+
+    loaded = load_journal(journal)
+    assert loaded["complete"] is False  # the run died unsealed
+    assert 0 < len(loaded["completed"]) < loaded["meta"]["tasks_total"]
+
+    out = subprocess.run(
+        [sys.executable, "-c", script, "resume"], env=env,
+        capture_output=True, text=True, timeout=180,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["correct"] is True  # bitwise-correct after the crash
+    assert report["skipped"] > 0
+    # strictly fewer tasks re-ran than the full plan (metrics-asserted)
+    assert report["resumed_tasks"] < report["total"], report
+    assert report["resumed_tasks"] + report["skipped"] >= report["total"]
+    # the resumed run sealed the journal
+    assert load_journal(journal)["complete"] is True
