@@ -555,6 +555,116 @@ def measure_coordinator_recovery(timeout: float):
         shutil.rmtree(work_dir, ignore_errors=True)
 
 
+#: p2p-transfer workload: a deep elementwise chain on the fleet — every
+#: inter-op edge is one store write+read round-trip per chunk without peer
+#: transfer, and (depth-1)/depth of the reads are cache-servable with it
+P2P_DEPTH = 6
+P2P_N = 16
+P2P_CHUNK = 4
+
+P2P_TRANSFER = r"""
+import json, sys, tempfile, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import cubed_tpu as ct
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+
+DEPTH, N, CHUNK = {depth!r}, {n!r}, {chunk!r}
+
+
+def bump(x):
+    return x + 1.0
+
+
+an = np.arange(N * N, dtype=np.float64).reshape(N, N)
+out = {{}}
+for mode in ("store_only", "peer"):
+    spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="2GB",
+                   scheduler="dataflow")
+    a = ct.from_array(an, chunks=(CHUNK, CHUNK), spec=spec)
+    r = a
+    for _ in range(DEPTH):
+        r = ct.map_blocks(bump, r, dtype=np.float64)
+    ex = DistributedDagExecutor(
+        n_local_workers=2, peer_transfer=(mode == "peer")
+    )
+    try:
+        ex._ensure_fleet()  # boot outside the timed window
+        reg = get_registry()
+        before = reg.snapshot()
+        t0 = time.perf_counter()
+        # optimize_graph=False keeps the chain DEEP (fusion would collapse
+        # it into one op and remove the inter-op edges being measured)
+        val = np.asarray(r.compute(executor=ex, optimize_graph=False))
+        elapsed = time.perf_counter() - t0
+        delta = reg.snapshot_delta(before)
+    finally:
+        ex.close()
+    assert (val == an + DEPTH).all()
+    out[mode] = {{
+        "elapsed": elapsed,
+        "bytes_read": delta.get("bytes_read", 0),
+        "store_read_bytes_saved": delta.get("store_read_bytes_saved", 0),
+        "peer_hits": delta.get("peer_hits", 0),
+        "peer_misses": delta.get("peer_misses", 0),
+        "peer_bytes_fetched": delta.get("peer_bytes_fetched", 0),
+        "peer_fetch_fallbacks": delta.get("peer_fetch_fallbacks", 0),
+        "placement_locality_hits": delta.get("placement_locality_hits", 0),
+    }}
+    print("p2p", mode, round(elapsed, 2), "s", file=sys.stderr, flush=True)
+hits = out["peer"]["peer_hits"]
+misses = out["peer"]["peer_misses"]
+out["hit_rate"] = hits / max(hits + misses, 1)
+# the headline: fraction of the store-only read volume the caches absorbed
+out["saved_fraction"] = out["peer"]["store_read_bytes_saved"] / max(
+    out["store_only"]["bytes_read"], 1
+)
+print(json.dumps(out), flush=True)
+"""
+
+
+def measure_p2p_transfer(timeout: float):
+    """Deep-chain fleet run, store-only vs peer-transfer-enabled.
+
+    Same plan twice on a 2-worker local fleet under the dataflow
+    scheduler: once with the historical store-only data plane, once with
+    the p2p chunk cache + locality placement. Records wall clock per mode,
+    the peer hit rate, and ``saved_fraction`` — ``store_read_bytes_saved``
+    over the store-only run's ``bytes_read`` (the acceptance bar is
+    >=30%). Rides the same history/perf-gate pipeline as every other
+    config. Returns None on failure — additive, never the reason a bench
+    run dies."""
+    script = P2P_TRANSFER.format(
+        repo=REPO, depth=P2P_DEPTH, n=P2P_N, chunk=P2P_CHUNK,
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            env=_scrubbed_cpu_env(),
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"p2p transfer failed (rc={out.returncode}): "
+                f"{out.stderr[-2000:]}"
+            )
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        print(
+            f"p2p transfer: saved_fraction {res['saved_fraction']:.0%}, "
+            f"hit rate {res['hit_rate']:.0%}, "
+            f"wall {res['store_only']['elapsed']:.2f}s store-only vs "
+            f"{res['peer']['elapsed']:.2f}s peer",
+            file=sys.stderr, flush=True,
+        )
+        return res
+    except Exception as e:
+        print(f"p2p transfer sweep skipped: {e}", file=sys.stderr)
+        return None
+
+
 def _scrubbed_cpu_env() -> dict:
     """Tunnel-free env: no plugin-gating vars, ONE CPU device.
 
@@ -967,6 +1077,15 @@ def main() -> None:
         print("coordinator recovery sweep skipped: out of budget",
               file=sys.stderr)
 
+    # p2p chunk transfer: the deep chain store-only vs peer-enabled (two
+    # fleet boots + two short elementwise-chain computes)
+    if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 60:
+        p2p = measure_p2p_transfer(_remaining(120))
+        if p2p is not None:
+            metrics_record["p2p_transfer"] = p2p
+    else:
+        print("p2p transfer sweep skipped: out of budget", file=sys.stderr)
+
     # per-op timing / IO-byte trajectories ride alongside the headline
     # numbers so future rounds can localize regressions without re-profiling
     prev_trajectory = _previous_trajectory()
@@ -1171,6 +1290,27 @@ def perf_regressions(prev: dict, cur: dict) -> list:
                     f"{old_df:.2f}s ({pct:+.1f}%)"
                 )
             continue
+        if name == "p2p_transfer":
+            # the data-plane win must not rot: saved bytes dropping >20%
+            # or the peer-enabled wall clock growing >20% both gate
+            pct = _delta_pct(
+                cfg.get("saved_fraction"), old.get("saved_fraction")
+            )
+            if pct is not None and pct <= -PERF_GATE_THRESHOLD_PCT:
+                out.append(
+                    f"p2p_transfer saved_fraction "
+                    f"{cfg['saved_fraction']:.2f} vs "
+                    f"{old['saved_fraction']:.2f} ({pct:+.1f}%)"
+                )
+            cur_pe = (cfg.get("peer") or {}).get("elapsed")
+            old_pe = (old.get("peer") or {}).get("elapsed")
+            pct = _delta_pct(cur_pe, old_pe)
+            if pct is not None and pct >= PERF_GATE_THRESHOLD_PCT:
+                out.append(
+                    f"p2p_transfer peer wall {cur_pe:.2f}s vs "
+                    f"{old_pe:.2f}s ({pct:+.1f}%)"
+                )
+            continue
         pct = _delta_pct(cfg.get("elapsed"), old.get("elapsed"))
         if pct is not None and pct >= PERF_GATE_THRESHOLD_PCT:
             out.append(
@@ -1230,6 +1370,51 @@ def _print_scheduler_deltas(cur: dict, old: dict, label: str) -> None:
         )
 
 
+def _print_p2p_deltas(cur: dict, old: dict, label: str) -> None:
+    """P2P data-plane trajectory: saved read bytes, hit rate, and per-mode
+    wall clock, with a LOUD flag when the saved fraction falls under the
+    30% acceptance bar or the shared gate rules flag a regression."""
+    sf = cur.get("saved_fraction")
+    hr = cur.get("hit_rate")
+    so = (cur.get("store_only") or {}).get("elapsed")
+    pe = (cur.get("peer") or {}).get("elapsed")
+    if isinstance(sf, (int, float)) and isinstance(pe, (int, float)):
+        print(
+            f"trajectory p2p_transfer: saved_fraction {sf:.0%}, hit rate "
+            f"{(hr or 0):.0%}, store-only {so:.2f}s vs peer {pe:.2f}s",
+            file=sys.stderr,
+        )
+        if sf < 0.30:
+            print(
+                "P2P REGRESSION: store_read_bytes_saved fell under the 30% "
+                f"acceptance bar (saved_fraction {sf:.0%})",
+                file=sys.stderr,
+            )
+    else:
+        print("trajectory p2p_transfer: incomplete record", file=sys.stderr)
+    if not old:
+        print("trajectory p2p_transfer: no prior record to compare against "
+              f"in {label}" if label else
+              "trajectory p2p_transfer: first record", file=sys.stderr)
+        return
+    regressed = perf_regressions(
+        {"configs": {"p2p_transfer": old}},
+        {"configs": {"p2p_transfer": cur}},
+    )
+    if regressed:
+        print(
+            f"P2P REGRESSION (>{PERF_GATE_THRESHOLD_PCT:.0f}% vs "
+            + (label or "prior record") + "): " + "; ".join(regressed),
+            file=sys.stderr,
+        )
+    else:
+        print(
+            f"trajectory p2p_transfer: within "
+            f"{PERF_GATE_THRESHOLD_PCT:.0f}% of {label}",
+            file=sys.stderr,
+        )
+
+
 def _print_trajectory_deltas(metrics_record: dict, prev_trajectory) -> None:
     """One line per config vs the previous trajectory (stderr — stdout's
     last line belongs to the driver), so the bench history stops being
@@ -1250,6 +1435,10 @@ def _print_trajectory_deltas(metrics_record: dict, prev_trajectory) -> None:
             _print_scheduler_deltas(
                 cur, old if isinstance(old, dict) else {}, label
             )
+            continue
+        if metric == "p2p_transfer":
+            _print_p2p_deltas(cur, old if isinstance(old, dict) else {},
+                              label)
             continue
         if not isinstance(old, dict):
             print(f"trajectory {metric}: new config (no prior record in "

@@ -181,7 +181,14 @@ def main() -> int:
     # Fast-compiling configs go first so a wedge costs the least info.
     RAW_ORDER = ["matmul_bf16", "elemwise", "reduce", "addsum",
                  "vorticity_f32", "matmul", "vorticity"]
-    for cfg in sorted(raw_gaps, key=RAW_ORDER.index):
+    # configs not in the hard-coded order sort last (alphabetically) instead
+    # of killing the whole gap session with a ValueError from .index
+    for cfg in sorted(
+        raw_gaps,
+        key=lambda c: (
+            RAW_ORDER.index(c) if c in RAW_ORDER else len(RAW_ORDER), c
+        ),
+    ):
         if not probe(75):
             return 1
         run_json_phase("raw", "raw_jax_bound.py", 300,

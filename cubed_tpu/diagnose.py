@@ -42,7 +42,24 @@ _TIMELINE_GROUPS = {
                      "worker_drain_requested", "worker_draining",
                      "worker_drained", "scale_up", "scale_down",
                      "spawn_died"),
+    # the p2p data plane: per-compute arming, locality-preferred
+    # dispatches, and peer-fetch store fallbacks (runtime/transfer.py)
+    "data movement": ("peer_transfer", "placement_locality",
+                      "peer_fallback"),
 }
+
+#: the data-movement section's metric rows (manifest metrics snapshot);
+#: printed only when the compute actually moved bytes peer-to-peer
+_DATA_MOVEMENT_METRICS = (
+    ("peer_hits", "reads served from a worker chunk cache (local or peer)"),
+    ("peer_misses", "peer-path reads that went to the store"),
+    ("peer_bytes_fetched", "bytes fetched worker-to-worker"),
+    ("store_read_bytes_saved", "store read bytes the caches saved"),
+    ("peer_fetch_fallbacks", "located fetches that fell back to the store"),
+    ("peer_locate_requests", "chunk_locate RPCs answered"),
+    ("placement_locality_hits", "dispatches placed for input locality"),
+    ("cache_evictions", "worker cache evictions (LRU + pressure)"),
+)
 
 
 def _merge_intervals(intervals: list) -> list:
@@ -178,6 +195,21 @@ def render_report(bundle: dict, timeline_limit: int = 20) -> str:
             f"  total cross-op overlap: {_fmt_s(total)}"
             + ("  (op barrier held: no overlap)" if total < 1e-6 else "")
         )
+
+    metrics = m.get("metrics") or {}
+    if any(metrics.get(name) for name, _ in _DATA_MOVEMENT_METRICS):
+        out.append(_section("data movement (peer-to-peer)"))
+        hits = metrics.get("peer_hits") or 0
+        misses = metrics.get("peer_misses") or 0
+        if hits or misses:
+            out.append(
+                f"  peer hit rate {hits / max(hits + misses, 1):.0%} "
+                f"({hits} hits / {misses} store reads on the peer path)"
+            )
+        for name, caption in _DATA_MOVEMENT_METRICS:
+            v = metrics.get(name)
+            if v:
+                out.append(f"  {name:<26} {v:>12}  {caption}")
 
     decisions = m.get("decisions") or []
     for title, kinds in _TIMELINE_GROUPS.items():

@@ -168,6 +168,11 @@ class ChunkGraph:
         #: included: overlap with the bootstrap is not "early")
         self.op_upstream: Dict[str, Set[str]] = {}
         self.pipelines: Dict[str, Any] = {}
+        #: item index -> tuple of (store, chunk file key) pairs the task
+        #: reads — derived during the same block-function walk that builds
+        #: dependencies; feeds the coordinator's locality-aware placement
+        #: (resident input bytes per worker, runtime/transfer.py)
+        self.reads: Dict[int, tuple] = {}
         #: tasks gated by a conservative op-level barrier (non-bootstrap)
         self.barrier_tasks: int = 0
         #: ops that became barriers (for logs/decisions)
@@ -310,6 +315,7 @@ def build_chunk_graph(
         for idx in op_item_indices[name]:
             _, m = g.items[idx]
             deps = set(barrier_base)
+            reads: List[tuple] = []
             if non_bootstrap_barrier:
                 g.barrier_tasks += 1
             try:
@@ -318,6 +324,7 @@ def build_chunk_graph(
                     proxy = pipeline.config.reads_map.get(key[0])
                     if proxy is None:
                         raise KeyError(key[0])
+                    reads.append((_store_of(proxy.array), _key_str(key)))
                     producer = store_to_op.get(_store_of(proxy.array))
                     if producer is None or producer not in in_graph:
                         continue  # source array, or op satisfied by resume
@@ -355,6 +362,9 @@ def build_chunk_graph(
                     p != CREATE_ARRAYS_OP for p in upstream
                 ):
                     g.barrier_tasks += 1
+                reads = []  # an unwalkable block function reads who-knows-what
+            if reads:
+                g.reads[idx] = tuple(dict.fromkeys(reads))
             add_deps(idx, deps)
 
         # safety net: a pending producer the walk never saw means the
@@ -418,6 +428,19 @@ class DataflowScheduler:
     @property
     def pipelines(self) -> Dict[str, Any]:
         return self.graph.pipelines
+
+    def locality_hints(self) -> Dict[tuple, tuple]:
+        """``(op name, output chunk key) -> ((store, input chunk key), ...)``
+        for every task whose reads the graph walk resolved — what the
+        distributed executor hands the coordinator so dispatch can score
+        workers by input bytes already resident in their chunk caches.
+        Keyed by (op, chunk) rather than item index because the pool
+        adapter sees ``(op_name, task_input)`` items, not indices."""
+        out: Dict[tuple, tuple] = {}
+        for idx, reads in self.graph.reads.items():
+            op, m = self.graph.items[idx]
+            out[(op, _task_chunk_key(m))] = reads
+        return out
 
     @property
     def completed(self) -> Set[int]:

@@ -94,6 +94,7 @@ from concurrent.futures import Future
 from typing import Any, Dict, Optional
 
 from ..observability.metrics import get_registry
+from .transfer import ChunkLocationRegistry, pick_worker_by_locality
 
 logger = logging.getLogger(__name__)
 
@@ -263,6 +264,13 @@ class _WorkerConn:
         #: spans on the client timeline (observability/collect.py)
         self.clock_offset: Optional[float] = None
         self.clock_rtt: Optional[float] = None
+        #: the worker's peer chunk-server address (ip, port) from the
+        #: hello, or None for workers without the p2p data plane; refreshed
+        #: on reconnect (the port survives, the reachable ip may not)
+        self.peer_addr = tuple(hello["peer_addr"]) if hello.get("peer_addr") else None
+        #: latest heartbeat-reported peer-cache stats (bytes/entries/
+        #: evictions) for stats_snapshot/diagnose
+        self.peer_cache: Optional[dict] = None
         #: per-session secret: a reconnecting worker must present it, so a
         #: stranger claiming a live worker's name cannot steal its tasks
         self.token = uuid.uuid4().hex
@@ -350,7 +358,15 @@ class Coordinator:
             "tasks_abandoned_on_drain": 0, "workers_disconnected": 0,
             "workers_reconnected": 0, "leases_expired": 0,
             "frames_corrupt": 0, "workers_rejected": 0,
+            "peer_locate_requests": 0, "placement_locality_hits": 0,
         }
+        #: (store, chunk key) -> producing worker, fed by the `produced`
+        #: lists piggybacked on sequenced result frames; drives the
+        #: chunk_locate RPC and locality-aware dispatch (runtime/transfer.py)
+        self.chunk_registry = ChunkLocationRegistry()
+        #: decision-ring entries for locality placement are throttled (the
+        #: counters carry the totals; the ring is bounded)
+        self._locality_decisions_left = 16
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="coordinator-accept", daemon=True
         )
@@ -400,7 +416,7 @@ class Coordinator:
                 (w for w in self._workers if w.alive and w.name == name), None
             )
         if existing is not None and token and token == existing.token:
-            if self._adopt_reconnect(existing, sock, addr):
+            if self._adopt_reconnect(existing, sock, addr, hello):
                 return
             # the lease expired between the lookup and the adopt: the old
             # session is gone — fall through to a fresh registration
@@ -434,6 +450,20 @@ class Coordinator:
             )
         conn = _WorkerConn(sock, addr, hello)
         conn.lease_deadline = time.monotonic() + self.lease_s
+        # register BEFORE acking — acking first left a window where a fast
+        # client's submit() raised NoWorkersError against a worker that
+        # believed itself registered — but keep the conn UNROUTABLE
+        # (connected=False) until the ack is on the wire: the hello_ack
+        # must be the first frame the worker receives, so a racing
+        # submit() must not slip a task frame ahead of it (submit's
+        # no-connected-workers path waits on _worker_joined, which the
+        # flip below notifies)
+        conn.connected = False
+        with self._lock:
+            self._workers.append(conn)
+            self._workers_ever += 1
+            self._worker_names_ever.add(conn.name)
+            self._worker_joined.notify_all()
         try:
             send_frame(sock, {
                 "type": "hello_ack", "token": conn.token, "resume": False,
@@ -441,12 +471,22 @@ class Coordinator:
             })
         except (ConnectionError, OSError) as e:
             logger.warning("hello_ack to %s failed: %s", name, e)
-            sock.close()
+            # roll the registration back quietly: the worker never saw the
+            # ack (it retries with a fresh hello) and was never routable
+            # (connected=False), so this is NOT a worker loss — no
+            # workers_lost count, no departed row
+            with self._lock:
+                conn.dropped = True
+                conn.alive = False
+                if conn in self._workers:
+                    self._workers.remove(conn)
+            try:
+                sock.close()
+            except OSError:
+                pass
             return
         with self._lock:
-            self._workers.append(conn)
-            self._workers_ever += 1
-            self._worker_names_ever.add(conn.name)
+            conn.connected = True
             self._worker_joined.notify_all()
         threading.Thread(
             target=self._recv_loop,
@@ -456,7 +496,7 @@ class Coordinator:
         ).start()
         logger.info("worker %s joined (%d threads)", conn.name, conn.nthreads)
 
-    def _adopt_reconnect(self, conn: _WorkerConn, sock, addr) -> bool:
+    def _adopt_reconnect(self, conn: _WorkerConn, sock, addr, hello=None) -> bool:
         """Swap a reconnecting worker's new socket into its live session:
         outstanding futures, lease, and blob bookkeeping all survive. The
         superseded recv loop notices its stale generation and exits."""
@@ -468,6 +508,10 @@ class Coordinator:
             old_sock = conn.sock
             conn.sock = sock
             conn.address = addr
+            if hello is not None and hello.get("peer_addr"):
+                # the peer server survives the reconnect, but the reachable
+                # ip may have changed with the new route
+                conn.peer_addr = tuple(hello["peer_addr"])
             conn.connected = True
             conn.generation += 1
             gen = conn.generation
@@ -595,6 +639,10 @@ class Coordinator:
             conn.sock.close()
         except OSError:
             pass
+        # a departed worker can no longer serve peer fetches: drop its
+        # chunk locations so readers go straight to the store instead of
+        # timing out against a corpse
+        self.chunk_registry.drop_worker(conn.name)
         exc_cls = WorkerDrainedError if clean else WorkerLostError
         for task_id, fut in orphans:
             _fail_future(
@@ -755,6 +803,16 @@ class Coordinator:
                         dup = seq <= conn.last_seq
                         if not dup:
                             conn.last_seq = seq
+                    if dup:
+                        # an outbox replay (or injected duplication) of a
+                        # message already applied: never process twice.
+                        # Counted BEFORE the ack goes out — the ack is the
+                        # observable "fully processed" signal, so anything
+                        # the frame implies (this counter) must be done
+                        # when a peer sees it
+                        get_registry().counter(
+                            "fleet_messages_deduped"
+                        ).inc()
                     # ack even a duplicate: the ack for the original may be
                     # the very frame the partition ate
                     try:
@@ -765,14 +823,16 @@ class Coordinator:
                     except (ConnectionError, OSError):
                         pass  # recv will notice the dead socket
                     if dup:
-                        # an outbox replay (or injected duplication) of a
-                        # message already applied: never process twice
-                        get_registry().counter(
-                            "fleet_messages_deduped"
-                        ).inc()
                         continue
                 mtype = msg.get("type")
                 if mtype in ("result", "error"):
+                    produced = msg.get("produced")
+                    if produced:
+                        # the producer's advertisement piggybacks on the
+                        # (sequenced, deduped) result frame: record BEFORE
+                        # the future resolves so a consumer dispatched by
+                        # this completion can already locate the bytes
+                        self.chunk_registry.record(conn.name, produced)
                     with self._lock:
                         fut = conn.outstanding.pop(msg["task_id"], None)
                         conn.deadlines.pop(msg["task_id"], None)
@@ -816,9 +876,19 @@ class Coordinator:
                     # plus its local pressure verdict (watermarks evaluated
                     # where the memory actually is); routing skips
                     # pressured workers while an unpressured one is live
+                    if msg.get("peer_cache_flush"):
+                        # the worker's cache emptied (hard pressure): its
+                        # advertised locations are all stale now
+                        self.chunk_registry.drop_worker(conn.name)
+                    elif msg.get("peer_evicted"):
+                        self.chunk_registry.remove(
+                            conn.name, msg["peer_evicted"]
+                        )
                     with self._lock:
                         conn.rss = msg.get("rss")
                         conn.pressured = bool(msg.get("pressured"))
+                        if msg.get("peer_cache") is not None:
+                            conn.peer_cache = msg["peer_cache"]
                         if msg.get("clock_offset") is not None:
                             conn.clock_offset = msg["clock_offset"]
                             conn.clock_rtt = msg.get("clock_rtt")
@@ -899,6 +969,41 @@ class Coordinator:
                 elif mtype == "drained":
                     self._on_drained(conn, msg)
                     return  # the worker closes its socket right after
+                elif mtype == "chunk_locate":
+                    # the peer-fetch lookup RPC: name + dialable address of
+                    # the worker whose cache holds this chunk (None when
+                    # unknown, departed, or currently disconnected — the
+                    # reader then goes straight to the store)
+                    wname = self.chunk_registry.locate(
+                        msg.get("store"), msg.get("key")
+                    )
+                    peer_addr = None
+                    if wname is not None:
+                        with self._lock:
+                            target = next(
+                                (
+                                    w for w in self._workers
+                                    if w.alive and w.connected
+                                    and w.name == wname
+                                ),
+                                None,
+                            )
+                            peer_addr = (
+                                target.peer_addr if target is not None
+                                else None
+                            )
+                    with self._lock:
+                        self.stats["peer_locate_requests"] += 1
+                    get_registry().counter("peer_locate_requests").inc()
+                    try:
+                        send_frame(conn.sock, {
+                            "type": "chunk_location",
+                            "req_id": msg.get("req_id"),
+                            "worker": wname if peer_addr is not None else None,
+                            "addr": peer_addr,
+                        }, conn.send_lock)
+                    except (ConnectionError, OSError):
+                        pass  # the reader's locate times out -> store read
                 elif mtype == "blob_dropped":
                     # the worker evicted this blob from its bounded caches;
                     # forget we sent it so the next task of that op
@@ -1113,8 +1218,15 @@ class Coordinator:
             self._blob_cache.popitem(last=False)
         return blob_id, blob
 
-    def submit(self, _stats_wrapper, function, task_input, *, config=None) -> Future:
-        """Ship one task to the least-loaded live worker.
+    def submit(
+        self, _stats_wrapper, function, task_input, *, config=None,
+        locality=None,
+    ) -> Future:
+        """Ship one task to the least-loaded live worker — or, when
+        ``locality`` names the task's input chunks ``[(store, key), ...]``
+        and peer transfer is on, to the non-pressured worker already
+        holding the most of those bytes in its chunk cache (within a load
+        slack of the least-loaded; see ``transfer.pick_worker_by_locality``).
 
         The first positional argument exists to mirror
         ``pool.submit(execute_with_stats, function, input, config=...)``; the
@@ -1226,11 +1338,41 @@ class Coordinator:
                 unpressured = [w for w in active if not w.pressured]
                 if unpressured and len(unpressured) < len(active):
                     get_registry().counter("dispatch_skipped_pressured").inc()
-                conn = min(
-                    unpressured or active,
-                    key=lambda w: (len(w.outstanding) + len(w.ghost_ids))
-                    / max(w.nthreads, 1),
-                )
+                candidates = unpressured or active
+
+                def _load(w):
+                    return (
+                        len(w.outstanding) + len(w.ghost_ids)
+                    ) / max(w.nthreads, 1)
+
+                conn = None
+                if locality and len(candidates) > 1:
+                    # locality-aware placement: prefer the (non-pressured —
+                    # an all-pressured fleet falls through to candidates,
+                    # where load wins) worker whose chunk cache already
+                    # holds the most input bytes
+                    resident = self.chunk_registry.resident_bytes(locality)
+                    conn = pick_worker_by_locality(
+                        candidates, resident, _load
+                    )
+                    if conn is not None:
+                        self.stats["placement_locality_hits"] += 1
+                        get_registry().counter(
+                            "placement_locality_hits"
+                        ).inc()
+                        if self._locality_decisions_left > 0:
+                            self._locality_decisions_left -= 1
+                            locality_note = (
+                                conn.name, resident.get(conn.name, 0)
+                            )
+                        else:
+                            locality_note = None
+                    else:
+                        locality_note = None
+                else:
+                    locality_note = None
+                if conn is None:
+                    conn = min(candidates, key=_load)
                 task_id = self._next_task_id
                 self._next_task_id += 1
                 conn.outstanding[task_id] = fut
@@ -1244,10 +1386,17 @@ class Coordinator:
                         time.monotonic() + self.task_timeout, False
                     ]
             from ..observability import accounting, logs
+            from ..observability.collect import record_decision
             from ..storage import integrity
             from . import memory
+            from . import transfer as p2p
             from .faults import wire_config
 
+            if locality_note is not None:
+                record_decision(
+                    "placement_locality", worker=locality_note[0],
+                    resident_bytes=locality_note[1], task_id=task_id,
+                )
             msg = {
                 "type": "task",
                 "task_id": task_id,
@@ -1276,6 +1425,11 @@ class Coordinator:
                 # spans exactly when the client has a collector to merge
                 # them, and stop when it doesn't
                 "spans": accounting.spans_wire(),
+                # ... and the peer-transfer arming (None = off, which also
+                # disarms a pre-started worker a previous compute enabled):
+                # workers cache/advertise/fetch exactly when this compute
+                # asked for the p2p data plane
+                "peer": p2p.wire_config(),
             }
             try:
                 send_frame(conn.sock, msg, conn.send_lock)
@@ -1324,8 +1478,10 @@ class Coordinator:
                     "draining": w.draining,
                     "clock_offset": w.clock_offset,
                     "clock_rtt": w.clock_rtt,
+                    "peer_cache": w.peer_cache,
                 }
         out["workers"] = workers
+        out["chunk_locations"] = self.chunk_registry.stats()
         return out
 
     def close(self) -> None:
@@ -1528,11 +1684,30 @@ def run_worker(
     from ..storage import integrity
     from ..utils import current_measured_mem
     from . import memory
+    from . import transfer as p2p
     from .faults import arm_from_wire, get_injector
     from .utils import execute_with_stats
 
     host, _, port = coordinator.rpartition(":")
     wname = name or f"{socket.gethostname()}:{os.getpid()}"
+    #: the p2p data plane's worker half: chunk cache + serving socket. The
+    #: listener is cheap and always started (its address must ride the
+    #: FIRST hello, before any task message can arm fetching); the cache
+    #: only fills — and fetches only happen — while a compute arms peer
+    #: transfer over the wire. CUBED_TPU_P2P=off disables it entirely.
+    peer_rt: Optional[p2p.PeerRuntime] = None
+    if not p2p.env_disabled():
+        try:
+            peer_rt = p2p.PeerRuntime(wname)
+            peer_rt.start_server()
+            p2p.set_worker_runtime(peer_rt)
+        except OSError as e:
+            logger.warning(
+                "worker %s: peer chunk server failed to start (%s); "
+                "running store-only", wname, e,
+            )
+            peer_rt = None
+            p2p.set_worker_runtime(None)
     # stamp this process's task stats with the worker name (its trace lane)
     # and adopt any test-injected clock skew before the first heartbeat
     set_process_label(wname)
@@ -1544,6 +1719,10 @@ def run_worker(
         "offset": None, "rtt": None, "best": None,
     }
     link = _WorkerLink(wname)
+    if peer_rt is not None:
+        # chunk_locate RPCs ride the coordinator link (non-important: a
+        # lost lookup is a locate timeout, which is a store fallback)
+        peer_rt.link_send = link.send
     #: task ids ever accepted, bounded: a re-delivered assignment (injected
     #: duplication, or a frame replay) must be executed at most once —
     #: idempotent task-assignment, worker-side. Cleared whenever the
@@ -1577,6 +1756,15 @@ def run_worker(
                 "nthreads": nthreads,
                 "pid": os.getpid(),
             }
+            if peer_rt is not None:
+                # advertise the peer server on the interface this worker
+                # reaches the coordinator from — the address other fleet
+                # hosts can dial
+                try:
+                    local_ip = s.getsockname()[0]
+                except OSError:
+                    local_ip = "127.0.0.1"
+                hello["peer_addr"] = peer_rt.advertised_addr(local_ip)
             if link.token is not None:
                 hello["token"] = link.token
             send_frame(s, hello)
@@ -1775,6 +1963,8 @@ def run_worker(
                 memory.arm_from_wire(msg.get("memory_guard"))
             if "spans" in msg:
                 arm_spans_from_wire(msg.get("spans"))
+            if "peer" in msg:
+                p2p.arm_from_wire(msg.get("peer"))
             if injector is not None:
                 action = injector.worker_task_tick(wname)
                 if action == "crash":
@@ -1848,19 +2038,27 @@ def run_worker(
                 # delay from a real hang. Not outbox-retained — a stale
                 # started ack is useless after a reconnect
                 link.send({"type": "started", "task_id": task_id})
-            if config is not None:
-                result, stats = execute_with_stats(
-                    function, msg["input"], config=config
-                )
-            else:
-                result, stats = execute_with_stats(function, msg["input"])
+            # collect the chunks this task writes (storage hook →
+            # transfer.note_chunk_written) so the advertisement can
+            # piggyback on the result frame; thread-local, so concurrent
+            # task slots never mix their lists
+            p2p.begin_task_produced()
+            try:
+                if config is not None:
+                    result, stats = execute_with_stats(
+                        function, msg["input"], config=config
+                    )
+                else:
+                    result, stats = execute_with_stats(function, msg["input"])
+            finally:
+                produced = p2p.end_task_produced()
             try:
                 # important: retained in the outbox and replayed across a
                 # reconnect, so a partition between finishing the task and
                 # delivering its result costs nothing
                 link.send(
                     {"type": "result", "task_id": task_id, "result": result,
-                     "stats": stats},
+                     "stats": stats, "produced": produced or None},
                     important=True,
                 )
             except Exception:
@@ -1881,7 +2079,7 @@ def run_worker(
                 )
                 link.send(
                     {"type": "result", "task_id": task_id, "result": None,
-                     "stats": stats},
+                     "stats": stats, "produced": produced or None},
                     important=True,
                 )
         except Exception as e:
@@ -1935,14 +2133,29 @@ def run_worker(
         harmless (the session token re-adopts the lease)."""
         while True:
             rss = current_measured_mem()
+            pressure = memory.pressure_level()
+            if peer_rt is not None:
+                # evict-on-pressure: the chunk cache's budget is accounted
+                # against the memory guard — under pressure the fast path
+                # yields its footprint before admission control has to
+                peer_rt.pressure_tick(pressure)
             hb = {
                 "type": "heartbeat",
                 "rss": rss,
-                "pressured": (
-                    rss is not None and memory.pressure_level() != "ok"
-                ),
+                "pressured": (rss is not None and pressure != "ok"),
                 "t0": obs_clock.now(),
             }
+            if peer_rt is not None:
+                hb["peer_cache"] = peer_rt.cache.stats()
+                # evicted chunks ride the heartbeat so the coordinator's
+                # location registry stops steering readers at them; a lost
+                # heartbeat costs a fetch-miss + store fallback, nothing
+                # more, so no ack/replay is needed
+                evicted, flush = peer_rt.cache.drain_evictions()
+                if flush:
+                    hb["peer_cache_flush"] = True
+                elif evicted:
+                    hb["peer_evicted"] = evicted
             if clock_est["offset"] is not None:
                 hb["clock_offset"] = clock_est["offset"]
                 hb["clock_rtt"] = clock_est["rtt"]
@@ -2000,6 +2213,9 @@ def run_worker(
         elif mtype == "hello_ack":
             pass  # handshake frames are consumed in _connect; a stray
             # duplicate (injected) carries nothing new
+        elif mtype == "chunk_location":
+            if peer_rt is not None:
+                peer_rt.on_location(msg)
         elif mtype == "drain":
             # graceful scale-down (or an operator-initiated drain):
             # same path as the SIGTERM handler, reason carried over
@@ -2109,6 +2325,9 @@ def run_worker(
         # nobody can receive: cancel them instead of running them out
         pool.shutdown(wait=False, cancel_futures=True)
     stop.set()  # silence the heartbeat/watchdog thread
+    if peer_rt is not None:
+        p2p.set_worker_runtime(None)
+        peer_rt.close()
     try:
         link.sock.close()
     except OSError:

@@ -33,6 +33,7 @@ from typing import Optional
 
 from ...observability import accounting
 from ...observability import logs as obs_logs
+from .. import transfer
 from ..dataflow import (
     DataflowScheduler,
     record_scheduler_mode,
@@ -43,6 +44,7 @@ from ..memory import AdmissionController
 from ..pipeline import (
     RecomputeResolver,
     ResumeState,
+    _task_chunk_key,
     pending_mappable,
     visit_node_generations,
     visit_nodes,
@@ -108,6 +110,7 @@ class DistributedDagExecutor(DagExecutor):
         task_timeout: Optional[float] = None,
         timeout_strikes: int = 2,
         lease_s: float = 15.0,
+        peer_transfer: Optional[bool] = None,
         retries: int = DEFAULT_RETRIES,
         use_backups: bool = True,
         batch_size: Optional[int] = None,
@@ -149,6 +152,11 @@ class DistributedDagExecutor(DagExecutor):
         #: how long a disconnected worker keeps its in-flight tasks before
         #: they requeue as worker loss (runtime/distributed.py leases)
         self.lease_s = lease_s
+        #: peer-to-peer chunk transfer (runtime/transfer.py): None defers
+        #: to CUBED_TPU_P2P / Spec(peer_transfer=...), the effective
+        #: default being off — the store-only data plane is the exact
+        #: historical behavior
+        self.peer_transfer = peer_transfer
         self.retries = retries
         self.use_backups = use_backups
         self.batch_size = batch_size
@@ -460,93 +468,114 @@ class DistributedDagExecutor(DagExecutor):
         resolver = RecomputeResolver(dag)
         scheduler = resolve_scheduler(spec)
         record_scheduler_mode(scheduler, executor=self.name)
-        if scheduler == "dataflow":
-            # the coordinator already routes per-item (op, task) pairs
-            # (_InterleavedPool); dataflow just widens the item set to the
-            # whole DAG and gates each on its own input chunks
-            if batch_size:
-                logger.warning(
-                    "batch_size=%s is ignored under scheduler=\"dataflow\" "
-                    "(the whole DAG is one dependency-gated map)",
-                    batch_size,
+        # peer-to-peer chunk transfer: env > Spec > executor arg > off.
+        # Armed for this compute's duration — the coordinator attaches the
+        # wire config to every task message, so pre-started fleet workers
+        # cache/advertise/fetch exactly when this compute asked for it
+        peer_on = transfer.resolve_peer_transfer(spec, self.peer_transfer)
+        record_decision(
+            "peer_transfer", enabled=peer_on, scheduler=scheduler,
+        )
+        with transfer.client_scoped(peer_on):
+            if scheduler == "dataflow":
+                # the coordinator already routes per-item (op, task) pairs
+                # (_InterleavedPool); dataflow just widens the item set to
+                # the whole DAG and gates each on its own input chunks
+                if batch_size:
+                    logger.warning(
+                        "batch_size=%s is ignored under scheduler="
+                        "\"dataflow\" (the whole DAG is one dependency-"
+                        "gated map)",
+                        batch_size,
+                    )
+                sched = DataflowScheduler(
+                    dag, resume=resume, state=state, callbacks=callbacks
                 )
-            sched = DataflowScheduler(
-                dag, resume=resume, state=state, callbacks=callbacks
-            )
-            sched.start()
-            try:
-                if sched.items:
+                sched.start()
+                try:
+                    if sched.items:
+                        map_unordered(
+                            _InterleavedPool(
+                                coord, sched.pipelines,
+                                # the chunk graph knows each task's input
+                                # chunks: dispatch scores workers by input
+                                # bytes already cache-resident (only
+                                # meaningful with the peer data plane on)
+                                locality_hints=(
+                                    sched.locality_hints() if peer_on
+                                    else None
+                                ),
+                            ),
+                            None,
+                            sched.items,
+                            retry_policy=policy,
+                            retry_budget=budget,
+                            use_backups=use_backups,
+                            callbacks=callbacks,
+                            array_names=sched.array_names,
+                            executor_name=self.name,
+                            recompute_resolver=resolver,
+                            admission=admission,
+                            dependencies=sched.dependencies,
+                            on_input_submit=sched.on_submit,
+                            on_input_done=sched.on_done,
+                        )
+                finally:
+                    sched.finish()
+            elif compute_arrays_in_parallel:
+                for generation in visit_node_generations(
+                    dag, resume=resume, state=state
+                ):
+                    merged, pipelines = merge_generation(
+                        generation, callbacks, resume=resume,
+                        resume_state=state,
+                    )
+                    if not merged:
+                        end_generation(generation, callbacks)
+                        continue
                     map_unordered(
-                        _InterleavedPool(coord, sched.pipelines),
+                        _InterleavedPool(coord, pipelines),
                         None,
-                        sched.items,
+                        merged,
                         retry_policy=policy,
                         retry_budget=budget,
                         use_backups=use_backups,
+                        batch_size=batch_size,
                         callbacks=callbacks,
-                        array_names=sched.array_names,
+                        array_names=[name for name, _ in merged],
                         executor_name=self.name,
                         recompute_resolver=resolver,
                         admission=admission,
-                        dependencies=sched.dependencies,
-                        on_input_submit=sched.on_submit,
-                        on_input_done=sched.on_done,
                     )
-            finally:
-                sched.finish()
-        elif compute_arrays_in_parallel:
-            for generation in visit_node_generations(
-                dag, resume=resume, state=state
-            ):
-                merged, pipelines = merge_generation(
-                    generation, callbacks, resume=resume, resume_state=state
-                )
-                if not merged:
                     end_generation(generation, callbacks)
-                    continue
-                map_unordered(
-                    _InterleavedPool(coord, pipelines),
-                    None,
-                    merged,
-                    retry_policy=policy,
-                    retry_budget=budget,
-                    use_backups=use_backups,
-                    batch_size=batch_size,
-                    callbacks=callbacks,
-                    array_names=[name for name, _ in merged],
-                    executor_name=self.name,
-                    recompute_resolver=resolver,
-                    admission=admission,
-                )
-                end_generation(generation, callbacks)
-        else:
-            for name, node in visit_nodes(dag, resume=resume, state=state):
-                primitive_op = node["primitive_op"]
-                pipeline = primitive_op.pipeline
-                callbacks_on(
-                    callbacks, "on_operation_start",
-                    OperationStartEvent(name, primitive_op.num_tasks),
-                )
-                mappable, _ = pending_mappable(name, node, resume, state)
-                map_unordered(
-                    _OpPool(coord, pipeline),
-                    pipeline.function,
-                    mappable,
-                    retry_policy=policy,
-                    retry_budget=budget,
-                    use_backups=use_backups,
-                    batch_size=batch_size,
-                    callbacks=callbacks,
-                    array_name=name,
-                    executor_name=self.name,
-                    recompute_resolver=resolver,
-                    admission=admission,
-                    config=pipeline.config,
-                )
-                callbacks_on(
-                    callbacks, "on_operation_end",
-                    OperationEndEvent(name, primitive_op.num_tasks),
-                )
+            else:
+                for name, node in visit_nodes(dag, resume=resume, state=state):
+                    primitive_op = node["primitive_op"]
+                    pipeline = primitive_op.pipeline
+                    callbacks_on(
+                        callbacks, "on_operation_start",
+                        OperationStartEvent(name, primitive_op.num_tasks),
+                    )
+                    mappable, _ = pending_mappable(name, node, resume, state)
+                    map_unordered(
+                        _OpPool(coord, pipeline),
+                        pipeline.function,
+                        mappable,
+                        retry_policy=policy,
+                        retry_budget=budget,
+                        use_backups=use_backups,
+                        batch_size=batch_size,
+                        callbacks=callbacks,
+                        array_name=name,
+                        executor_name=self.name,
+                        recompute_resolver=resolver,
+                        admission=admission,
+                        config=pipeline.config,
+                    )
+                    callbacks_on(
+                        callbacks, "on_operation_end",
+                        OperationEndEvent(name, primitive_op.num_tasks),
+                    )
 
 
 class _LocalWorkerFactory:
@@ -586,15 +615,29 @@ class _OpPool:
 
 class _InterleavedPool:
     """Adapter for generation-interleaved items ``(op_name, m)``: resolves
-    each item's pipeline so every op keeps its own (function, config) blob."""
+    each item's pipeline so every op keeps its own (function, config) blob.
 
-    def __init__(self, coordinator: Coordinator, pipelines: dict):
+    ``locality_hints`` (dataflow + peer transfer) maps ``(op, chunk key)``
+    to the task's input chunks so the coordinator can place it on the
+    worker already holding those bytes."""
+
+    def __init__(
+        self, coordinator: Coordinator, pipelines: dict,
+        locality_hints: Optional[dict] = None,
+    ):
         self.coordinator = coordinator
         self.pipelines = pipelines
+        self.locality_hints = locality_hints
 
     def submit(self, stats_wrapper, _fn, item, **kwargs):
         name, m = item
         pipeline = self.pipelines[name]
+        locality = None
+        if self.locality_hints is not None and isinstance(m, (tuple, list)):
+            # only blockwise out-key items have chunk keys (create-arrays
+            # and rechunk tasks carry other shapes — and no hints anyway)
+            locality = self.locality_hints.get((name, _task_chunk_key(m)))
         return self.coordinator.submit(
-            stats_wrapper, pipeline.function, m, config=pipeline.config
+            stats_wrapper, pipeline.function, m, config=pipeline.config,
+            locality=locality,
         )

@@ -153,6 +153,19 @@ class FaultConfig:
     partition_after_tasks: int = 0
     partition_duration_s: float = 2.0
     partition_direction: str = "tx"
+    #: peer-to-peer chunk-fetch faults (runtime/transfer.py), decided per
+    #: fetch on the READING worker: "drop" makes the reply vanish (store
+    #: fallback, like a timeout), "delay" sleeps peer_delay_s in the fetch
+    #: path, "corrupt" flips a bit in the fetched bytes so the CRC verify
+    #: against the authoritative manifest must catch it. peer_reset_rate
+    #: fires on the SERVING worker: the connection is closed mid-
+    #: conversation, modelling a peer dying mid-fetch. Every one of these
+    #: must resolve to a transparent store fallback — never a task failure
+    peer_drop_rate: float = 0.0
+    peer_delay_rate: float = 0.0
+    peer_delay_s: float = 0.05
+    peer_corrupt_rate: float = 0.0
+    peer_reset_rate: float = 0.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "FaultConfig":
@@ -196,6 +209,10 @@ class FaultConfig:
             or self.net_msg_delay_rate
             or self.net_reset_rate
             or (self.partition_worker_names and self.partition_after_tasks)
+            or self.peer_drop_rate
+            or self.peer_delay_rate
+            or self.peer_corrupt_rate
+            or self.peer_reset_rate
         )
 
 
@@ -329,6 +346,31 @@ class FaultInjector:
         if self._hit(f"net_{direction}_delay", key, cfg.net_msg_delay_rate):
             return "delay"
         return None
+
+    def peer_fetch_fault(self, key: str) -> Optional[str]:
+        """One seeded decision for a peer chunk fetch on the reading side:
+        ``"drop"`` (reply vanishes → store fallback), ``"corrupt"`` (a bit
+        flips in the fetched bytes — the CRC verify must catch it), or
+        ``"delay"`` (sleep ``peer_delay_s`` in the fetch path); None =
+        fetch faithfully. At most one fault per fetch, severity order."""
+        cfg = self.config
+        if not (
+            cfg.peer_drop_rate or cfg.peer_corrupt_rate or cfg.peer_delay_rate
+        ):
+            return None
+        if self._hit("peer_drop", key, cfg.peer_drop_rate):
+            return "drop"
+        if self._hit("peer_corrupt", key, cfg.peer_corrupt_rate):
+            return "corrupt"
+        if self._hit("peer_delay", key, cfg.peer_delay_rate):
+            return "delay"
+        return None
+
+    def peer_serve_reset(self, key: str) -> bool:
+        """True -> the SERVING worker closes the peer connection instead of
+        answering this chunk_get — a peer dying mid-fetch, as seen by the
+        reader (who must fall back to the store)."""
+        return self._hit("peer_reset", key, self.config.peer_reset_rate)
 
     def partitioned(self, worker_name: str, direction: str) -> bool:
         """True while ``worker_name`` is inside its injected one-way

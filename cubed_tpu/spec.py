@@ -35,6 +35,7 @@ class Spec:
         memory_guard: Optional[str] = None,
         scheduler: Optional[str] = None,
         journal: Optional[str] = None,
+        peer_transfer: Optional[bool] = None,
     ):
         self._work_dir = work_dir
         self._reserved_mem = convert_to_bytes(reserved_mem or 0)
@@ -82,6 +83,9 @@ class Spec:
                 f"{type(journal).__name__}"
             )
         self._journal = journal
+        self._peer_transfer = (
+            None if peer_transfer is None else bool(peer_transfer)
+        )
 
     @property
     def work_dir(self) -> Optional[str]:
@@ -178,6 +182,19 @@ class Spec:
         resume_compute`` rebuild coordinator state from after a client
         crash. ``None`` (the default) journals nothing."""
         return self._journal
+
+    @property
+    def peer_transfer(self) -> Optional[bool]:
+        """Peer-to-peer chunk transfer on the distributed fleet: ``True``
+        lets a consuming task fetch an input chunk directly from the worker
+        that produced it (bounded worker chunk caches + locality-aware
+        placement), falling back to the Zarr store on any miss, timeout,
+        peer death, or checksum mismatch — the store stays write-through
+        and remains the sole durable tier, so resume/journal/integrity
+        guarantees are untouched. ``None`` defers to the ``CUBED_TPU_P2P``
+        env var (operator override, wins) or the store-only default
+        (runtime/transfer.py)."""
+        return self._peer_transfer
 
     def __repr__(self) -> str:
         return (

@@ -38,6 +38,7 @@ from ..observability.accounting import (
     scope_span,
 )
 from ..observability.metrics import get_registry
+from ..runtime import transfer as p2p
 from ..runtime.faults import FaultInjectedIOError, get_injector
 from ..runtime.resilience import RetryPolicy
 from ..utils import join_path
@@ -453,6 +454,21 @@ class ZarrV2Array:
         """Read the full (padded) chunk at block index *idx*, or None if absent."""
         key = self._chunk_key(idx)
         verify = integrity.verify_reads_active()
+        if p2p.task_fetch_active():
+            # peer-fetch fast path (fleet workers, Spec/executor-armed):
+            # bytes come from the producing worker's chunk cache, verified
+            # (CRC32 + length) against the authoritative manifest entry
+            # inside fetch_chunk — a chunk without an entry, or any miss/
+            # timeout/peer-death/mismatch, returns None and the normal
+            # store read below proceeds as if the peer path didn't exist
+            entry = self._manifest()[0].get(key)
+            if entry is not None:
+                data = p2p.fetch_chunk(self.store, key, entry)
+                if data is not None:
+                    if self._codec is not None:
+                        data = self._codec[1](data)
+                    arr = np.frombuffer(data, dtype=self.dtype)
+                    return arr.reshape(self.chunks if self.shape else ())
         if not self._io.exists(key):
             if verify and key in self._manifest()[0]:
                 # the manifest says this chunk WAS written: absence is an
@@ -621,6 +637,14 @@ class ZarrV2Array:
                 if self._manifest_cache is not None:
                     self._manifest_cache[0][key] = entry
                     self._manifest_cache = (self._manifest_cache[0], True)
+                # peer-transfer hook, strictly AFTER the durable write and
+                # its checksum record: cache the stored bytes on this
+                # worker and queue the (store, key, nbytes) advertisement
+                # for the result frame. Zarr stays write-through — losing
+                # the cached copy costs a store read, never data. Only
+                # checksummed writes are cached: readers refuse peer bytes
+                # they cannot verify against the manifest
+                p2p.note_chunk_written(self.store, key, data)
         record_bytes_written(self.store, len(data))
 
     def _empty_chunk(self) -> np.ndarray:
