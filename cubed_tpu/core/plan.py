@@ -275,6 +275,16 @@ class Plan:
             from ..runtime.journal import JournalCallback
 
             all_callbacks.append(JournalCallback(journal_path))
+        # live telemetry (observability/export.py): env > Spec > off. When
+        # armed, the process-global sampler/HTTP endpoint starts (or keeps
+        # running — it outlives computes, like any scrape target) and this
+        # compute reports live progress (tasks done/total -> task rate/ETA
+        # on the /snapshot.json feed and `python -m cubed_tpu.top`)
+        from ..observability import export as telemetry_export
+        from ..observability.timeseries import ComputeProgressCallback
+
+        if telemetry_export.maybe_start(spec) is not None:
+            all_callbacks.append(ComputeProgressCallback())
         recorder_dir = os.environ.get(FLIGHT_RECORDER_ENV_VAR)
         if recorder_dir and not any(
             isinstance(cb, TraceCollector) for cb in all_callbacks

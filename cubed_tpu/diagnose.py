@@ -46,6 +46,10 @@ _TIMELINE_GROUPS = {
     # dispatches, and peer-fetch store fallbacks (runtime/transfer.py)
     "data movement": ("peer_transfer", "placement_locality",
                       "peer_fallback"),
+    # the live-telemetry alert engine's firings (observability/alerts.py);
+    # the dedicated "alerts" section above prints the same rows with their
+    # severities — this keeps them in timeline context with everything else
+    "alerts": ("alert_fired",),
 }
 
 #: the data-movement section's metric rows (manifest metrics snapshot);
@@ -160,6 +164,17 @@ def render_report(bundle: dict, timeline_limit: int = 20) -> str:
                 + (f" ({util:.0%} of projection)" if util else "")
             )
 
+    alerts = m.get("alerts") or []
+    if alerts:
+        from .observability.alerts import format_alert_row
+
+        out.append(_section(f"alerts ({len(alerts)} fired)"))
+        t0 = alerts[0].get("ts", 0)
+        for a in alerts[-timeline_limit:]:
+            out.append(
+                f"  +{(a.get('ts', 0) - t0):8.3f}s {format_alert_row(a)}"
+            )
+
     stragglers = m.get("stragglers") or []
     if stragglers:
         out.append(_section("top stragglers"))
@@ -243,6 +258,13 @@ def render_report(bundle: dict, timeline_limit: int = 20) -> str:
         out.append(_section("artifacts"))
         out.append(f"  trace.json: {n} events — open at https://ui.perfetto.dev")
         out.append(f"  logs.jsonl: {len(bundle.get('logs') or [])} structured records")
+        series = m.get("timeseries")
+        if series:
+            npts = sum(len(s.get("points") or []) for s in series)
+            out.append(
+                f"  timeseries: {len(series)} series / {npts} points "
+                "sampled over the compute window (manifest.json)"
+            )
     dropped = m.get("task_records_dropped")
     if dropped:
         out.append(f"  NOTE: {dropped} task record(s) beyond the retention "

@@ -20,9 +20,16 @@ in ``runtime/types.py``); this package turns that stream into
   client, pool and fleet-worker log line attributable to its task
   (``logs``);
 - **flight recorder**: :class:`FlightRecorder` bundles the merged trace,
-  metrics, plan projections, decision timelines and last-N logs into a
-  post-mortem directory readable by ``python -m cubed_tpu.diagnose``
-  (``flightrecorder``).
+  metrics, plan projections, decision timelines, alert timeline +
+  time-series dump and last-N logs into a post-mortem directory readable
+  by ``python -m cubed_tpu.diagnose`` (``flightrecorder``);
+- **live telemetry**: a bounded :class:`TimeSeriesStore` sampled ~1s from
+  the merged fleet view (``timeseries``), served as Prometheus
+  ``/metrics`` + ``/healthz`` + ``/snapshot.json`` by a stdlib-HTTP
+  thread armed via ``Spec(telemetry_port=...)`` /
+  ``CUBED_TPU_TELEMETRY_PORT`` (``export``), watched by an
+  :class:`AlertEngine` (``alerts``) and rendered live by
+  ``python -m cubed_tpu.top``.
 """
 
 from .accounting import (  # noqa: F401
@@ -40,11 +47,28 @@ from .collect import (  # noqa: F401
     record_decision,
     record_sample,
 )
+from .alerts import (  # noqa: F401
+    AlertEngine,
+    AlertRule,
+    BurnRateRule,
+    StallRule,
+    ThresholdRule,
+    default_rules,
+)
 from .events import EventLogCallback, PlanRow  # noqa: F401
+from .export import (  # noqa: F401
+    TelemetryRuntime,
+    prometheus_text,
+    resolve_port,
+)
 from .flightrecorder import FlightRecorder, load_bundle  # noqa: F401
 from .metrics import (  # noqa: F401
     MetricsRegistry,
     get_registry,
     merge_snapshots,
+)
+from .timeseries import (  # noqa: F401
+    TelemetrySampler,
+    TimeSeriesStore,
 )
 from .tracer import Tracer  # noqa: F401

@@ -1,0 +1,342 @@
+"""Alert rules over the live time series: threshold + burn-rate + stall.
+
+The rules run inside the telemetry sampler's ~1s tick
+(``observability/timeseries.py``), so alert latency is one sampling
+interval. A firing is never just a log line — it lands everywhere an
+operator might be looking:
+
+- the ``alerts_fired`` counter (per-rule visibility via the firing ring),
+- a ``scheduler``-lane decision (``record_decision("alert_fired", ...)``)
+  — which means the flight-recorder bundle and ``python -m
+  cubed_tpu.diagnose`` both show the alert timeline for free,
+- a structured warning on the ``cubed_tpu`` logger (compute-correlated
+  when one is running),
+- the engine's bounded firing ring, served by ``/snapshot.json`` and the
+  ``cubed_tpu.top`` dashboard.
+
+Rules fire on the rising edge (condition flips false->true) and re-fire
+while still active only after ``cooldown_s`` — a sustained condition
+reads as one alert per cooldown window, not one per second.
+
+The default rule set (:func:`default_rules`) covers the failure shapes
+the PRs so far taught the runtime to survive — so an operator sees them
+*while* the machinery absorbs them, not in the post-mortem: retry-budget
+burn, a half-pressured fleet, a straggler burst, a stalled queue, and a
+peer-fetch fallback spike.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from .metrics import get_registry
+
+logger = logging.getLogger(__name__)
+
+#: firings retained for /snapshot.json, the dashboard and the bundle
+MAX_FIRINGS = 256
+
+
+class AlertRule:
+    """One named condition over the telemetry store.
+
+    Subclasses implement ``evaluate(store, now) -> Optional[dict]``: None
+    while healthy, else a dict of firing details (at least ``value`` and
+    ``threshold``). ``severity`` is ``"warning"`` or ``"critical"``
+    (display only — every firing takes the same paths)."""
+
+    def __init__(self, name: str, description: str = "",
+                 severity: str = "warning"):
+        self.name = name
+        self.description = description
+        self.severity = severity
+
+    def evaluate(self, store, now: float) -> Optional[dict]:
+        raise NotImplementedError
+
+
+class ThresholdRule(AlertRule):
+    """Fire when a series' latest value (or its rate over ``window_s``
+    when ``rate=True``) crosses ``threshold``.
+
+    ``comparison`` is ``">="`` or ``"<="``. A missing series is healthy —
+    absence of data must not page anyone — and so is a FROZEN one: a
+    latest-value reading older than ``stale_after_s`` means its writer is
+    gone (a closed fleet, a finished compute), and a long-lived telemetry
+    endpoint must not re-fire on that fossil every cooldown forever."""
+
+    #: latest-value samples older than this are treated as no-data (the
+    #: sampler ticks at ~1s, so 10 missed writes means the writer is gone)
+    STALE_AFTER_S = 10.0
+
+    def __init__(
+        self, name: str, metric: str, threshold: float,
+        comparison: str = ">=", rate: bool = False, window_s: float = 30.0,
+        labels: Optional[dict] = None, description: str = "",
+        severity: str = "warning", stale_after_s: Optional[float] = None,
+    ):
+        super().__init__(name, description, severity)
+        if comparison not in (">=", "<="):
+            raise ValueError(
+                f"comparison must be '>=' or '<=', got {comparison!r}"
+            )
+        self.metric = metric
+        self.threshold = float(threshold)
+        self.comparison = comparison
+        self.rate = rate
+        self.window_s = float(window_s)
+        self.labels = labels
+        self.stale_after_s = (
+            self.STALE_AFTER_S if stale_after_s is None
+            else float(stale_after_s)
+        )
+
+    def evaluate(self, store, now: float) -> Optional[dict]:
+        if self.rate:
+            value = store.rate(
+                self.metric, self.window_s, labels=self.labels, now=now
+            )
+        else:
+            pt = store.latest_point(self.metric, labels=self.labels)
+            value = None
+            if pt is not None and now - pt[0] <= self.stale_after_s:
+                value = pt[1]
+        if value is None:
+            return None
+        crossed = (
+            value >= self.threshold if self.comparison == ">="
+            else value <= self.threshold
+        )
+        if not crossed:
+            return None
+        return {
+            "metric": self.metric,
+            "value": round(float(value), 6),
+            "threshold": self.threshold,
+            "comparison": self.comparison,
+            "window_s": self.window_s if self.rate else None,
+        }
+
+
+class BurnRateRule(AlertRule):
+    """Fire when a cumulative counter consumes more than ``burn_frac`` of
+    ``budget`` within ``window_s`` — the classic error-budget burn alert,
+    here sized for bounded allowances like the per-compute retry budget:
+    spending 10% of the whole allowance inside one window means the
+    failures are systemic, and the circuit breaker is where this ends."""
+
+    def __init__(
+        self, name: str, counter: str, budget: float,
+        burn_frac: float = 0.1, window_s: float = 60.0,
+        description: str = "", severity: str = "critical",
+    ):
+        super().__init__(name, description, severity)
+        self.counter = counter
+        self.budget = float(budget)
+        self.burn_frac = float(burn_frac)
+        self.window_s = float(window_s)
+
+    def evaluate(self, store, now: float) -> Optional[dict]:
+        pts = store.window(self.counter, self.window_s, now=now)
+        if len(pts) < 2:
+            return None
+        burned = max(0.0, pts[-1][1] - pts[0][1])
+        allowance = self.budget * self.burn_frac
+        if burned < max(allowance, 1.0):
+            return None
+        return {
+            "metric": self.counter,
+            "value": burned,
+            "threshold": allowance,
+            "budget": self.budget,
+            "window_s": self.window_s,
+        }
+
+
+class StallRule(AlertRule):
+    """Fire when work is queued but nothing completes: ``gauge_metric``
+    (latest) is positive while ``progress_counter`` shows zero increase
+    over ``window_s`` — the queue-depth stall shape (a wedged fleet, a
+    dead dispatch loop, an all-pressured admission floor)."""
+
+    def __init__(
+        self, name: str, gauge_metric: str = "queue_depth",
+        progress_counter: str = "tasks_completed", window_s: float = 30.0,
+        description: str = "", severity: str = "critical",
+    ):
+        super().__init__(name, description, severity)
+        self.gauge_metric = gauge_metric
+        self.progress_counter = progress_counter
+        self.window_s = float(window_s)
+
+    def evaluate(self, store, now: float) -> Optional[dict]:
+        depth = store.latest(self.gauge_metric)
+        if not depth:
+            return None
+        # the queue must have been non-empty for the WHOLE window — a
+        # queue that just filled is starting, not stalled
+        depth_pts = store.window(self.gauge_metric, self.window_s, now=now)
+        if len(depth_pts) < 2 or depth_pts[0][0] > now - self.window_s * 0.8:
+            return None
+        if any(v <= 0 for _, v in depth_pts):
+            return None
+        rate = store.rate(self.progress_counter, self.window_s, now=now)
+        # a MISSING progress series is zero progress, not health: a fleet
+        # wedged before the first task ever completes never creates the
+        # tasks_completed counter at all — and the full-window depth
+        # series above already proves the sampler covered the window
+        if rate is not None and rate > 0:
+            return None
+        return {
+            "metric": self.gauge_metric,
+            "value": depth,
+            "threshold": 0,
+            "progress_counter": self.progress_counter,
+            "window_s": self.window_s,
+        }
+
+
+def default_rules(retry_budget_hint: float = 50.0) -> list:
+    """The standing rule set, covering the runtime's known failure shapes.
+
+    ``retry_budget_hint`` sizes the burn-rate rule when no compute-specific
+    budget is known (the resilience layer sizes real budgets off the task
+    count; 50 matches a mid-sized compute's allowance)."""
+    return [
+        BurnRateRule(
+            "retry_budget_burn", counter="task_retries",
+            budget=retry_budget_hint, burn_frac=0.2, window_s=60.0,
+            description="task retries consumed >=20% of the retry budget "
+            "within a minute: failures are systemic, the circuit breaker "
+            "is next",
+        ),
+        ThresholdRule(
+            "fleet_memory_pressure", metric="fleet_pressured_fraction",
+            threshold=0.5, severity="critical",
+            description=">=50% of live fleet workers report memory "
+            "pressure: admission control is degrading throughput; raise "
+            "allowed_mem, shrink chunks, or add workers",
+        ),
+        ThresholdRule(
+            "straggler_rate", metric="stragglers_detected", rate=True,
+            threshold=0.2, window_s=30.0,
+            description="stragglers detected faster than 1 per 5s over "
+            "30s: a slow worker or skewed chunking is serializing the "
+            "compute",
+        ),
+        StallRule(
+            "queue_depth_stall",
+            description="tasks are queued but none completed for a whole "
+            "window: a wedged fleet or a dead dispatch loop",
+        ),
+        ThresholdRule(
+            "peer_fetch_fallback_spike", metric="peer_fetch_fallbacks",
+            rate=True, threshold=1.0, window_s=30.0,
+            description="peer fetches falling back to the store >1/s: "
+            "the p2p data plane is degraded (cache pressure, peer churn, "
+            "or network faults) — correctness is unaffected, the "
+            "store-read savings are gone",
+        ),
+    ]
+
+
+class AlertEngine:
+    """Evaluates rules against a :class:`TimeSeriesStore` each tick."""
+
+    def __init__(
+        self, store, rules: Optional[list] = None, cooldown_s: float = 60.0,
+    ):
+        self.store = store
+        self.rules = list(rules) if rules is not None else default_rules()
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        #: rule name -> {"active": bool, "last_fired": ts}
+        self._state = {
+            r.name: {"active": False, "last_fired": 0.0} for r in self.rules
+        }
+        self.firings: deque = deque(maxlen=MAX_FIRINGS)
+
+    def add_rule(self, rule: AlertRule) -> None:
+        with self._lock:
+            self.rules.append(rule)
+            self._state[rule.name] = {"active": False, "last_fired": 0.0}
+
+    def tick(self, now: Optional[float] = None) -> list:
+        """Evaluate every rule; returns the firings this tick produced."""
+        if now is None:
+            now = time.time()
+        fired = []
+        with self._lock:
+            rules = list(self.rules)
+        for rule in rules:
+            try:
+                details = rule.evaluate(self.store, now)
+            except Exception:
+                logger.exception("alert rule %s failed to evaluate", rule.name)
+                continue
+            state = self._state.setdefault(
+                rule.name, {"active": False, "last_fired": 0.0}
+            )
+            if details is None:
+                state["active"] = False
+                continue
+            rising = not state["active"]
+            state["active"] = True
+            if not rising and now - state["last_fired"] < self.cooldown_s:
+                continue  # sustained condition inside its cooldown window
+            state["last_fired"] = now
+            firing = self._fire(rule, details, now)
+            fired.append(firing)
+        return fired
+
+    def _fire(self, rule: AlertRule, details: dict, now: float) -> dict:
+        from .collect import record_decision
+
+        firing = {
+            "ts": now,
+            "rule": rule.name,
+            "severity": rule.severity,
+            "description": rule.description,
+        }
+        firing.update(details)
+        with self._lock:
+            self.firings.append(firing)
+        get_registry().counter("alerts_fired").inc()
+        record_decision(
+            "alert_fired", rule=rule.name, severity=rule.severity,
+            metric=details.get("metric"), value=details.get("value"),
+            threshold=details.get("threshold"),
+        )
+        logger.warning(
+            "ALERT %s [%s]: %s=%s crossed %s — %s",
+            rule.name, rule.severity, details.get("metric"),
+            details.get("value"), details.get("threshold"),
+            rule.description or "(no description)",
+        )
+        return firing
+
+    def recent(self, n: int = 50) -> list:
+        """The last ``n`` firings, oldest first."""
+        with self._lock:
+            return list(self.firings)[-n:]
+
+    def active(self) -> list:
+        """Names of rules currently in the active (condition-true) state."""
+        with self._lock:
+            return [name for name, s in self._state.items() if s["active"]]
+
+
+def format_alert_row(firing: dict) -> str:
+    """One firing as a fixed-width row — the shared format both
+    ``python -m cubed_tpu.top`` and ``python -m cubed_tpu.diagnose``
+    render (callers prepend their own timestamp/flag column)."""
+    return (
+        f"{firing.get('severity', '?'):<9}"
+        f"{firing.get('rule', '?'):<28}"
+        f"{firing.get('metric', '')}={firing.get('value', '')} "
+        f"(threshold {firing.get('threshold', '')})"
+    )

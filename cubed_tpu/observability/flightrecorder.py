@@ -11,8 +11,9 @@ directory:
     <bundle_dir>/bundle-<compute_id>/
         manifest.json   # status, error + failing op/chunk, metrics snapshot,
                         # per-op projected-vs-measured memory, coordinator
-                        # worker table, decision timeline, stragglers,
-                        # per-worker clock offsets
+                        # worker table, decision timeline, alert timeline +
+                        # time-series dump (when live telemetry was armed),
+                        # stragglers, per-worker clock offsets
         trace.json      # the merged Perfetto trace (open in ui.perfetto.dev)
         logs.jsonl      # last-N correlated structured log records
 
@@ -115,6 +116,27 @@ class FlightRecorder(TraceCollector):
             if d["kind"] == "task_failed"
         ][-50:]
 
+    def _alert_timeline(self) -> list:
+        """Alert firings recorded during this compute (the alert engine
+        lands every firing on the decision ring, so the bundle carries the
+        alert timeline even when the telemetry endpoint is gone by
+        post-mortem time)."""
+        return [
+            d for d in decisions_since(self._t0)
+            if d["kind"] == "alert_fired"
+        ]
+
+    def _timeseries_dump(self) -> Optional[list]:
+        """A bounded dump of the live time-series store covering this
+        compute's window, or None when telemetry was never armed."""
+        from .export import get_runtime
+
+        runtime = get_runtime()
+        if runtime is None:
+            return None
+        window_s = max(60.0, time.time() - self._t0 + 5.0)
+        return runtime.store.to_dict(window_s=window_s, max_points=120)
+
     def manifest(self) -> dict:
         error = self.error
         err_block = None
@@ -153,6 +175,11 @@ class FlightRecorder(TraceCollector):
                 name: t.wall_clock for name, t in self.op_timings.items()
             },
             "decisions": decisions_since(self._t0),
+            # the live-telemetry layer's post-mortem residue: every alert
+            # that fired during the compute, plus the sampled time series
+            # covering its window (None when telemetry was unarmed)
+            "alerts": self._alert_timeline(),
+            "timeseries": self._timeseries_dump(),
             "stragglers": self.stragglers(),
             "clock_offsets": self.clock_offsets(),
             "task_records": len(self._records),

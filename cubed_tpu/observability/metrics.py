@@ -48,8 +48,12 @@ The canonical metric names used across the codebase:
 
 from __future__ import annotations
 
+import logging
+import random
 import threading
 from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
 
 
 class Counter:
@@ -97,10 +101,30 @@ class Gauge:
         return self._max
 
 
-class Histogram:
-    """Streaming summary (count/sum/min/max) of an observed quantity."""
+#: bounded reservoir size for histogram quantile estimation (per
+#: histogram): sized so the p99 estimate of a 512-sample reservoir stays
+#: within a few observations of the true p99 for any stream length, at a
+#: fixed ~4KB-per-histogram memory cost
+RESERVOIR_SIZE = 512
 
-    __slots__ = ("name", "count", "sum", "min", "max", "_lock")
+#: the quantiles every histogram estimates, exported through ``summary()``
+#: (and from there ``snapshot()`` / the Prometheus ``/metrics`` endpoint)
+#: — latency SLO rules need percentiles, not just count/sum/min/max
+QUANTILES = ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"))
+
+
+class Histogram:
+    """Streaming summary (count/sum/min/max + estimated p50/p95/p99) of an
+    observed quantity.
+
+    Quantiles come from a bounded reservoir (Vitter's algorithm R,
+    ``RESERVOIR_SIZE`` samples, seeded per histogram name so replacement is
+    deterministic for a given observation order): every observation has an
+    equal chance of being retained, so the sorted reservoir's order
+    statistics estimate the stream's quantiles at fixed memory."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "_lock",
+                 "_reservoir", "_rng")
 
     def __init__(self, name: str):
         self.name = name
@@ -109,6 +133,8 @@ class Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self._lock = threading.Lock()
+        self._reservoir: list = []
+        self._rng = random.Random(name)
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -118,16 +144,40 @@ class Histogram:
                 self.min = v
             if self.max is None or v > self.max:
                 self.max = v
+            if len(self._reservoir) < RESERVOIR_SIZE:
+                self._reservoir.append(v)
+            else:
+                slot = self._rng.randrange(self.count)
+                if slot < RESERVOIR_SIZE:
+                    self._reservoir[slot] = v
+
+    def quantiles(self) -> dict:
+        """Estimated quantiles from the reservoir (empty dict when nothing
+        was observed). Keys are the ``QUANTILES`` labels (p50/p95/p99)."""
+        with self._lock:
+            sample = sorted(self._reservoir)
+        if not sample:
+            return {}
+        n = len(sample)
+        out = {}
+        for q, label in QUANTILES:
+            # nearest-rank on the retained sample
+            idx = min(n - 1, max(0, int(round(q * (n - 1)))))
+            out[label] = sample[idx]
+        return out
 
     def summary(self) -> dict:
+        q = self.quantiles()
         with self._lock:
-            return {
+            out = {
                 "count": self.count,
                 "sum": self.sum,
                 "min": self.min,
                 "max": self.max,
                 "mean": (self.sum / self.count) if self.count else None,
             }
+        out.update(q)
+        return out
 
 
 class MetricsRegistry:
@@ -145,6 +195,8 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        #: gauge keys already log-noted as dropped from snapshot_delta
+        self._delta_gauges_logged: set = set()
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -182,8 +234,14 @@ class MetricsRegistry:
             out[h.name] = h.summary()
         return out
 
-    def snapshot_delta(self, before: dict) -> dict:
+    def snapshot_delta(self, before: dict, now: Optional[dict] = None) -> dict:
         """Current snapshot minus a previous one.
+
+        ``now`` lets a caller that already took the current snapshot reuse
+        it — the heartbeat path needs the delta and the new baseline to be
+        the SAME observation, or increments landing between two internal
+        snapshots would ship twice (once in this delta, again in the
+        next).
 
         Counters and histogram count/sum/mean subtract, so the result is a
         true per-window reading. Quantities that CANNOT be windowed from two
@@ -191,13 +249,21 @@ class MetricsRegistry:
         ``_max`` key appears only if the window set a new high, a gauge's
         instantaneous value is omitted entirely (the end-of-window reading —
         e.g. ``queue_depth`` after the queue drained — measures nothing),
-        and histogram summaries omit lifetime min/max (a long-lived process
-        — persistent fleet, bench loop — must not attribute an old
-        compute's extremes to a later one)."""
-        now = self.snapshot()
+        and histogram summaries omit lifetime min/max and quantiles (a
+        long-lived process — persistent fleet, bench loop — must not
+        attribute an old compute's extremes to a later one).
+
+        Dropped gauges are NOT silent: each unwindowable gauge reading is
+        counted in the ``gauges_dropped_in_delta`` counter (and logged once
+        per key per registry), so a consumer shipping deltas — the fleet
+        heartbeat path — can see that a gauge existed and was windowed
+        away rather than never reported at all."""
+        if now is None:
+            now = self.snapshot()
         with self._lock:
             gauge_names = set(self._gauges)
         out: dict = {}
+        dropped_gauges = []
         for k, v in now.items():
             prev = before.get(k)
             if isinstance(v, dict):  # histogram summary
@@ -215,11 +281,25 @@ class MetricsRegistry:
                 if not isinstance(prev, (int, float)) or v > prev:
                     out[k] = v
             elif k in gauge_names:
+                dropped_gauges.append(k)
                 continue  # instantaneous reading: not a per-window quantity
             elif isinstance(prev, (int, float)):
                 out[k] = v - prev
             else:
                 out[k] = v
+        if dropped_gauges:
+            # count AFTER the snapshot above, so this window's delta is not
+            # perturbed by its own bookkeeping (the next window sees it)
+            self.counter("gauges_dropped_in_delta").inc(len(dropped_gauges))
+            for k in dropped_gauges:
+                if k not in self._delta_gauges_logged:
+                    self._delta_gauges_logged.add(k)
+                    logger.info(
+                        "snapshot_delta: gauge %r has no per-window value "
+                        "and is dropped from deltas (its _max rides when "
+                        "the window raises it; counted in "
+                        "gauges_dropped_in_delta)", k,
+                    )
         return out
 
     def report(self) -> str:
@@ -232,22 +312,39 @@ class MetricsRegistry:
             v = snap[k]
             if isinstance(v, dict):
                 mean = v.get("mean")
-                rows.append(
-                    (k, f"count={v['count']} sum={_fmt(v['sum'])} "
-                        f"mean={_fmt(mean)} min={_fmt(v['min'])} "
-                        f"max={_fmt(v['max'])}")
+                row = (
+                    f"count={v['count']} sum={_fmt(v['sum'])} "
+                    f"mean={_fmt(mean)} min={_fmt(v['min'])} "
+                    f"max={_fmt(v['max'])}"
                 )
+                if v.get("p50") is not None:
+                    row += (
+                        f" p50={_fmt(v['p50'])} p95={_fmt(v.get('p95'))} "
+                        f"p99={_fmt(v.get('p99'))}"
+                    )
+                rows.append((k, row))
             else:
                 rows.append((k, _fmt(v)))
         width = max(len(k) for k, _ in rows)
         lines = [f"{k.ljust(width)}  {v}" for k, v in rows]
         return "\n".join(lines)
 
+    def kinds(self) -> Dict[str, str]:
+        """Metric name -> ``"counter"`` / ``"gauge"`` / ``"histogram"`` for
+        every registered metric — what the Prometheus exposition needs to
+        emit correct ``# TYPE`` lines (``observability/export.py``)."""
+        with self._lock:
+            out: Dict[str, str] = {n: "counter" for n in self._counters}
+            out.update({n: "gauge" for n in self._gauges})
+            out.update({n: "histogram" for n in self._histograms})
+            return out
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+            self._delta_gauges_logged.clear()
 
 
 def _fmt(v) -> str:

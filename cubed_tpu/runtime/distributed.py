@@ -271,6 +271,12 @@ class _WorkerConn:
         #: latest heartbeat-reported peer-cache stats (bytes/entries/
         #: evictions) for stats_snapshot/diagnose
         self.peer_cache: Optional[dict] = None
+        #: cumulative worker-side counters, folded from the bounded
+        #: ``metrics_delta`` payloads piggybacked on heartbeat frames —
+        #: the per-worker dimension the live telemetry pipeline samples
+        #: (tasks completed, peer hits/misses, retries ... as counted
+        #: WHERE the work ran, continuously, not once at compute end)
+        self.metrics: Dict[str, float] = {}
         #: per-session secret: a reconnecting worker must present it, so a
         #: stranger claiming a live worker's name cannot steal its tasks
         self.token = uuid.uuid4().hex
@@ -364,6 +370,11 @@ class Coordinator:
         #: lists piggybacked on sequenced result frames; drives the
         #: chunk_locate RPC and locality-aware dispatch (runtime/transfer.py)
         self.chunk_registry = ChunkLocationRegistry()
+        #: fleet-wide accumulation of the workers' heartbeat metric deltas
+        #: (counters add; the per-worker split lives on each conn) — what
+        #: the telemetry sampler and stats_snapshot read as the merged
+        #: worker-side view
+        self.fleet_metrics: Dict[str, float] = {}
         #: decision-ring entries for locality placement are throttled (the
         #: counters carry the totals; the ring is bounded)
         self._locality_decisions_left = 16
@@ -379,6 +390,12 @@ class Coordinator:
         threading.Thread(
             target=self._lease_loop, name="coordinator-leases", daemon=True
         ).start()
+        # the live telemetry sampler (observability/timeseries.py) polls
+        # registered fleets for per-worker series; weak registration, so a
+        # leaked coordinator can't pin itself into the telemetry loop
+        from ..observability.timeseries import register_fleet
+
+        register_fleet(self)
 
     # -- worker management ---------------------------------------------
 
@@ -884,6 +901,7 @@ class Coordinator:
                         self.chunk_registry.remove(
                             conn.name, msg["peer_evicted"]
                         )
+                    delta = msg.get("metrics_delta")
                     with self._lock:
                         conn.rss = msg.get("rss")
                         conn.pressured = bool(msg.get("pressured"))
@@ -892,6 +910,27 @@ class Coordinator:
                         if msg.get("clock_offset") is not None:
                             conn.clock_offset = msg["clock_offset"]
                             conn.clock_rtt = msg.get("clock_rtt")
+                        if isinstance(delta, dict):
+                            # bounded per-window counter deltas shipped by
+                            # the worker: fold into the per-worker and the
+                            # fleet-wide cumulative views the telemetry
+                            # sampler reads (heartbeats are lossy by
+                            # design — a dropped frame costs one window's
+                            # increments, never correctness: the
+                            # authoritative per-compute numbers still ride
+                            # the task result stats)
+                            for k, v in delta.items():
+                                if isinstance(v, (int, float)):
+                                    conn.metrics[k] = (
+                                        conn.metrics.get(k, 0) + v
+                                    )
+                                    self.fleet_metrics[k] = (
+                                        self.fleet_metrics.get(k, 0) + v
+                                    )
+                    if isinstance(delta, dict):
+                        get_registry().counter(
+                            "heartbeat_metric_deltas"
+                        ).inc()
                     if conn.rss is not None:
                         get_registry().gauge("fleet_worker_rss_bytes").set(
                             conn.rss
@@ -1479,12 +1518,18 @@ class Coordinator:
                     "clock_offset": w.clock_offset,
                     "clock_rtt": w.clock_rtt,
                     "peer_cache": w.peer_cache,
+                    "metrics": dict(w.metrics) or None,
                 }
         out["workers"] = workers
         out["chunk_locations"] = self.chunk_registry.stats()
+        with self._lock:
+            out["fleet_metrics"] = dict(self.fleet_metrics) or None
         return out
 
     def close(self) -> None:
+        from ..observability.timeseries import unregister_fleet
+
+        unregister_fleet(self)
         self._closed.set()
         with self._lock:
             workers = list(self._workers)
@@ -1523,6 +1568,52 @@ OUTBOX_CAP = 256
 #: raise these
 RX_STALE_S = 4.0
 ACK_STALE_S = 1.5
+
+#: task-scope counters additionally folded into the WORKER's own registry
+#: (so the heartbeat metrics_delta carries a live per-worker view of
+#: them); bounded allowlist — scoped counters already reach the CLIENT
+#: registry via task stats, this fold only feeds the worker-side telemetry
+#: dimension and never crosses into client metrics
+_WORKER_FOLD_COUNTERS = (
+    "peer_hits", "peer_misses", "chunks_verified",
+    "chunks_corrupt_detected",
+)
+
+#: cap on the per-heartbeat metrics-delta payload (numeric keys): the
+#: heartbeat frame must stay kilobyte-scale whatever the metric namespace
+#: grows to; overflow keys are dropped deterministically (sorted order)
+#: and the drop is itself counted in the shipped delta
+HEARTBEAT_DELTA_MAX_KEYS = 64
+
+
+def heartbeat_metrics_delta(reg, prev_snapshot: dict) -> tuple:
+    """The bounded worker->coordinator metrics payload for one heartbeat.
+
+    Returns ``(delta_dict_or_None, new_snapshot)``: numeric per-window
+    increments only (histogram windows and gauge ``_max`` marks stay out
+    — ``snapshot_delta`` already windowed gauges away, counting them in
+    ``gauges_dropped_in_delta``, which DOES ship so a fleet gauge can
+    never vanish silently), zero increments elided, at most
+    ``HEARTBEAT_DELTA_MAX_KEYS`` keys. The delta and the returned new
+    baseline are the SAME snapshot observation — two separate snapshots
+    would ship increments landing between them twice."""
+    snap = reg.snapshot()
+    delta = reg.snapshot_delta(prev_snapshot, now=snap)
+    out = {}
+    overflow = 0
+    for k in sorted(delta):
+        v = delta[k]
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if v == 0 or k.endswith("_max"):
+            continue
+        if len(out) >= HEARTBEAT_DELTA_MAX_KEYS:
+            overflow += 1
+            continue
+        out[k] = v
+    if overflow:
+        out["heartbeat_delta_keys_dropped"] = overflow
+    return (out or None), snap
 
 
 class _WorkerLink:
@@ -2052,6 +2143,19 @@ def run_worker(
                     result, stats = execute_with_stats(function, msg["input"])
             finally:
                 produced = p2p.end_task_produced()
+            # live-telemetry residue in the WORKER's own registry: the
+            # per-worker counters the heartbeat metrics_delta ships (the
+            # authoritative per-compute numbers still ride the task stats
+            # to the client — this is the continuous, per-worker view).
+            # Scoped counters deliberately bypass the local registry
+            # (accounting.record_scoped_counter), so a bounded allowlist
+            # is folded here where the worker identity is known
+            reg = get_registry()
+            reg.counter("worker_tasks_executed").inc()
+            for key in _WORKER_FOLD_COUNTERS:
+                v = (stats.get("counters") or {}).get(key)
+                if isinstance(v, (int, float)) and v:
+                    reg.counter(key).inc(int(v))
             try:
                 # important: retained in the outbox and replayed across a
                 # reconnect, so a partition between finishing the task and
@@ -2083,6 +2187,7 @@ def run_worker(
                     important=True,
                 )
         except Exception as e:
+            get_registry().counter("worker_task_errors").inc()
             try:
                 link.send(
                     {"type": "error", "task_id": task_id,
@@ -2130,7 +2235,14 @@ def run_worker(
         partition, a silently dead TCP stream). The watchdog then closes
         the socket, forcing the main recv loop into its reconnect path;
         against a healthy coordinator a spurious reconnect is cheap and
-        harmless (the session token re-adopts the lease)."""
+        harmless (the session token re-adopts the lease).
+
+        Since the live-telemetry PR each heartbeat also piggybacks a
+        bounded ``metrics_delta`` — this process's counter increments
+        since the previous heartbeat — so the coordinator's telemetry
+        pipeline sees worker-side progress continuously instead of once
+        per task result."""
+        hb_metrics_prev = get_registry().snapshot()
         while True:
             rss = current_measured_mem()
             pressure = memory.pressure_level()
@@ -2159,6 +2271,11 @@ def run_worker(
             if clock_est["offset"] is not None:
                 hb["clock_offset"] = clock_est["offset"]
                 hb["clock_rtt"] = clock_est["rtt"]
+            delta, hb_metrics_prev = heartbeat_metrics_delta(
+                get_registry(), hb_metrics_prev
+            )
+            if delta is not None:
+                hb["metrics_delta"] = delta
             link.send(hb)  # link failures heal via the recv loop's reconnect
             if (
                 not stop.is_set()

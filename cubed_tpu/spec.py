@@ -36,6 +36,7 @@ class Spec:
         scheduler: Optional[str] = None,
         journal: Optional[str] = None,
         peer_transfer: Optional[bool] = None,
+        telemetry_port: Optional[int] = None,
     ):
         self._work_dir = work_dir
         self._reserved_mem = convert_to_bytes(reserved_mem or 0)
@@ -86,6 +87,14 @@ class Spec:
         self._peer_transfer = (
             None if peer_transfer is None else bool(peer_transfer)
         )
+        if telemetry_port is not None:
+            telemetry_port = int(telemetry_port)
+            if telemetry_port < 0 or telemetry_port > 65535:
+                raise ValueError(
+                    f"telemetry_port must be 0-65535 (0 = ephemeral), got "
+                    f"{telemetry_port}"
+                )
+        self._telemetry_port = telemetry_port
 
     @property
     def work_dir(self) -> Optional[str]:
@@ -195,6 +204,19 @@ class Spec:
         env var (operator override, wins) or the store-only default
         (runtime/transfer.py)."""
         return self._peer_transfer
+
+    @property
+    def telemetry_port(self) -> Optional[int]:
+        """Live-telemetry HTTP port: arming it makes ``Plan.execute``
+        start the process-global telemetry pipeline — a ~1s fleet/metrics
+        sampler feeding a bounded time-series store, a Prometheus
+        ``/metrics`` + ``/healthz`` + ``/snapshot.json`` endpoint on this
+        port (``0`` = ephemeral), and the alert-rule engine; read it live
+        with ``python -m cubed_tpu.top``. ``None`` defers to the
+        ``CUBED_TPU_TELEMETRY_PORT`` env var (operator override, wins;
+        ``off`` disables) or the off default
+        (observability/export.py)."""
+        return self._telemetry_port
 
     def __repr__(self) -> str:
         return (

@@ -1,0 +1,238 @@
+"""Live fleet dashboard: ``python -m cubed_tpu.top [host:port]``.
+
+Renders the telemetry endpoint's ``/snapshot.json`` (armed via
+``Spec(telemetry_port=...)`` or ``CUBED_TPU_TELEMETRY_PORT``; see
+``docs/observability.md`` "Live telemetry") as a refreshing terminal
+view:
+
+- a **fleet table** — one row per worker: connectivity, draining/
+  pressured flags, RSS, load (outstanding/threads), lifetime tasks,
+  peer-cache footprint and hit rate;
+- **compute progress** — tasks done/total with a live task rate and ETA
+  (rate from the ``compute_tasks_done`` series' trailing window);
+- **recent alerts** — the alert engine's last firings, active ones
+  flagged.
+
+``--once`` prints a single refresh and exits (scripts, tests);
+``--interval`` sets the refresh period. The endpoint defaults to
+``127.0.0.1:$CUBED_TPU_TELEMETRY_PORT``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Optional
+from urllib.request import urlopen
+
+from .observability.alerts import format_alert_row
+from .utils import memory_repr
+
+#: ANSI clear-screen + cursor-home (suppressed when stdout is not a tty)
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def fetch_snapshot(endpoint: str, timeout: float = 5.0) -> dict:
+    """GET ``http://<endpoint>/snapshot.json`` and parse it."""
+    if "://" not in endpoint:
+        endpoint = f"http://{endpoint}"
+    with urlopen(f"{endpoint}/snapshot.json", timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _series_rate(snapshot: dict, name: str, labels: dict,
+                 window_s: float = 30.0) -> Optional[float]:
+    """Per-second rate of one dumped series over its trailing window."""
+    for row in snapshot.get("series") or []:
+        if row.get("name") != name or row.get("labels") != labels:
+            continue
+        pts = row.get("points") or []
+        now = snapshot.get("ts") or time.time()
+        pts = [p for p in pts if p[0] >= now - window_s]
+        if len(pts) < 2 or pts[-1][0] <= pts[0][0]:
+            return None
+        return max(0.0, (pts[-1][1] - pts[0][1]) / (pts[-1][0] - pts[0][0]))
+    return None
+
+
+def _fmt_mem(v) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    return memory_repr(int(v))
+
+
+def _fmt_eta(seconds) -> str:
+    if seconds is None or seconds != seconds or seconds < 0:
+        return "-"
+    seconds = int(seconds)
+    if seconds >= 3600:
+        return f"{seconds // 3600}h{(seconds % 3600) // 60:02d}m"
+    if seconds >= 60:
+        return f"{seconds // 60}m{seconds % 60:02d}s"
+    return f"{seconds}s"
+
+
+def _worker_hit_rate(row: dict) -> str:
+    metrics = row.get("metrics") or {}
+    hits = metrics.get("peer_hits") or 0
+    misses = metrics.get("peer_misses") or 0
+    if not hits and not misses:
+        return "-"
+    return f"{hits / (hits + misses):.0%}"
+
+
+def render(snapshot: dict, width: int = 100) -> str:
+    """One dashboard frame from a ``/snapshot.json`` payload."""
+    out: list = []
+    ts = snapshot.get("ts")
+    stamp = (
+        time.strftime("%H:%M:%S", time.localtime(ts))
+        if isinstance(ts, (int, float)) else "-"
+    )
+    fleet = snapshot.get("fleet") or {}
+    metrics = snapshot.get("metrics") or {}
+    out.append(
+        f"cubed_tpu.top  {stamp}  workers {fleet.get('workers_live', 0)} "
+        f"({fleet.get('workers_pressured', 0)} pressured, "
+        f"{fleet.get('workers_disconnected', 0)} disconnected)  "
+        f"tasks_completed {metrics.get('tasks_completed', 0)}  "
+        f"alerts_fired {metrics.get('alerts_fired', 0)}"
+    )
+    out.append("=" * width)
+
+    # -- fleet table ---------------------------------------------------
+    workers = (fleet.get("workers") or {})
+    out.append(
+        f"{'WORKER':<16}{'STATE':<14}{'RSS':>10}{'LOAD':>8}"
+        f"{'TASKS':>8}{'CACHE':>10}{'HIT%':>6}  CLOCK"
+    )
+    if not workers:
+        out.append("  (no live workers — is a fleet running?)")
+    for name in sorted(workers):
+        row = workers[name]
+        state = "up"
+        if not row.get("connected", True):
+            state = "disconnected"
+        elif row.get("draining"):
+            state = "draining"
+        elif row.get("pressured"):
+            state = "pressured"
+        nthreads = row.get("nthreads") or 1
+        load = f"{row.get('outstanding', 0)}/{nthreads}"
+        cache = row.get("peer_cache") or {}
+        off = row.get("clock_offset")
+        clock = f"{off:+.3f}s" if isinstance(off, (int, float)) else "-"
+        out.append(
+            f"{name:<16}{state:<14}{_fmt_mem(row.get('rss')):>10}"
+            f"{load:>8}{row.get('tasks_sent', 0):>8}"
+            f"{_fmt_mem(cache.get('bytes')):>10}"
+            f"{_worker_hit_rate(row):>6}  {clock}"
+        )
+    out.append("")
+
+    # -- compute progress ----------------------------------------------
+    out.append("COMPUTES")
+    computes = snapshot.get("computes") or []
+    if not computes:
+        out.append("  (none tracked)")
+    for row in computes[-5:]:
+        done = row.get("tasks_done") or 0
+        total = row.get("tasks_total") or 0
+        # retries/backup twins can complete more attempts than the plan
+        # has tasks: clamp so the bar (and percentage) never overflow
+        frac = min(1.0, done / total) if total else 0.0
+        bar_w = 24
+        filled = min(bar_w, int(round(frac * bar_w)))
+        bar = "#" * filled + "-" * (bar_w - filled)
+        rate = _series_rate(
+            snapshot, "compute_tasks_done",
+            {"compute": row.get("compute_id")},
+        )
+        eta = None
+        if rate and total:
+            eta = (total - done) / rate
+        status = row.get("status") or "?"
+        line = (
+            f"  {row.get('compute_id', '?'):<16}[{bar}] "
+            f"{done}/{total} ({frac:.0%}) {status}"
+        )
+        if status == "running":
+            line += (
+                f"  {rate:.1f} tasks/s  ETA {_fmt_eta(eta)}"
+                if rate else "  rate - ETA -"
+            )
+        out.append(line)
+    out.append("")
+
+    # -- alerts --------------------------------------------------------
+    active = set(snapshot.get("alerts_active") or [])
+    alerts = snapshot.get("alerts") or []
+    out.append(f"ALERTS ({len(active)} active)")
+    if not alerts:
+        out.append("  (none fired)")
+    for firing in alerts[-8:]:
+        fts = firing.get("ts")
+        fstamp = (
+            time.strftime("%H:%M:%S", time.localtime(fts))
+            if isinstance(fts, (int, float)) else "-"
+        )
+        flag = "*" if firing.get("rule") in active else " "
+        out.append(f" {flag}{fstamp} {format_alert_row(firing)}")
+    return "\n".join(out) + "\n"
+
+
+def default_endpoint() -> str:
+    port = os.environ.get("CUBED_TPU_TELEMETRY_PORT", "").strip()
+    if not port or port in ("0", "off"):
+        port = "9090"
+    return f"127.0.0.1:{port}"
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m cubed_tpu.top", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "endpoint", nargs="?", default=None,
+        help="telemetry endpoint host:port (default "
+        "127.0.0.1:$CUBED_TPU_TELEMETRY_PORT)",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh period in seconds (default 2)",
+    )
+    parser.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit",
+    )
+    args = parser.parse_args(argv)
+    endpoint = args.endpoint or default_endpoint()
+    while True:
+        try:
+            snapshot = fetch_snapshot(endpoint)
+        except Exception as e:
+            print(
+                f"cannot reach telemetry endpoint {endpoint}: {e}\n"
+                "arm it with Spec(telemetry_port=...) or "
+                "CUBED_TPU_TELEMETRY_PORT on the client process",
+                file=sys.stderr,
+            )
+            return 2
+        frame = render(snapshot)
+        if args.once:
+            sys.stdout.write(frame)
+            return 0
+        sys.stdout.write((_CLEAR if sys.stdout.isatty() else "") + frame)
+        sys.stdout.flush()
+        try:
+            time.sleep(max(0.2, args.interval))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,295 @@
+"""Alert-rule engine tests: rule units, engine edge/cooldown semantics,
+and the chaos proof — a seeded retry burn fires the matching rule with the
+firing visible in the decision ring, the flight-recorder bundle, and
+``python -m cubed_tpu.diagnose`` output."""
+
+from __future__ import annotations
+
+import tempfile
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+from cubed_tpu.diagnose import render_report
+from cubed_tpu.observability.alerts import (
+    AlertEngine,
+    BurnRateRule,
+    StallRule,
+    ThresholdRule,
+    default_rules,
+)
+from cubed_tpu.observability.collect import decisions_since
+from cubed_tpu.observability.flightrecorder import FlightRecorder, load_bundle
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.observability.timeseries import TimeSeriesStore
+
+# ---------------------------------------------------------------------------
+# rule units
+# ---------------------------------------------------------------------------
+
+
+def test_threshold_rule_latest_value():
+    store = TimeSeriesStore()
+    rule = ThresholdRule("mem", metric="fleet_pressured_fraction", threshold=0.5)
+    assert rule.evaluate(store, 100.0) is None  # no data = healthy
+    store.record("fleet_pressured_fraction", 0.25, ts=99.0)
+    assert rule.evaluate(store, 100.0) is None
+    store.record("fleet_pressured_fraction", 0.5, ts=100.0)
+    details = rule.evaluate(store, 100.0)
+    assert details is not None
+    assert details["value"] == 0.5 and details["threshold"] == 0.5
+
+
+def test_threshold_rule_rate_mode():
+    store = TimeSeriesStore()
+    rule = ThresholdRule(
+        "stragglers", metric="stragglers_detected", rate=True,
+        threshold=0.2, window_s=30.0,
+    )
+    store.record("stragglers_detected", 0, ts=70.0)
+    store.record("stragglers_detected", 1, ts=80.0)
+    # 1 in 10s = 0.1/s < 0.2 threshold
+    assert rule.evaluate(store, 80.0) is None
+    store.record("stragglers_detected", 7, ts=90.0)
+    details = rule.evaluate(store, 90.0)
+    assert details is not None and details["value"] >= 0.2
+
+
+def test_threshold_rule_ignores_frozen_series():
+    """A latest-value reading whose writer is gone (no samples for longer
+    than the staleness bound) is no-data, not a standing alert — the
+    long-lived telemetry singleton must not re-fire on a closed fleet's
+    fossil reading every cooldown forever."""
+    store = TimeSeriesStore()
+    rule = ThresholdRule("mem", metric="fleet_pressured_fraction", threshold=0.5)
+    store.record("fleet_pressured_fraction", 0.9, ts=100.0)
+    assert rule.evaluate(store, 105.0) is not None  # fresh: fires
+    assert rule.evaluate(store, 100.0 + rule.stale_after_s + 1) is None
+
+
+def test_threshold_rule_rejects_bad_comparison():
+    with pytest.raises(ValueError):
+        ThresholdRule("x", metric="m", threshold=1, comparison="==")
+
+
+def test_burn_rate_rule():
+    store = TimeSeriesStore()
+    rule = BurnRateRule(
+        "retry_burn", counter="task_retries", budget=100,
+        burn_frac=0.1, window_s=60.0,
+    )
+    store.record("task_retries", 0, ts=0.0)
+    store.record("task_retries", 5, ts=30.0)
+    assert rule.evaluate(store, 30.0) is None  # 5 < 10% of 100
+    store.record("task_retries", 12, ts=40.0)
+    details = rule.evaluate(store, 40.0)
+    assert details is not None
+    assert details["value"] == 12 and details["threshold"] == 10.0
+
+
+def test_stall_rule_fires_only_on_sustained_stall():
+    store = TimeSeriesStore()
+    rule = StallRule("stall", window_s=30.0)
+    # queued work, completions advancing: healthy
+    for t in range(0, 40, 5):
+        store.record("queue_depth", 4, ts=float(t))
+        store.record("tasks_completed", t, ts=float(t))
+    assert rule.evaluate(store, 39.0) is None
+    # queued work, completions frozen across the whole window: stalled
+    store2 = TimeSeriesStore()
+    for t in range(0, 40, 5):
+        store2.record("queue_depth", 4, ts=float(t))
+        store2.record("tasks_completed", 7, ts=float(t))
+    details = rule.evaluate(store2, 39.0)
+    assert details is not None and details["value"] == 4
+    # a fleet wedged before the FIRST task ever completes never creates
+    # the tasks_completed series at all — missing progress is zero
+    # progress, not health (the depth series proves sampler coverage)
+    store2b = TimeSeriesStore()
+    for t in range(0, 40, 5):
+        store2b.record("queue_depth", 4, ts=float(t))
+    assert rule.evaluate(store2b, 39.0) is not None
+    # a queue that only JUST filled is starting, not stalled
+    store3 = TimeSeriesStore()
+    store3.record("queue_depth", 4, ts=38.0)
+    store3.record("queue_depth", 4, ts=39.0)
+    store3.record("tasks_completed", 7, ts=38.0)
+    store3.record("tasks_completed", 7, ts=39.0)
+    assert rule.evaluate(store3, 39.0) is None
+    # an empty queue is never a stall
+    assert rule.evaluate(TimeSeriesStore(), 39.0) is None
+
+
+def test_default_rules_cover_the_documented_shapes():
+    names = {r.name for r in default_rules()}
+    assert names == {
+        "retry_budget_burn", "fleet_memory_pressure", "straggler_rate",
+        "queue_depth_stall", "peer_fetch_fallback_spike",
+    }
+
+
+# ---------------------------------------------------------------------------
+# engine semantics
+# ---------------------------------------------------------------------------
+
+
+def _pressure_store(frac: float, ts: float = 100.0) -> TimeSeriesStore:
+    store = TimeSeriesStore()
+    store.record("fleet_pressured_fraction", frac, ts=ts)
+    return store
+
+
+def test_engine_fires_on_rising_edge_and_counts():
+    store = _pressure_store(0.75)
+    engine = AlertEngine(
+        store,
+        rules=[ThresholdRule("mem", metric="fleet_pressured_fraction",
+                             threshold=0.5)],
+    )
+    reg = get_registry()
+    before = reg.snapshot()
+    t0 = 100.0
+    fired = engine.tick(now=t0)
+    assert len(fired) == 1
+    firing = fired[0]
+    assert firing["rule"] == "mem" and firing["value"] == 0.75
+    assert engine.active() == ["mem"]
+    # visible in the counter AND the decision ring
+    assert reg.snapshot_delta(before).get("alerts_fired") == 1
+    ring = [d for d in decisions_since(0) if d["kind"] == "alert_fired"]
+    assert ring and ring[-1]["rule"] == "mem"
+    # the firing ring serves the dashboard
+    assert engine.recent()[-1]["rule"] == "mem"
+
+
+def test_engine_cooldown_suppresses_sustained_condition():
+    store = _pressure_store(0.9, ts=100.0)
+    engine = AlertEngine(
+        store, cooldown_s=60.0,
+        rules=[ThresholdRule("mem", metric="fleet_pressured_fraction",
+                             threshold=0.5)],
+    )
+    assert len(engine.tick(now=100.0)) == 1
+    store.record("fleet_pressured_fraction", 0.9, ts=101.0)
+    assert engine.tick(now=101.0) == []  # still active, inside cooldown
+    store.record("fleet_pressured_fraction", 0.9, ts=161.0)
+    assert len(engine.tick(now=161.0)) == 1  # re-fires after cooldown
+    # condition clears, then returns: rising edge fires immediately
+    store.record("fleet_pressured_fraction", 0.1, ts=162.0)
+    assert engine.tick(now=162.0) == []
+    assert engine.active() == []
+    store.record("fleet_pressured_fraction", 0.9, ts=163.0)
+    assert len(engine.tick(now=163.0)) == 1
+
+
+def test_engine_survives_a_broken_rule():
+    class _Broken(ThresholdRule):
+        def evaluate(self, store, now):
+            raise RuntimeError("boom")
+
+    store = _pressure_store(0.9)
+    engine = AlertEngine(
+        store,
+        rules=[
+            _Broken("broken", metric="x", threshold=1),
+            ThresholdRule("mem", metric="fleet_pressured_fraction",
+                          threshold=0.5),
+        ],
+    )
+    fired = engine.tick(now=100.0)
+    assert [f["rule"] for f in fired] == ["mem"]
+
+
+# ---------------------------------------------------------------------------
+# chaos: a seeded retry burn fires retry_budget_burn, visible in the
+# decision ring, the flight-recorder bundle, and diagnose output
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_retry_burn_fires_alert_into_ring_bundle_and_diagnose(
+    tmp_path, monkeypatch,
+):
+    pytest.importorskip("jax")
+    from cubed_tpu.observability import export
+
+    export.shutdown()
+    monkeypatch.delenv(export.TELEMETRY_PORT_ENV_VAR, raising=False)
+    spec = ct.Spec(
+        work_dir=str(tmp_path / "work"), allowed_mem="500MB",
+        telemetry_port=0,
+        # seeded storage flakiness: every retry draws task_retries up —
+        # the same deterministic chaos shape test_chaos proves correctness
+        # under; here it exists to burn the retry budget visibly
+        fault_injection={"storage_read_failure_rate": 0.25, "seed": 7},
+    )
+    fr = FlightRecorder(bundle_dir=str(tmp_path / "bundles"), always=True)
+    an = np.arange(144.0).reshape(12, 12)
+    a = ct.from_array(an, chunks=(3, 3), spec=spec)
+    r = ct.map_blocks(lambda x: x + 2.0, a, dtype=np.float64)
+    retries_before = get_registry().snapshot().get("task_retries", 0)
+    try:
+        from cubed_tpu.runtime.executors.python_async import (
+            AsyncPythonDagExecutor,
+        )
+
+        result = np.asarray(
+            r.compute(callbacks=[fr], executor=AsyncPythonDagExecutor())
+        )
+        np.testing.assert_array_equal(result, an + 2.0)
+        rt = export.get_runtime()
+        assert rt is not None, "telemetry never armed"
+        # a tight burn rule over the live series (the default 20%-of-50
+        # allowance would need a bigger storm than a unit test wants)
+        rt.alert_engine.rules = [
+            BurnRateRule(
+                "retry_budget_burn", counter="task_retries", budget=10,
+                burn_frac=0.1, window_s=300.0,
+            ),
+        ]
+        rt.alert_engine._state = {
+            "retry_budget_burn": {"active": False, "last_fired": 0.0}
+        }
+        retries = get_registry().snapshot().get("task_retries", 0)
+        assert retries - retries_before > 0, (
+            "seeded flakiness produced no retries"
+        )
+        # bracket the burn deterministically: the pre-compute baseline
+        # (the tick the 1s sampler would have taken had the compute not
+        # armed telemetry itself) plus one live tick at the current value
+        import time as _time
+
+        rt.store.record(
+            "task_retries", retries_before, ts=_time.time() - 30.0
+        )
+        # the sampler tick runs the engine itself — exactly the live path
+        rt.sampler.sample_once()
+        fired = rt.alert_engine.recent()
+        assert [f["rule"] for f in fired] == ["retry_budget_burn"]
+        assert rt.alert_engine.active() == ["retry_budget_burn"]
+        # 1) the decision ring carries the firing
+        ring = [
+            d for d in decisions_since(0) if d["kind"] == "alert_fired"
+            and d["rule"] == "retry_budget_burn"
+        ]
+        assert ring, "alert firing missing from the decision ring"
+        # 2) the flight-recorder bundle carries the alert timeline and the
+        #    time-series dump
+        bundle_path = fr.dump()
+        bundle = load_bundle(bundle_path)
+        manifest = bundle["manifest"]
+        alerts = manifest.get("alerts") or []
+        assert any(a.get("rule") == "retry_budget_burn" for a in alerts), (
+            manifest.get("alerts")
+        )
+        series = manifest.get("timeseries") or []
+        assert any(s["name"] == "task_retries" for s in series), (
+            [s["name"] for s in series][:10]
+        )
+        # 3) diagnose renders the alerts section
+        report = render_report(bundle)
+        assert "alerts" in report
+        assert "retry_budget_burn" in report
+        assert "timeseries:" in report
+    finally:
+        export.shutdown()

@@ -84,6 +84,106 @@ def test_reset_clears_everything():
 
 
 # ---------------------------------------------------------------------------
+# histogram quantiles (bounded reservoir)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_quantiles_exact_under_reservoir_size():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat")
+    for i in range(100):
+        h.observe(float(i))
+    s = reg.snapshot()["lat"]
+    assert s["p50"] == 50.0 or abs(s["p50"] - 49.0) <= 1
+    assert abs(s["p95"] - 94.0) <= 1
+    assert abs(s["p99"] - 98.0) <= 1
+
+
+def test_histogram_quantiles_estimate_long_streams_bounded():
+    from cubed_tpu.observability.metrics import RESERVOIR_SIZE
+
+    reg = MetricsRegistry()
+    h = reg.histogram("lat")
+    for i in range(20 * RESERVOIR_SIZE):
+        h.observe(float(i % 1000))
+    # the reservoir never grows past its bound
+    assert len(h._reservoir) == RESERVOIR_SIZE
+    s = h.summary()
+    # uniform 0..999: estimates land near the true quantiles
+    assert 350 <= s["p50"] <= 650
+    assert 850 <= s["p95"] <= 1000
+    assert 900 <= s["p99"] <= 1000
+    # count/sum stay exact regardless of sampling
+    assert s["count"] == 20 * RESERVOIR_SIZE
+
+
+def test_histogram_quantiles_empty_and_single():
+    reg = MetricsRegistry()
+    assert reg.histogram("h").quantiles() == {}
+    assert reg.snapshot() == {"h": {
+        "count": 0, "sum": 0.0, "min": None, "max": None, "mean": None,
+    }}
+    reg.histogram("h").observe(3.5)
+    s = reg.snapshot()["h"]
+    assert s["p50"] == s["p95"] == s["p99"] == 3.5
+
+
+def test_histogram_quantiles_deterministic_per_name():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    for i in range(5000):
+        a.histogram("h").observe(float(i))
+        b.histogram("h").observe(float(i))
+    assert a.histogram("h")._reservoir == b.histogram("h")._reservoir
+
+
+def test_quantiles_stay_out_of_windowed_deltas():
+    # like lifetime min/max, quantiles are lifetime estimates: a later
+    # window must not inherit them
+    reg = MetricsRegistry()
+    reg.histogram("h").observe(1.0)
+    before = reg.snapshot()
+    reg.histogram("h").observe(2.0)
+    delta = reg.snapshot_delta(before)
+    assert "p50" not in delta["h"] and "p99" not in delta["h"]
+
+
+# ---------------------------------------------------------------------------
+# gauges dropped from deltas are counted, not silent
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_delta_counts_dropped_gauges():
+    reg = MetricsRegistry()
+    reg.counter("c").inc(1)
+    reg.gauge("g1").set(5)
+    reg.gauge("g2").set(7)
+    before = reg.snapshot()
+    delta = reg.snapshot_delta(before)
+    # both gauges were windowed away: counted on the registry for the
+    # NEXT window (this delta itself is not perturbed by its bookkeeping)
+    assert "gauges_dropped_in_delta" not in delta
+    assert reg.snapshot()["gauges_dropped_in_delta"] == 2
+    delta2 = reg.snapshot_delta(reg.snapshot())
+    assert reg.snapshot()["gauges_dropped_in_delta"] == 4
+    assert "g1" not in delta2 and "g2" not in delta2
+
+
+def test_snapshot_delta_logs_dropped_gauge_once_per_key(caplog):
+    import logging
+
+    reg = MetricsRegistry()
+    reg.gauge("queue_depth").set(3)
+    with caplog.at_level(logging.INFO, logger="cubed_tpu.observability.metrics"):
+        reg.snapshot_delta(reg.snapshot())
+        reg.snapshot_delta(reg.snapshot())
+    notes = [
+        r for r in caplog.records if "queue_depth" in r.getMessage()
+        and "dropped from deltas" in r.getMessage()
+    ]
+    assert len(notes) == 1  # once per key, not once per delta
+
+
+# ---------------------------------------------------------------------------
 # byte accounting
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,118 @@
+"""``python -m cubed_tpu.top`` dashboard tests: frame rendering from a
+canned snapshot, and one full refresh against a live endpoint."""
+
+from __future__ import annotations
+
+import time
+
+from cubed_tpu import top
+from cubed_tpu.observability.export import TelemetryRuntime
+
+
+def _snapshot(ts=None):
+    ts = ts or time.time()
+    return {
+        "ts": ts,
+        "metrics": {"tasks_completed": 42, "alerts_fired": 2},
+        "fleet": {
+            "workers_live": 2,
+            "workers_pressured": 1,
+            "workers_disconnected": 0,
+            "workers": {
+                "local-0": {
+                    "alive": True, "connected": True, "pressured": False,
+                    "nthreads": 2, "outstanding": 1, "tasks_sent": 20,
+                    "rss": 150 * 2**20,
+                    "peer_cache": {"bytes": 32 * 2**20},
+                    "clock_offset": 0.002,
+                    "metrics": {"peer_hits": 9, "peer_misses": 1},
+                },
+                "local-1": {
+                    "alive": True, "connected": False, "pressured": True,
+                    "nthreads": 2, "outstanding": 0, "tasks_sent": 22,
+                    "rss": None, "peer_cache": None, "clock_offset": None,
+                    "metrics": None,
+                },
+            },
+        },
+        "computes": [
+            {"compute_id": "c-done", "tasks_done": 8, "tasks_total": 8,
+             "status": "succeeded", "started_at": ts - 60,
+             "ended_at": ts - 30},
+            {"compute_id": "c-live", "tasks_done": 30, "tasks_total": 100,
+             "status": "running", "started_at": ts - 10, "ended_at": None},
+        ],
+        "alerts": [
+            {"ts": ts - 5, "rule": "fleet_memory_pressure",
+             "severity": "critical", "metric": "fleet_pressured_fraction",
+             "value": 0.5, "threshold": 0.5},
+        ],
+        "alerts_active": ["fleet_memory_pressure"],
+        "series": [
+            {"name": "compute_tasks_done", "labels": {"compute": "c-live"},
+             "points": [[ts - 10, 0], [ts - 5, 15], [ts, 30]]},
+        ],
+    }
+
+
+def test_render_fleet_table_progress_and_alerts():
+    frame = top.render(_snapshot())
+    # fleet table: both workers, state flags, RSS, load, hit rate
+    assert "local-0" in frame and "local-1" in frame
+    assert "disconnected" in frame  # local-1's state (pressured is masked)
+    assert "157.3 MB" in frame  # 150 MiB rendered decimal by memory_repr
+    assert "1/2" in frame  # outstanding/threads
+    assert "90%" in frame  # peer cache hit rate 9/(9+1)
+    # compute progress: fraction, bar, rate + ETA from the series
+    assert "c-live" in frame and "30/100" in frame and "30%" in frame
+    assert "tasks/s" in frame and "ETA" in frame
+    assert "succeeded" in frame  # the finished compute stays listed
+    # alerts: the firing with its active flag
+    assert "fleet_memory_pressure" in frame and "critical" in frame
+    assert "ALERTS (1 active)" in frame
+
+
+def test_render_empty_snapshot_is_graceful():
+    frame = top.render({"ts": time.time(), "metrics": {}, "fleet": {},
+                        "computes": [], "alerts": [], "series": []})
+    assert "no live workers" in frame
+    assert "(none tracked)" in frame
+    assert "(none fired)" in frame
+
+
+def test_render_eta_formats():
+    assert top._fmt_eta(None) == "-"
+    assert top._fmt_eta(30) == "30s"
+    assert top._fmt_eta(90) == "1m30s"
+    assert top._fmt_eta(4000) == "1h06m"
+
+
+def test_series_rate_uses_trailing_window():
+    snap = _snapshot(ts=1000.0)
+    rate = top._series_rate(
+        snap, "compute_tasks_done", {"compute": "c-live"}, window_s=30.0
+    )
+    assert rate == 3.0  # 30 tasks over 10s
+    assert top._series_rate(snap, "missing", {}) is None
+
+
+def test_main_once_renders_from_live_endpoint(capsys):
+    rt = TelemetryRuntime(port=0)
+    rt.start()
+    try:
+        rt.sampler.sample_once()
+        rc = top.main([f"127.0.0.1:{rt.port}", "--once"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "cubed_tpu.top" in out
+        assert "WORKER" in out and "COMPUTES" in out and "ALERTS" in out
+    finally:
+        rt.stop()
+
+
+def test_main_unreachable_endpoint_fails_with_hint(capsys):
+    rc = top.main(["127.0.0.1:9", "--once"])  # port 9: discard, nothing there
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "cannot reach telemetry endpoint" in err
+    assert "CUBED_TPU_TELEMETRY_PORT" in err

@@ -768,3 +768,92 @@ def test_all_draining_fleet_submit_waits_for_replacement():
     finally:
         coord.backfill_grace_s = 0.0
         coord.close()
+
+
+# ----------------------------------------------------------------------
+# heartbeat metrics-delta shipping (live telemetry, observability PR)
+# ----------------------------------------------------------------------
+
+
+def test_heartbeat_metrics_delta_is_bounded_numeric_and_nonzero():
+    from cubed_tpu.observability.metrics import MetricsRegistry
+    from cubed_tpu.runtime.distributed import (
+        HEARTBEAT_DELTA_MAX_KEYS,
+        heartbeat_metrics_delta,
+    )
+
+    reg = MetricsRegistry()
+    reg.counter("worker_tasks_executed").inc(3)
+    reg.counter("untouched").inc(0)
+    reg.gauge("peer_cache_bytes").set(123)
+    reg.histogram("op_wall_clock_s").observe(0.5)
+    delta, snap = heartbeat_metrics_delta(reg, {})
+    assert delta["worker_tasks_executed"] == 3
+    # gauges are windowed away by snapshot_delta — but NOT silently: the
+    # drop is counted and the counter ships on the NEXT heartbeat (the
+    # bookkeeping lands after the delta's own snapshot), so a fleet gauge
+    # can never vanish without a trace (the satellite fix this PR carries)
+    assert "peer_cache_bytes" not in delta
+    # histogram summaries (dicts) and zero increments stay off the wire
+    assert "op_wall_clock_s" not in delta and "untouched" not in delta
+    delta2, _ = heartbeat_metrics_delta(reg, snap)
+    assert delta2 is not None
+    assert delta2.get("gauges_dropped_in_delta", 0) >= 1
+    assert set(delta2) <= {"gauges_dropped_in_delta"}
+    # the key cap holds whatever the metric namespace grows to
+    for i in range(2 * HEARTBEAT_DELTA_MAX_KEYS):
+        reg.counter(f"m{i:04d}").inc()
+    delta3, _ = heartbeat_metrics_delta(reg, snap)
+    payload_keys = [
+        k for k in delta3 if k != "heartbeat_delta_keys_dropped"
+    ]
+    assert len(payload_keys) <= HEARTBEAT_DELTA_MAX_KEYS
+    assert delta3["heartbeat_delta_keys_dropped"] > 0
+
+
+def test_fleet_heartbeats_fold_worker_metrics_into_coordinator(tmp_path):
+    """End to end: worker subprocesses count task executions in their own
+    registries, heartbeats ship the deltas, and the coordinator's
+    per-worker + fleet-wide accumulators carry them (what the telemetry
+    sampler and `cubed_tpu.top` read)."""
+    from cubed_tpu.observability.metrics import get_registry
+
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+    an = np.arange(64.0).reshape(8, 8)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    r = ct.map_blocks(_inc_one, a, dtype=np.float64)
+    reg = get_registry()
+    before = reg.snapshot()
+    ex = DistributedDagExecutor(n_local_workers=2)
+    try:
+        ex._ensure_fleet()
+        result = np.asarray(r.compute(executor=ex))
+        np.testing.assert_array_equal(result, an + 1.0)
+        # wait for the next heartbeat round to deliver the final deltas
+        deadline = time.monotonic() + 15
+        total = 0
+        while time.monotonic() < deadline:
+            snap = ex._coordinator.stats_snapshot()
+            total = (snap.get("fleet_metrics") or {}).get(
+                "worker_tasks_executed", 0
+            )
+            if total >= 17:  # 16 map tasks + create-arrays
+                break
+            time.sleep(0.2)
+        assert total >= 17, snap.get("fleet_metrics")
+        workers = snap["workers"]
+        per_worker = [
+            (w.get("metrics") or {}).get("worker_tasks_executed", 0)
+            for w in workers.values() if w.get("alive")
+        ]
+        assert sum(per_worker) == total
+        assert all(v > 0 for v in per_worker)  # both workers reported
+    finally:
+        ex.close()
+    # the coordinator counted the delta frames it folded
+    delta = reg.snapshot_delta(before)
+    assert delta.get("heartbeat_metric_deltas", 0) >= 2
+
+
+def _inc_one(x):
+    return x + 1.0

@@ -10,8 +10,11 @@ Tests that need the guard to actually *measure* (a readable
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
+import itertools
 import pickle
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -323,6 +326,12 @@ def test_map_resource_aborts_actionably_at_concurrency_one():
     def always_oom(i, config=None):
         with lock:
             calls["n"] += 1
+        # hold the slot briefly so sibling submissions are provably in
+        # flight when the first RESOURCE failure halves the admission
+        # window — without it, whether any submission ever has to WAIT
+        # (tasks_throttled) is a thread-timing race that loses under a
+        # loaded container
+        time.sleep(0.05)
         raise MemoryGuardExceededError(
             f"task {i} measured 999 > 10", chunk_key=str(i),
             measured=999, allowed=10,
@@ -393,6 +402,29 @@ SPIKE = dict(
 )
 
 
+@contextlib.contextmanager
+def _pinned_plan_names(base: int):
+    """Make a seeded spike test independent of suite ordering.
+
+    Injector decisions hash ``(seed, site, chunk key, occurrence)``, and
+    chunk keys embed gensym'd array names drawn from a PROCESS-GLOBAL
+    counter — so which tasks spike depends on how many arrays every
+    earlier test in the session happened to create. The
+    degrade-and-complete tests' determinism argument (seeded pressure
+    recedes on re-roll) only holds for a fixed key set: pin the counter
+    for this plan's construction, then resume it exactly where the
+    natural flow would have landed so no downstream test's names move."""
+    from cubed_tpu import utils as ct_utils
+
+    resume_at = next(ct_utils.sym_counter)  # the id natural flow would use
+    ct_utils.sym_counter = itertools.count(base)
+    try:
+        yield
+    finally:
+        used = next(ct_utils.sym_counter) - base
+        ct_utils.sym_counter = itertools.count(resume_at + used)
+
+
 class _StatsCapture:
     stats: dict = {}
 
@@ -423,14 +455,15 @@ def test_chaos_threaded_mem_spikes_degrade_and_complete(tmp_path):
         fault_injection=SPIKE, memory_guard="enforce",
     )
     an = np.arange(400, dtype=np.float64).reshape(20, 20)
-    a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 100 tasks
     cap = _StatsCapture()
-    result = xp.add(a, 1.0).compute(
-        executor=AsyncPythonDagExecutor(
-            retry_policy=RetryPolicy(retries=6, backoff_base=0.005, seed=0)
-        ),
-        callbacks=[cap],
-    )
+    with _pinned_plan_names(900_000_000):
+        a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 100 tasks
+        result = xp.add(a, 1.0).compute(
+            executor=AsyncPythonDagExecutor(
+                retry_policy=RetryPolicy(retries=6, backoff_base=0.005, seed=0)
+            ),
+            callbacks=[cap],
+        )
     _assert_degraded_and_correct(cap, result, an + 1.0)
 
 
@@ -456,15 +489,18 @@ def test_chaos_multiprocess_mem_spikes_degrade_and_complete(tmp_path):
         memory_guard="enforce",
     )
     an = np.arange(100, dtype=np.float64).reshape(10, 10)
-    a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 25 tasks
     cap = _StatsCapture()
-    result = xp.add(a, 3.0).compute(
-        executor=MultiprocessDagExecutor(
-            max_workers=1,
-            retry_policy=RetryPolicy(retries=6, backoff_base=0.005, seed=0),
-        ),
-        callbacks=[cap],
-    )
+    with _pinned_plan_names(910_000_000):
+        a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 25 tasks
+        result = xp.add(a, 3.0).compute(
+            executor=MultiprocessDagExecutor(
+                max_workers=1,
+                retry_policy=RetryPolicy(
+                    retries=6, backoff_base=0.005, seed=0
+                ),
+            ),
+            callbacks=[cap],
+        )
     _assert_degraded_and_correct(cap, result, an + 3.0, local_inject=False)
 
 
@@ -493,8 +529,9 @@ def test_chaos_distributed_mem_spikes_degrade_and_complete(tmp_path):
         worker_threads=2,
         retry_policy=RetryPolicy(retries=6, backoff_base=0.005, seed=0),
     ) as ex:
-        a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 64 tasks
-        result = xp.add(a, 1.0).compute(executor=ex, callbacks=[cap])
+        with _pinned_plan_names(920_000_000):
+            a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 64 tasks
+            result = xp.add(a, 1.0).compute(executor=ex, callbacks=[cap])
     _assert_degraded_and_correct(cap, result, an + 1.0, local_inject=False)
 
 
