@@ -784,6 +784,126 @@ def measure_telemetry_overhead(timeout: float):
         return None
 
 
+#: multi-tenant service bench: N synthetic tenants sustaining submissions
+#: against one threaded service — QPS, latency quantiles, fairness
+MT_TENANTS = 3
+MT_REQUESTS_PER_TENANT = 8
+MT_REPEAT_EVERY = 4  # every 4th submission repeats an earlier query
+
+MULTITENANT_SERVICE = r"""
+import json, os, sys, tempfile, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import cubed_tpu as ct
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+from cubed_tpu.service import ComputeService
+
+TENANTS = {tenants!r}
+R = {requests!r}
+REPEAT = {repeat!r}
+
+an = np.arange(64 * 64, dtype=np.float64).reshape(64, 64)
+spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="2GB")
+
+
+def build(k):
+    def kernel(x, _k=float(k)):
+        return x + _k
+
+    a = ct.from_array(an, chunks=(16, 16), spec=spec)
+    return ct.map_blocks(kernel, a, dtype=np.float64)
+
+
+reg = get_registry()
+before = reg.snapshot()
+svc = ComputeService(
+    executor=AsyncPythonDagExecutor(), max_concurrent=2,
+).start()
+handles = []
+t0 = time.perf_counter()
+try:
+    for i in range(R):
+        for t in range(TENANTS):
+            # every REPEAT-th submission repeats that tenant's first
+            # query: the sustained mix exercises the plan/result caches
+            k = (t * 1000) + (0 if (i and i % REPEAT == 0) else i)
+            handles.append(
+                (svc.submit(build(k), tenant=f"tenant-{{t}}"), t, k)
+            )
+    for h, t, k in handles:
+        val = h.result(timeout=600)
+        assert (val == an + float(k)).all()
+    elapsed = time.perf_counter() - t0
+finally:
+    svc.close()
+
+lat = sorted(
+    (h._request.ended_at - h._request.submitted_at) for h, _, _ in handles
+)
+per_tenant = {{}}
+for h, t, _ in handles:
+    per_tenant.setdefault(t, []).append(h._request.ended_at)
+# per-tenant throughput over the tenant's own submit->last-done window
+tps = {{
+    t: len(ends) / max(1e-9, max(ends) - t0)
+    for t, ends in per_tenant.items()
+}}
+delta = reg.snapshot_delta(before)
+n = len(handles)
+print(json.dumps({{
+    "elapsed": elapsed,
+    "requests": n,
+    "qps": n / max(1e-9, elapsed),
+    "p50_s": lat[n // 2],
+    "p99_s": lat[min(n - 1, (n * 99) // 100)],
+    "fairness_ratio": max(tps.values()) / max(1e-9, min(tps.values())),
+    "plan_cache_hits": delta.get("plan_cache_hits", 0),
+    "result_cache_hits": delta.get("result_cache_hits", 0),
+}}), flush=True)
+"""
+
+
+def measure_multitenant_service(timeout: float):
+    """Sustained submissions from N synthetic tenants against one
+    threaded service: QPS, p50/p99 request latency, and the fairness
+    ratio (max/min per-tenant throughput; 1.0 = perfectly fair under the
+    equal weights used here). Recorded as ``multitenant_service`` in
+    BENCH_METRICS.json — ``elapsed`` and ``qps`` ride the >20% perf gate.
+    Returns None on failure — additive, never the reason a bench run
+    dies."""
+    script = MULTITENANT_SERVICE.format(
+        repo=REPO, tenants=MT_TENANTS, requests=MT_REQUESTS_PER_TENANT,
+        repeat=MT_REPEAT_EVERY,
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            env=_scrubbed_cpu_env(),
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"multitenant service failed (rc={out.returncode}): "
+                f"{out.stderr[-2000:]}"
+            )
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        print(
+            f"multitenant service: {res['requests']} requests in "
+            f"{res['elapsed']:.2f}s ({res['qps']:.1f} QPS, p50 "
+            f"{res['p50_s'] * 1000:.0f}ms, p99 {res['p99_s'] * 1000:.0f}ms, "
+            f"fairness {res['fairness_ratio']:.2f}, "
+            f"{res['result_cache_hits']} result-cache hit(s))",
+            file=sys.stderr, flush=True,
+        )
+        return res
+    except Exception as e:
+        print(f"multitenant service sweep skipped: {e}", file=sys.stderr)
+        return None
+
+
 def _scrubbed_cpu_env() -> dict:
     """Tunnel-free env: no plugin-gating vars, ONE CPU device.
 
@@ -1216,6 +1336,17 @@ def main() -> None:
         print("telemetry overhead sweep skipped: out of budget",
               file=sys.stderr)
 
+    # multi-tenant service: sustained submissions from N synthetic
+    # tenants (QPS, p50/p99 latency, fairness ratio, cache hits) — the
+    # front-door overhead number the service is on the hook for
+    if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 45:
+        mt = measure_multitenant_service(_remaining(90))
+        if mt is not None:
+            metrics_record["multitenant_service"] = mt
+    else:
+        print("multitenant service sweep skipped: out of budget",
+              file=sys.stderr)
+
     # per-op timing / IO-byte trajectories ride alongside the headline
     # numbers so future rounds can localize regressions without re-profiling
     prev_trajectory = _previous_trajectory()
@@ -1441,6 +1572,22 @@ def perf_regressions(prev: dict, cur: dict) -> list:
                     f"{old_pe:.2f}s ({pct:+.1f}%)"
                 )
             continue
+        if name == "multitenant_service":
+            # the front door must not rot: QPS dropping >20% or p99
+            # latency growing >20% both gate (elapsed rides the generic
+            # wall check below like every other config)
+            pct = _delta_pct(cfg.get("qps"), old.get("qps"))
+            if pct is not None and pct <= -PERF_GATE_THRESHOLD_PCT:
+                out.append(
+                    f"multitenant_service QPS {cfg['qps']:.1f} vs "
+                    f"{old['qps']:.1f} ({pct:+.1f}%)"
+                )
+            pct = _delta_pct(cfg.get("p99_s"), old.get("p99_s"))
+            if pct is not None and pct >= PERF_GATE_THRESHOLD_PCT:
+                out.append(
+                    f"multitenant_service p99 {cfg['p99_s']:.3f}s vs "
+                    f"{old['p99_s']:.3f}s ({pct:+.1f}%)"
+                )
         pct = _delta_pct(cfg.get("elapsed"), old.get("elapsed"))
         if pct is not None and pct >= PERF_GATE_THRESHOLD_PCT:
             out.append(
@@ -1545,6 +1692,55 @@ def _print_p2p_deltas(cur: dict, old: dict, label: str) -> None:
         )
 
 
+def _print_multitenant_deltas(cur: dict, old: dict, label: str) -> None:
+    """Multi-tenant service trajectory: QPS, latency quantiles, fairness,
+    with a LOUD flag on the shared gate rules (QPS drop / p99 growth /
+    wall regression) and on a fairness ratio leaving its bound."""
+    qps = cur.get("qps")
+    fr = cur.get("fairness_ratio")
+    if isinstance(qps, (int, float)):
+        print(
+            f"trajectory multitenant_service: {qps:.1f} QPS, p50 "
+            f"{(cur.get('p50_s') or 0) * 1000:.0f}ms, p99 "
+            f"{(cur.get('p99_s') or 0) * 1000:.0f}ms, fairness "
+            f"{(fr or 0):.2f}, {cur.get('result_cache_hits', 0)} "
+            "result-cache hit(s)",
+            file=sys.stderr,
+        )
+        if isinstance(fr, (int, float)) and fr > 2.0:
+            print(
+                "SERVICE FAIRNESS REGRESSION: max/min per-tenant "
+                f"throughput ratio {fr:.2f} exceeds the 2.0 bound for "
+                "equal-weight tenants",
+                file=sys.stderr,
+            )
+    else:
+        print("trajectory multitenant_service: incomplete record",
+              file=sys.stderr)
+    if not old:
+        print("trajectory multitenant_service: no prior record to compare "
+              f"against in {label}" if label else
+              "trajectory multitenant_service: first record",
+              file=sys.stderr)
+        return
+    regressed = perf_regressions(
+        {"configs": {"multitenant_service": old}},
+        {"configs": {"multitenant_service": cur}},
+    )
+    if regressed:
+        print(
+            f"SERVICE REGRESSION (>{PERF_GATE_THRESHOLD_PCT:.0f}% vs "
+            + (label or "prior record") + "): " + "; ".join(regressed),
+            file=sys.stderr,
+        )
+    else:
+        print(
+            f"trajectory multitenant_service: within "
+            f"{PERF_GATE_THRESHOLD_PCT:.0f}% of {label}",
+            file=sys.stderr,
+        )
+
+
 def _print_trajectory_deltas(metrics_record: dict, prev_trajectory) -> None:
     """One line per config vs the previous trajectory (stderr — stdout's
     last line belongs to the driver), so the bench history stops being
@@ -1569,6 +1765,11 @@ def _print_trajectory_deltas(metrics_record: dict, prev_trajectory) -> None:
         if metric == "p2p_transfer":
             _print_p2p_deltas(cur, old if isinstance(old, dict) else {},
                               label)
+            continue
+        if metric == "multitenant_service":
+            _print_multitenant_deltas(
+                cur, old if isinstance(old, dict) else {}, label
+            )
             continue
         if not isinstance(old, dict):
             print(f"trajectory {metric}: new config (no prior record in "

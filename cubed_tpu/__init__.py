@@ -36,6 +36,8 @@ from . import array_api  # noqa: F401
 from .array_api import Array  # noqa: F401  (reference: cubed/__init__.py)
 from . import observability  # noqa: F401
 from . import random  # noqa: F401
+from . import service  # noqa: F401
+from .service import ComputeService, ServiceConfig  # noqa: F401
 
 __all__ = [
     "__version__",
@@ -63,4 +65,7 @@ __all__ = [
     "array_api",
     "observability",
     "random",
+    "service",
+    "ComputeService",
+    "ServiceConfig",
 ]

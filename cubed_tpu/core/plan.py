@@ -228,6 +228,7 @@ class Plan:
         resume_from_journal: Optional[str] = None,
         array_names: Optional[tuple] = None,
         spec=None,
+        finalized: Optional["FinalizedPlan"] = None,
         **kwargs,
     ) -> None:
         if executor is None:
@@ -245,7 +246,13 @@ class Plan:
             resume = True
             kwargs["journal"] = load_journal(resume_from_journal)
 
-        finalized = self._finalize(optimize_graph, optimize_function, array_names)
+        if finalized is None:
+            finalized = self._finalize(
+                optimize_graph, optimize_function, array_names
+            )
+        # else: a pre-finalized plan (the service's structural plan cache)
+        # skips optimization + lazy-array creation entirely; the caller is
+        # responsible for the fingerprint match that makes this sound
         dag = finalized.dag
 
         # every compute carries an aggregator: it folds per-task stats

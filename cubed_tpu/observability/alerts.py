@@ -200,6 +200,56 @@ class StallRule(AlertRule):
         }
 
 
+class TenantStarvationRule(AlertRule):
+    """Fire when any tenant has queued work for a whole window with zero
+    completions — the multi-tenant stall shape (a wedged service
+    dispatcher, a fair-share weight misconfigured to ~0, or every slot
+    pinned by another tenant's long computes).
+
+    Evaluates every ``tenant_queued{tenant=...}`` series the telemetry
+    sampler maintains (one per tenant the service has seen), so new
+    tenants are covered the tick they first queue work. A starving tenant
+    must show a positive queue across the ENTIRE window while its
+    ``tenant_completed`` counter shows no increase."""
+
+    def __init__(
+        self, name: str = "tenant_starvation", window_s: float = 30.0,
+        description: str = "", severity: str = "critical",
+    ):
+        super().__init__(name, description, severity)
+        self.window_s = float(window_s)
+
+    def evaluate(self, store, now: float) -> Optional[dict]:
+        starving = []
+        worst = 0.0
+        for sname, labels, _latest in store.latest_series():
+            if sname != "tenant_queued" or "tenant" not in labels:
+                continue
+            pts = store.window(sname, self.window_s, labels=labels, now=now)
+            # queued for the WHOLE window (same discipline as StallRule:
+            # a queue that just filled is starting, not starved)
+            if len(pts) < 2 or pts[0][0] > now - self.window_s * 0.8:
+                continue
+            if any(v <= 0 for _, v in pts):
+                continue
+            rate = store.rate(
+                "tenant_completed", self.window_s, labels=labels, now=now,
+            )
+            if rate is not None and rate > 0:
+                continue
+            starving.append(labels["tenant"])
+            worst = max(worst, pts[-1][1])
+        if not starving:
+            return None
+        return {
+            "metric": "tenant_queued",
+            "value": worst,
+            "threshold": 0,
+            "tenants": sorted(starving),
+            "window_s": self.window_s,
+        }
+
+
 def default_rules(retry_budget_hint: float = 50.0) -> list:
     """The standing rule set, covering the runtime's known failure shapes.
 
@@ -240,6 +290,12 @@ def default_rules(retry_budget_hint: float = 50.0) -> list:
             "the p2p data plane is degraded (cache pressure, peer churn, "
             "or network faults) — correctness is unaffected, the "
             "store-read savings are gone",
+        ),
+        TenantStarvationRule(
+            description="a tenant has had queued requests for a whole "
+            "window with zero completions: check the service dispatcher, "
+            "the tenant's quota weight, and whether another tenant's "
+            "long computes hold every admission slot",
         ),
     ]
 

@@ -45,6 +45,7 @@ from .timeseries import (
     TimeSeriesStore,
     compute_progress,
     fleet_view,
+    service_view,
 )
 
 logger = logging.getLogger(__name__)
@@ -355,6 +356,7 @@ class TelemetryRuntime:
             "port": self.port,
             "metrics": get_registry().snapshot(),
             "fleet": fleet_view(),
+            "service": service_view(),
             "computes": compute_progress(),
             "alerts": self.alert_engine.recent(),
             "alerts_active": self.alert_engine.active(),

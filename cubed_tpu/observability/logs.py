@@ -54,23 +54,60 @@ def current_compute_id() -> Optional[str]:
     return os.environ.get(COMPUTE_ID_ENV_VAR) or None
 
 
+#: serializes env-var export/restore across concurrently running computes
+_env_export_lock = threading.Lock()
+#: compute ids currently exported by a LIVE scope in this process — so an
+#: exiting scope can tell a live sibling's id (restore it) from a dead
+#: one (drop it) when exits happen out of order
+_live_exports: set = set()
+#: every id any scope in this process ever exported — a "previous" value
+#: NOT in here came from outside (an operator/parent-process pin) and is
+#: always restorable
+_ever_exported: set = set()
+
+
 @contextmanager
 def compute_scope(compute_id: str, export_env: bool = False):
     """Bind the compute id for a block (and, with ``export_env``, for every
-    child process spawned inside it — how pool workers inherit it)."""
+    child process spawned inside it — how pool workers inherit it).
+
+    The contextvar is per-thread, so concurrent computes on different
+    threads (the multi-tenant service) see only their own id. The env
+    export is inherently process-global: concurrent exporters are
+    last-writer-wins (children spawned meanwhile inherit whichever id is
+    current), but exit is guarded two ways — a scope only touches the
+    variable if it still holds ITS OWN id, and it only restores the
+    previous value when that value is still a live scope's export (or an
+    external pin); a finished sibling's id is dropped, never resurrected.
+    """
     token = compute_id_var.set(compute_id)
-    prev_env = os.environ.get(COMPUTE_ID_ENV_VAR)
-    if export_env:
-        os.environ[COMPUTE_ID_ENV_VAR] = compute_id
+    with _env_export_lock:
+        prev_env = os.environ.get(COMPUTE_ID_ENV_VAR)
+        if export_env:
+            os.environ[COMPUTE_ID_ENV_VAR] = compute_id
+            _live_exports.add(compute_id)
+            if len(_ever_exported) >= 4096:
+                # bounded: after a reset, an out-of-order exit degrades to
+                # the old restore-the-previous behavior at worst
+                _ever_exported.clear()
+                _ever_exported.update(_live_exports)
+            _ever_exported.add(compute_id)
     try:
         yield
     finally:
         compute_id_var.reset(token)
         if export_env:
-            if prev_env is None:
-                os.environ.pop(COMPUTE_ID_ENV_VAR, None)
-            else:
-                os.environ[COMPUTE_ID_ENV_VAR] = prev_env
+            with _env_export_lock:
+                _live_exports.discard(compute_id)
+                if os.environ.get(COMPUTE_ID_ENV_VAR) == compute_id:
+                    restorable = prev_env is not None and (
+                        prev_env in _live_exports
+                        or prev_env not in _ever_exported
+                    )
+                    if restorable:
+                        os.environ[COMPUTE_ID_ENV_VAR] = prev_env
+                    else:
+                        os.environ.pop(COMPUTE_ID_ENV_VAR, None)
 
 
 @contextmanager

@@ -260,6 +260,56 @@ def live_fleets() -> list:
         return [c for c in _fleets if not c._closed.is_set()]
 
 
+#: live ComputeServices (weak, like fleets); registered by
+#: ComputeService.start — the sampler derives the per-tenant series
+#: (tenant_queued/tenant_running/tenant_completed, labelled by tenant)
+#: and /snapshot.json's "service" section from these
+_services: "weakref.WeakSet" = weakref.WeakSet()
+_services_lock = threading.Lock()
+
+
+def register_service(service) -> None:
+    with _services_lock:
+        _services.add(service)
+
+
+def unregister_service(service) -> None:
+    with _services_lock:
+        _services.discard(service)
+
+
+def live_services() -> list:
+    with _services_lock:
+        return [s for s in _services if not s.closed]
+
+
+def service_view() -> Optional[dict]:
+    """Merged per-tenant service table for ``/snapshot.json`` and the
+    dashboard; None while no service is live."""
+    views = []
+    for svc in live_services():
+        try:
+            views.append(svc.stats_snapshot())
+        except Exception:
+            continue
+    if not views:
+        return None
+    if len(views) == 1:
+        return views[0]
+    merged = {
+        "tenants": {}, "queue_depth": 0, "running": 0, "slots": 0,
+        "throttling": any(v.get("throttling") for v in views),
+        "durable": any(v.get("durable") for v in views),
+        "service_dir": None, "plan_cache": None, "result_cache": None,
+    }
+    for v in views:
+        merged["tenants"].update(v.get("tenants") or {})
+        merged["queue_depth"] += v.get("queue_depth") or 0
+        merged["running"] += v.get("running") or 0
+        merged["slots"] += v.get("slots") or 0
+    return merged
+
+
 #: active (and a few recent) computes: compute_id -> progress dict
 _computes_lock = threading.Lock()
 _computes: "OrderedDict[str, dict]" = OrderedDict()
@@ -419,6 +469,7 @@ class TelemetrySampler:
         self._sample_registry(reg, now)
         self._sample_fleets(now)
         self._sample_computes(now)
+        self._sample_services(now)
         reg.counter("telemetry_samples").inc()
         self.last_sample_ts = now
         if self.alert_engine is not None:
@@ -533,6 +584,34 @@ class TelemetrySampler:
                 (pressured / live) if live else 0.0, ts=now,
             )
             self.store.record("fleet_queue_depth", queue, ts=now)
+
+    def _sample_services(self, now: float) -> None:
+        """Per-tenant series from every live ComputeService: queue depth
+        and running count as gauges, completions as a cumulative counter —
+        what the ``tenant_starvation`` alert rule and the dashboard's
+        TENANTS panel read."""
+        for svc in live_services():
+            try:
+                snap = svc.stats_snapshot()
+            except Exception:
+                continue
+            for tenant, row in (snap.get("tenants") or {}).items():
+                labels = {"tenant": tenant}
+                self.store.record(
+                    "tenant_queued", row.get("queued"), ts=now, labels=labels,
+                )
+                self.store.record(
+                    "tenant_running", row.get("running"), ts=now,
+                    labels=labels,
+                )
+                self.store.record(
+                    "tenant_completed", row.get("completed"), ts=now,
+                    labels=labels,
+                )
+                self.store.record(
+                    "tenant_throttled_total", row.get("throttled"), ts=now,
+                    labels=labels,
+                )
 
     def _sample_computes(self, now: float) -> None:
         for row in compute_progress():

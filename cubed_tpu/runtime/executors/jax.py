@@ -42,6 +42,7 @@ import contextlib
 import itertools
 import logging
 import math
+import threading
 import time
 from collections import Counter
 from typing import Any, Dict, Iterator, Optional
@@ -933,7 +934,10 @@ class JaxExecutor(DagExecutor):
         # reuses the compiled program WITHOUT re-tracing (the dominant warm
         # cost); store paths/seeds are re-bound positionally
         skey = self._structural_key(ops, dag, in_keys, resident, keep_list, seeded)
-        cached_struct = _STRUCT_CACHE.get(skey) if skey is not None else None
+        with _CACHE_LOCK:
+            cached_struct = (
+                _STRUCT_CACHE.get(skey) if skey is not None else None
+            )
         if cached_struct is not None:
             compiled, footprint = cached_struct
             self.stats["segment_struct_hits"] += 1
@@ -991,15 +995,17 @@ class JaxExecutor(DagExecutor):
             key = hashlib.sha256(fingerprint.encode()).hexdigest()
         except Exception:
             key = None
-        cached = _SEGMENT_CACHE.get(key) if key is not None else None
+        with _CACHE_LOCK:
+            cached = _SEGMENT_CACHE.get(key) if key is not None else None
         if cached is None:
             compiled = lowered.compile()
             self.stats["segments_compiled"] += 1
             footprint = _hbm_footprint(compiled)
             if key is not None:
-                if len(_SEGMENT_CACHE) >= 64:
-                    _SEGMENT_CACHE.pop(next(iter(_SEGMENT_CACHE)))
-                _SEGMENT_CACHE[key] = (compiled, footprint)
+                with _CACHE_LOCK:
+                    if len(_SEGMENT_CACHE) >= 64:
+                        _SEGMENT_CACHE.pop(next(iter(_SEGMENT_CACHE)))
+                    _SEGMENT_CACHE[key] = (compiled, footprint)
         else:
             compiled, footprint = cached
             self.stats["segment_cache_hits"] += 1
@@ -1008,9 +1014,10 @@ class JaxExecutor(DagExecutor):
                 self.stats.get("segment_hbm_footprint", 0), footprint
             )
         if skey is not None:
-            if len(_STRUCT_CACHE) >= 64:
-                _STRUCT_CACHE.pop(next(iter(_STRUCT_CACHE)))
-            _STRUCT_CACHE[skey] = (compiled, footprint)
+            with _CACHE_LOCK:
+                if len(_STRUCT_CACHE) >= 64:
+                    _STRUCT_CACHE.pop(next(iter(_STRUCT_CACHE)))
+                _STRUCT_CACHE[skey] = (compiled, footprint)
         outs = compiled(in_vals, base_vals)
         for store, value in zip(keep_list, outs):
             self._admit(resident, store, value, keep[store], budget)
@@ -1835,6 +1842,12 @@ _STRUCT_CACHE: Dict[str, Any] = {}
 
 #: debugging hook: set to a list to collect normalized fingerprint payloads
 _STRUCT_DEBUG: Optional[list] = None
+
+#: guards the two module-level program caches: concurrent computes (the
+#: multi-tenant service drives Plan.execute from many threads) would
+#: otherwise interleave the size-check/evict/insert sequences and could
+#: evict an entry a sibling just read or resurrect one past the bound
+_CACHE_LOCK = threading.Lock()
 
 
 def _hbm_footprint(compiled) -> int:

@@ -77,28 +77,34 @@ class ComputeJournal:
         self._f = open(self.path, "ab")
         self._lock = threading.Lock()
 
-    def append(self, kind: str, fsync: bool = True, **fields) -> None:
+    def append(self, kind: str, fsync: bool = True, **fields) -> bool:
+        """Append one record; returns True once it is durably written.
+
+        Failures never raise (journaling is additive: a full disk
+        degrades resume granularity, it must not fail the compute) — but
+        the return value lets a caller whose record is LOAD-BEARING (the
+        service's ``accepted`` records promise recoverability) refuse to
+        make promises the file doesn't back."""
         record = {"kind": kind, "t": time.time()}
         record.update(fields)
         try:
             line = (json.dumps(record, default=str) + "\n").encode()
         except (TypeError, ValueError):
             logger.warning("unserializable journal record dropped: %r", kind)
-            return
+            return False
         with self._lock:
             if self._f is None:
-                return
+                return False
             try:
                 self._f.write(line)
                 self._f.flush()
                 if fsync:
                     os.fsync(self._f.fileno())
             except OSError as e:
-                # journaling is additive: a full disk degrades resume
-                # granularity, it must never fail the compute itself
                 logger.warning("journal append failed (%s): %s", kind, e)
-                return
+                return False
         get_registry().counter("journal_appends").inc()
+        return True
 
     def close(self) -> None:
         with self._lock:

@@ -1,0 +1,34 @@
+"""Multi-tenant compute service: the persistent front door over one fleet.
+
+See ``docs/service.md`` for the API, tenancy/quota model, caching and
+invalidation rules, and the durability contract.
+"""
+
+from .admission import FairShareArbiter, ServiceAdmission  # noqa: F401
+from .cache import (  # noqa: F401
+    PlanCache,
+    ResultCache,
+    input_state_digest,
+    structural_fingerprint,
+)
+from .service import (  # noqa: F401
+    ComputeService,
+    RequestCancelledError,
+    RequestHandle,
+    ServiceConfig,
+    TenantThrottledError,
+)
+
+__all__ = [
+    "ComputeService",
+    "ServiceConfig",
+    "RequestHandle",
+    "RequestCancelledError",
+    "TenantThrottledError",
+    "FairShareArbiter",
+    "ServiceAdmission",
+    "PlanCache",
+    "ResultCache",
+    "structural_fingerprint",
+    "input_state_digest",
+]

@@ -1,0 +1,442 @@
+"""Plan & result caching for the compute service.
+
+Two caches, two different keys:
+
+- **Plan cache** — keyed by a *structural fingerprint* of the un-finalized
+  plan DAG (:func:`structural_fingerprint`, the executor-independent
+  sibling of the JaxExecutor's pre-trace segment key): two builds of the
+  same query produce byte-identical fingerprints even though every gensym
+  name and intermediate store path differs, so a repeat submission reuses
+  the first build's :class:`~cubed_tpu.core.plan.FinalizedPlan` and skips
+  optimization + lazy-array creation entirely (``plan_cache_hits``).
+
+- **Result cache** — keyed by the structural fingerprint *plus* an input
+  digest derived from the source arrays' integrity manifests
+  (:func:`input_state_digest`). A hit returns the prior run's output
+  array with **zero tasks executed** (``result_cache_hits``); any change
+  in a source store's manifest shards changes the digest, so a mutated
+  input can never serve a stale result — and a lookup that observes a
+  changed digest for a cached fingerprint explicitly drops the stale
+  entry (``result_cache_invalidations``). Entries hold bounded in-memory
+  copies, LRU-evicted by a byte budget (``result_cache_evictions``).
+
+Fingerprint soundness: the canonical payload masks everything that does
+NOT affect the computed values (store paths → order-of-first-use tokens,
+Spec resources, plan/provenance metadata) and keeps everything that does
+(kernel/block functions by cloudpickle — code objects + closure values —
+shapes, dtypes, chunking, in-memory input bytes by digest, RNG bases).
+Gensym identifiers are canonicalized by order of first appearance in the
+byte stream, exactly like the JAX structural key. Fingerprinting is
+best-effort: any failure returns ``None`` and the caller simply skips
+caching (never the reason a compute dies).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import logging
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability.metrics import get_registry
+
+logger = logging.getLogger(__name__)
+
+#: default byte budget for in-memory result copies
+DEFAULT_RESULT_CACHE_BYTES = 256 * 1024 * 1024
+
+#: plan-cache entry bound (FinalizedPlans are cheap: graph metadata only)
+MAX_PLAN_ENTRIES = 128
+
+
+def _node_counter(name: str) -> Tuple[int, str]:
+    """Sort key recovering creation order from a gensym'd node name.
+
+    Every plan identifier is ``{prefix}-{counter:09d}`` with one shared
+    process-global counter, so sorting by the numeric suffix reproduces
+    build order — which is identical across two builds of the same code
+    even though the absolute counter values differ."""
+    tail = name.rsplit("-", 1)[-1]
+    if tail.isdigit():
+        return (int(tail), "")
+    return (-1, name)  # non-gensym nodes (none today) sort first, by name
+
+
+def canonical_node_order(dag) -> List[str]:
+    """The dag's node names in build order (stable across rebuilds)."""
+    return sorted((str(n) for n in dag.nodes), key=_node_counter)
+
+
+def _is_temp_store(store: str) -> bool:
+    """True when a store path is one of THIS process's build-local
+    intermediates (under the ``work_dir/CONTEXT_ID`` temp directory) —
+    the only paths the fingerprint may mask as noise."""
+    from ..core.plan import CONTEXT_ID
+
+    return CONTEXT_ID in store
+
+
+def structural_fingerprint(dag) -> Tuple[Optional[str], Optional[List[str]]]:
+    """``(sha256 hexdigest, canonical node order)`` of a plan dag, or
+    ``(None, None)`` when fingerprinting fails.
+
+    Two dags of structurally identical queries (same ops, same kernels and
+    closures, same shapes/dtypes/chunking, same in-memory input bytes)
+    fingerprint equal; the canonical order lets a cache hit map *this*
+    build's output array name to the cached build's node at the same
+    position."""
+    try:
+        import cloudpickle
+    except Exception:
+        return None, None
+
+    from ..core.plan import Plan
+    from ..spec import Spec
+    from ..storage.store import ZarrV2Array
+    from ..storage.virtual import (
+        VirtualEmptyArray,
+        VirtualFullArray,
+        VirtualInMemoryArray,
+        VirtualOffsetsArray,
+    )
+    from ..storage.zarr import LazyZarrArray
+    from ..utils import StackSummary
+
+    canonical = canonical_node_order(dag)
+    index = {n: i for i, n in enumerate(canonical)}
+    tokens: Dict[str, str] = {}
+
+    def tok(path: str) -> str:
+        return tokens.setdefault(path, f"@{len(tokens)}")
+
+    plan_names = set(canonical)
+
+    class _MaskingPickler(cloudpickle.CloudPickler):
+        """Masks value-irrelevant identity (paths, specs, provenance) so
+        per-build noise can't defeat the cache, while keeping everything
+        that shapes the RESULT (mirrors JaxExecutor._structural_key; RNG
+        bases are deliberately NOT masked — a different seed is a
+        different result)."""
+
+        def reducer_override(self, obj):  # noqa: D401
+            if isinstance(obj, ZarrV2Array):
+                # a CONCRETE stored array is an input: its store path IS
+                # identity. Masking it like an intermediate would make two
+                # structurally identical queries over different stores
+                # collide — and a plan-cache hit would then compute over
+                # the wrong data
+                return (
+                    str,
+                    (
+                        f"zarrsrc:{obj.store}:{tuple(obj.shape)}:"
+                        f"{obj.dtype}:{tuple(getattr(obj, 'chunks', ()) or ())}",
+                    ),
+                )
+            if isinstance(obj, LazyZarrArray):
+                store = str(obj.store)
+                if _is_temp_store(store):
+                    # a work_dir/CONTEXT_ID intermediate is per-build
+                    # noise: masked to order-of-first-use so rebuilds of
+                    # the same query hash equal
+                    store = tok(store)
+                # else: a USER-NAMED lazy target (to_zarr/store) is
+                # identity, like a source — two queries writing different
+                # destinations must not share a cache entry
+                return (
+                    str,
+                    (
+                        f"zarr:{store}:{tuple(obj.shape)}:"
+                        f"{obj.dtype}:{tuple(getattr(obj, 'chunks', ()) or ())}",
+                    ),
+                )
+            if isinstance(obj, VirtualOffsetsArray):
+                return (str, (f"offsets:{tuple(obj.shape)}:{obj.base}",))
+            if isinstance(obj, (VirtualEmptyArray, VirtualFullArray)):
+                return (
+                    str,
+                    (
+                        f"vconst:{tuple(obj.shape)}:{obj.dtype}:"
+                        f"{getattr(obj, 'fill_value', 0)}",
+                    ),
+                )
+            if isinstance(obj, VirtualInMemoryArray):
+                h = hashlib.sha256(
+                    np.ascontiguousarray(obj.array).tobytes()
+                ).hexdigest()
+                return (
+                    str,
+                    (f"vmem:{obj.array.shape}:{obj.array.dtype}:{h}",),
+                )
+            if isinstance(obj, Spec):
+                return (str, ("spec",))
+            if isinstance(obj, (Plan, StackSummary)):
+                return (str, ("meta",))
+            return super().reducer_override(obj)
+
+    payload: list = []
+    try:
+        for name in canonical:
+            node = dag.nodes[name]
+            if node.get("type") == "op":
+                pop = node.get("primitive_op")
+                payload.append(
+                    (
+                        "op",
+                        node.get("op_name"),
+                        pop.num_tasks if pop is not None else None,
+                        pop.pipeline.config if pop is not None and
+                        pop.pipeline is not None else None,
+                    )
+                )
+            else:
+                payload.append(("array", node.get("target")))
+        payload.append(
+            (
+                "edges",
+                tuple(
+                    sorted(
+                        (index[str(u)], index[str(v)])
+                        for u, v in dag.edges()
+                    )
+                ),
+            )
+        )
+        buf = io.BytesIO()
+        _MaskingPickler(buf).dump(payload)
+    except Exception:
+        logger.debug("plan fingerprinting failed", exc_info=True)
+        return None, None
+
+    # canonicalize gensym identifiers leaked into pickled closures (block
+    # functions carry array-name arguments) by order of first appearance
+    data = buf.getvalue()
+    if plan_names:
+        pattern = re.compile(
+            b"|".join(
+                re.escape(n.encode())
+                for n in sorted(plan_names, key=len, reverse=True)
+            )
+        )
+        seen: Dict[bytes, bytes] = {}
+
+        def repl(m) -> bytes:
+            k = m.group(0)
+            if k not in seen:
+                seen[k] = b"~%07d~" % len(seen)
+            return seen[k]
+
+        data = pattern.sub(repl, data)
+    return hashlib.sha256(data).hexdigest(), canonical
+
+
+# ----------------------------------------------------------------------
+# input state (what the result cache invalidates on)
+# ----------------------------------------------------------------------
+
+
+def _manifest_digest(store: str) -> Optional[str]:
+    """Digest of a local zarr store's integrity-manifest shards (falling
+    back to the chunk listing when no manifest exists). ``None`` when the
+    store isn't a readable local directory — the caller treats that input
+    as uncacheable rather than guessing."""
+    import os
+
+    if "://" in store and not store.startswith("file://"):
+        return None
+    path = store.replace("file://", "")
+    if not os.path.isdir(path):
+        return None
+    h = hashlib.sha256()
+    try:
+        names = sorted(os.listdir(path))
+        manifest_names = [
+            n for n in names
+            if n.startswith(".manifest-") and n.endswith(".json")
+        ]
+        if manifest_names:
+            for n in manifest_names:
+                h.update(n.encode())
+                with open(os.path.join(path, n), "rb") as f:
+                    h.update(f.read())
+        else:
+            # no integrity manifests (plain zarr input): fall back to the
+            # chunk listing with sizes + mtimes — coarser, still catches
+            # any rewrite of the store
+            for n in names:
+                st = os.stat(os.path.join(path, n))
+                h.update(f"{n}:{st.st_size}:{st.st_mtime_ns}".encode())
+    except OSError:
+        return None
+    return h.hexdigest()
+
+
+def input_state_digest(dag) -> Optional[str]:
+    """One digest over every STORED source array's manifest state.
+
+    In-memory virtual inputs are already value-hashed inside the
+    structural fingerprint; this covers the zarr-backed sources whose
+    bytes live outside the plan. Returns ``None`` when any source store
+    can't be digested (remote store, vanished directory) — the result
+    cache then refuses to serve for this plan rather than risk staleness.
+    """
+    from ..storage.store import ZarrV2Array
+
+    h = hashlib.sha256()
+    for name in canonical_node_order(dag):
+        node = dag.nodes[name]
+        if node.get("type") != "array":
+            continue
+        target = node.get("target")
+        if isinstance(target, ZarrV2Array):
+            d = _manifest_digest(str(target.store))
+            if d is None:
+                return None
+            h.update(d.encode())
+    return h.hexdigest()
+
+
+# ----------------------------------------------------------------------
+# the caches
+# ----------------------------------------------------------------------
+
+
+class PlanCacheEntry:
+    __slots__ = ("finalized", "canonical")
+
+    def __init__(self, finalized, canonical: List[str]):
+        self.finalized = finalized
+        self.canonical = canonical
+
+
+class PlanCache:
+    """fingerprint -> finalized plan (+ the source dag's canonical order,
+    for mapping a new build's output names onto the cached build)."""
+
+    def __init__(self, max_entries: int = MAX_PLAN_ENTRIES):
+        self.max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, PlanCacheEntry]" = OrderedDict()
+
+    def get(self, fingerprint: Optional[str]) -> Optional[PlanCacheEntry]:
+        if fingerprint is None:
+            return None
+        with self._lock:
+            entry = self._entries.get(fingerprint)
+            if entry is not None:
+                self._entries.move_to_end(fingerprint)
+                get_registry().counter("plan_cache_hits").inc()
+            else:
+                get_registry().counter("plan_cache_misses").inc()
+            return entry
+
+    def put(
+        self, fingerprint: Optional[str], finalized, canonical: List[str],
+    ) -> None:
+        if fingerprint is None:
+            return
+        with self._lock:
+            self._entries[fingerprint] = PlanCacheEntry(finalized, canonical)
+            self._entries.move_to_end(fingerprint)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class ResultCacheEntry:
+    __slots__ = ("value", "input_digest", "nbytes", "compute_id")
+
+    def __init__(self, value: np.ndarray, input_digest: str,
+                 compute_id: Optional[str] = None):
+        self.value = value
+        self.input_digest = input_digest
+        self.nbytes = int(value.nbytes)
+        self.compute_id = compute_id
+
+
+class ResultCache:
+    """fingerprint -> (input digest, bounded in-memory result copy).
+
+    A lookup whose fingerprint matches but whose freshly-computed input
+    digest does NOT is an *invalidation*: the stale entry is dropped
+    (``result_cache_invalidations``) and the caller recomputes. Serving a
+    hit returns a copy — cached bytes must never alias a caller's
+    mutable array."""
+
+    def __init__(self, max_bytes: int = DEFAULT_RESULT_CACHE_BYTES):
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, ResultCacheEntry]" = OrderedDict()
+        self._bytes = 0
+
+    def lookup(
+        self, fingerprint: Optional[str], input_digest: Optional[str],
+    ) -> Optional[np.ndarray]:
+        reg = get_registry()
+        if fingerprint is None or input_digest is None:
+            reg.counter("result_cache_misses").inc()
+            return None
+        with self._lock:
+            entry = self._entries.get(fingerprint)
+            if entry is None:
+                reg.counter("result_cache_misses").inc()
+                return None
+            if entry.input_digest != input_digest:
+                # a source store's manifest changed under the cached
+                # fingerprint: drop the fossil, force recompute
+                del self._entries[fingerprint]
+                self._bytes -= entry.nbytes
+                reg.counter("result_cache_invalidations").inc()
+                reg.counter("result_cache_misses").inc()
+                return None
+            self._entries.move_to_end(fingerprint)
+            reg.counter("result_cache_hits").inc()
+            value = entry.value
+        # the (possibly large) defensive copy happens OUTSIDE the lock so
+        # concurrent lookups don't serialize behind a memcpy; the cached
+        # array itself is never mutated, only replaced
+        return np.array(value, copy=True)
+
+    def put(
+        self, fingerprint: Optional[str], input_digest: Optional[str],
+        value: np.ndarray, compute_id: Optional[str] = None,
+    ) -> bool:
+        if fingerprint is None or input_digest is None:
+            return False
+        value = np.asarray(value)
+        if value.nbytes > self.max_bytes:
+            return False  # one oversize result must not flush everything
+        entry = ResultCacheEntry(
+            np.array(value, copy=True), input_digest, compute_id
+        )
+        reg = get_registry()
+        with self._lock:
+            old = self._entries.pop(fingerprint, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[fingerprint] = entry
+            self._bytes += entry.nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= evicted.nbytes
+                reg.counter("result_cache_evictions").inc()
+        return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+            }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -1,0 +1,1017 @@
+"""The multi-tenant compute service: a persistent front door over one fleet.
+
+One :class:`ComputeService` wraps one executor (any DagExecutor — the
+autoscaled distributed fleet in production, the threaded executor in
+tests) and accepts many concurrent computes from many tenants:
+
+.. code-block:: python
+
+    svc = ComputeService(executor=ex, tenants={"gold": 4.0, "free": 1.0},
+                         service_dir="/data/svc")
+    h = svc.submit(result_array, tenant="gold")
+    value = h.result(timeout=300)
+
+- **Admission** is weighted fair-share (``service/admission.py``): a
+  smooth-weighted-round-robin arbiter picks whose queued request runs
+  next, and an AIMD controller (PR 4's, reused verbatim) sizes how many
+  run concurrently — RESOURCE failures halve the ceiling, pressure-free
+  successes restore it.
+- **Durability** is journal-backed (``service/durability.py``): with a
+  ``service_dir``, every accepted request is pickled + journaled before
+  the submit returns, each request's compute writes a PR 8 journal, and
+  ``recover()`` (automatic on start) re-enqueues every accepted-but-
+  unfinished request after a crash, resuming partial computes from the
+  journal ∩ integrity frontier.
+- **Caching** (``service/cache.py``): a structural plan cache (identical
+  queries skip planning) and a result cache keyed by plan fingerprint +
+  input manifest digests (identical queries over unchanged inputs return
+  the prior array with zero tasks executed; a mutated input manifest
+  invalidates). Identical in-flight requests coalesce onto one execution.
+- **Isolation**: per-tenant queues, journals, stats rows
+  (:meth:`ComputeService.stats_snapshot`, mirrored into
+  ``/snapshot.json`` and ``cubed_tpu.top``), per-tenant telemetry series
+  (``tenant_queued``/``tenant_running``/``tenant_completed`` labelled by
+  tenant), and tenant-tagged decision-ring entries.
+
+Known limitation (documented in ``docs/service.md``): fault-injection /
+integrity / memory-guard arming is process-global, so concurrent requests
+should share one arming configuration — build tenant arrays against a
+uniform Spec.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import uuid
+from collections import OrderedDict, deque
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..observability.collect import record_decision
+from ..observability.metrics import get_registry
+from .admission import DEFAULT_WEIGHT, FairShareArbiter, ServiceAdmission
+from .cache import (
+    DEFAULT_RESULT_CACHE_BYTES,
+    PlanCache,
+    ResultCache,
+    input_state_digest,
+    structural_fingerprint,
+)
+from .durability import TenantRequestJournal, load_requests
+
+logger = logging.getLogger(__name__)
+
+#: env overrides (operator wins over Spec(service=...) / constructor args)
+SERVICE_DIR_ENV_VAR = "CUBED_TPU_SERVICE_DIR"
+MAX_CONCURRENT_ENV_VAR = "CUBED_TPU_SERVICE_MAX_CONCURRENT"
+PLAN_CACHE_ENV_VAR = "CUBED_TPU_SERVICE_PLAN_CACHE"
+RESULT_CACHE_ENV_VAR = "CUBED_TPU_SERVICE_RESULT_CACHE"
+MAX_QUEUED_ENV_VAR = "CUBED_TPU_SERVICE_MAX_QUEUED"
+
+#: request states
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+
+#: finished request handles retained for introspection
+MAX_RETAINED_REQUESTS = 4096
+#: byte bound on the RESULT arrays those retained records pin — the
+#: registry must never out-retain the deliberately byte-bounded result
+#: cache (a client's own handle keeps its result alive regardless)
+MAX_RETAINED_RESULT_BYTES = 512 * 1024 * 1024
+
+
+class TenantThrottledError(RuntimeError):
+    """A tenant exceeded its queued-request bound; the submission was
+    rejected (counted in ``tenant_throttled``). Back off and resubmit."""
+
+
+class RequestCancelledError(RuntimeError):
+    """``result()`` was called on a cancelled request."""
+
+
+def _env_bool(var: str) -> Optional[bool]:
+    raw = os.environ.get(var)
+    if raw is None:
+        return None
+    raw = raw.strip().lower()
+    if raw == "":
+        return None  # empty means unset
+    if raw in ("on", "true", "1", "yes"):
+        return True
+    if raw in ("off", "false", "0", "no"):
+        return False
+    raise ValueError(
+        f"invalid {var}={raw!r}: expected on/off (or true/false, 1/0)"
+    )
+
+
+def _env_int(var: str) -> Optional[int]:
+    raw = os.environ.get(var)
+    if raw is None or not raw.strip():
+        return None
+    try:
+        value = int(raw.strip())
+    except ValueError:
+        raise ValueError(f"invalid {var}={raw!r}: expected an integer")
+    if value < 1:
+        raise ValueError(f"invalid {var}={raw!r}: must be >= 1")
+    return value
+
+
+class ServiceConfig:
+    """Resolved service configuration (env > explicit > defaults)."""
+
+    def __init__(
+        self,
+        tenants: Optional[Dict[str, float]] = None,
+        default_weight: float = DEFAULT_WEIGHT,
+        max_concurrent: int = 2,
+        plan_cache: bool = True,
+        result_cache: bool = True,
+        result_cache_bytes: int = DEFAULT_RESULT_CACHE_BYTES,
+        max_queued_per_tenant: int = 1024,
+        service_dir: Optional[str] = None,
+        recover: bool = True,
+    ):
+        self.tenants = dict(tenants or {})
+        self.default_weight = float(default_weight)
+        self.max_concurrent = int(max_concurrent)
+        if self.max_concurrent < 1:
+            raise ValueError("max_concurrent must be >= 1")
+        self.plan_cache = bool(plan_cache)
+        self.result_cache = bool(result_cache)
+        self.result_cache_bytes = int(result_cache_bytes)
+        self.max_queued_per_tenant = int(max_queued_per_tenant)
+        if self.max_queued_per_tenant < 1:
+            raise ValueError("max_queued_per_tenant must be >= 1")
+        self.service_dir = service_dir
+        self.recover = bool(recover)
+
+    @classmethod
+    def resolve(
+        cls, spec=None, config: Optional["ServiceConfig"] = None, **overrides,
+    ) -> "ServiceConfig":
+        """Merge: env vars (operator, win) > explicit config/overrides >
+        ``Spec(service=...)`` > defaults."""
+        base: dict = {}
+        spec_cfg = getattr(spec, "service", None)
+        if isinstance(spec_cfg, ServiceConfig):
+            base.update(
+                tenants=spec_cfg.tenants,
+                default_weight=spec_cfg.default_weight,
+                max_concurrent=spec_cfg.max_concurrent,
+                plan_cache=spec_cfg.plan_cache,
+                result_cache=spec_cfg.result_cache,
+                result_cache_bytes=spec_cfg.result_cache_bytes,
+                max_queued_per_tenant=spec_cfg.max_queued_per_tenant,
+                service_dir=spec_cfg.service_dir,
+                recover=spec_cfg.recover,
+            )
+        elif isinstance(spec_cfg, dict):
+            base.update(spec_cfg)
+        if config is not None:
+            base.update(
+                tenants=config.tenants,
+                default_weight=config.default_weight,
+                max_concurrent=config.max_concurrent,
+                plan_cache=config.plan_cache,
+                result_cache=config.result_cache,
+                result_cache_bytes=config.result_cache_bytes,
+                max_queued_per_tenant=config.max_queued_per_tenant,
+                service_dir=config.service_dir,
+                recover=config.recover,
+            )
+        base.update({k: v for k, v in overrides.items() if v is not None})
+        resolved = cls(**base)
+        env_dir = os.environ.get(SERVICE_DIR_ENV_VAR)
+        if env_dir and env_dir.strip():
+            resolved.service_dir = env_dir.strip()
+        env_mc = _env_int(MAX_CONCURRENT_ENV_VAR)
+        if env_mc is not None:
+            resolved.max_concurrent = env_mc
+        env_pc = _env_bool(PLAN_CACHE_ENV_VAR)
+        if env_pc is not None:
+            resolved.plan_cache = env_pc
+        env_rc = _env_bool(RESULT_CACHE_ENV_VAR)
+        if env_rc is not None:
+            resolved.result_cache = env_rc
+        env_mq = _env_int(MAX_QUEUED_ENV_VAR)
+        if env_mq is not None:
+            resolved.max_queued_per_tenant = env_mq
+        return resolved
+
+
+class RequestHandle:
+    """The client's view of one submitted compute."""
+
+    def __init__(self, request: "_Request"):
+        self._request = request
+
+    @property
+    def request_id(self) -> str:
+        return self._request.request_id
+
+    @property
+    def tenant(self) -> str:
+        return self._request.tenant
+
+    @property
+    def plan_cache_hit(self) -> bool:
+        return self._request.plan_cache_hit
+
+    @property
+    def result_cache_hit(self) -> bool:
+        return self._request.result_cache_hit
+
+    @property
+    def compute_id(self) -> Optional[str]:
+        """The correlated compute id (trace/log/journal joins), once the
+        request starts executing."""
+        return self._request.compute_id
+
+    def status(self) -> str:
+        return self._request.state
+
+    def done(self) -> bool:
+        return self._request.event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """The computed array; blocks until the request finishes. Raises
+        the compute's own exception on failure and
+        :class:`RequestCancelledError` after a cancel."""
+        if not self._request.event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished within {timeout}s "
+                f"(state: {self._request.state})"
+            )
+        req = self._request
+        if req.state == CANCELLED:
+            raise RequestCancelledError(
+                f"request {self.request_id} was cancelled"
+            )
+        if req.error is not None:
+            raise req.error
+        return req.value
+
+    def cancel(self) -> bool:
+        """Cancel a still-queued request (a running compute is not torn
+        down mid-flight). True when the cancel took effect."""
+        return self._request.service._cancel(self._request)
+
+    def __repr__(self) -> str:
+        return (
+            f"RequestHandle({self.request_id}, tenant={self.tenant!r}, "
+            f"state={self.status()!r})"
+        )
+
+
+class _Request:
+    """Internal request record."""
+
+    __slots__ = (
+        "request_id", "tenant", "array", "service", "state", "event",
+        "value", "error", "submitted_at", "started_at", "ended_at",
+        "plan_cache_hit", "result_cache_hit", "recovered",
+        "resume_journal", "durable", "compute_id", "coalesced_into",
+        "fingerprint", "canonical",
+    )
+
+    def __init__(self, service: "ComputeService", tenant: str, array,
+                 request_id: Optional[str] = None):
+        self.request_id = request_id or f"r-{uuid.uuid4().hex[:12]}"
+        self.tenant = tenant
+        self.array = array
+        self.service = service
+        self.state = QUEUED
+        self.event = threading.Event()
+        self.value: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.submitted_at = time.time()
+        self.started_at: Optional[float] = None
+        self.ended_at: Optional[float] = None
+        self.plan_cache_hit = False
+        self.result_cache_hit = False
+        self.recovered = False
+        self.resume_journal: Optional[str] = None
+        self.durable = False
+        self.compute_id: Optional[str] = None
+        self.coalesced_into: Optional[str] = None
+        #: fingerprint computed at submit time (durable path), reused by
+        #: _execute so the masking-pickle pass runs once per request
+        self.fingerprint: Optional[str] = None
+        self.canonical: Optional[list] = None
+
+
+class _ComputeIdCallback:
+    """Captures the compute id Plan.execute mints for one request, so the
+    per-tenant stats row and the handle can join traces/logs/journals."""
+
+    def __init__(self, request: _Request):
+        self._request = request
+
+    def on_compute_start(self, event) -> None:
+        self._request.compute_id = getattr(event, "compute_id", None)
+
+
+class _TenantStats:
+    __slots__ = (
+        "weight", "accepted", "completed", "failed", "cancelled",
+        "throttled", "recovered", "plan_cache_hits", "result_cache_hits",
+        "coalesced",
+    )
+
+    def __init__(self, weight: float):
+        self.weight = weight
+        self.accepted = 0
+        self.completed = 0
+        self.failed = 0
+        self.cancelled = 0
+        self.throttled = 0
+        self.recovered = 0
+        self.plan_cache_hits = 0
+        self.result_cache_hits = 0
+        self.coalesced = 0
+
+
+class ComputeService:
+    """A persistent front door multiplexing many tenants onto one fleet."""
+
+    def __init__(
+        self,
+        executor=None,
+        spec=None,
+        config: Optional[ServiceConfig] = None,
+        tenants: Optional[Dict[str, float]] = None,
+        service_dir: Optional[str] = None,
+        max_concurrent: Optional[int] = None,
+        **config_overrides,
+    ):
+        self.config = ServiceConfig.resolve(
+            spec=spec, config=config, tenants=tenants,
+            service_dir=service_dir, max_concurrent=max_concurrent,
+            **config_overrides,
+        )
+        if executor is None and spec is not None:
+            executor = spec.executor
+        if executor is None:
+            from ..runtime.executors.python_async import (
+                AsyncPythonDagExecutor,
+            )
+
+            executor = AsyncPythonDagExecutor()
+        self.executor = executor
+        self.spec = spec
+        self.arbiter = FairShareArbiter(
+            self.config.tenants, self.config.default_weight
+        )
+        self.admission = ServiceAdmission(self.config.max_concurrent)
+        self.plan_cache = PlanCache() if self.config.plan_cache else None
+        self.result_cache = (
+            ResultCache(self.config.result_cache_bytes)
+            if self.config.result_cache else None
+        )
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queues: Dict[str, deque] = {}
+        self._tenant_stats: Dict[str, _TenantStats] = {}
+        for t, w in self.config.tenants.items():
+            self._tenant_stats[t] = _TenantStats(w)
+        self._requests: "OrderedDict[str, _Request]" = OrderedDict()
+        self._running: Dict[str, _Request] = {}
+        #: per-tenant submissions between bound-check and enqueue, so the
+        #: backlog bound holds exactly under concurrent submits
+        self._reserved: Dict[str, int] = {}
+        #: (fingerprint, input_digest) -> leader request (coalescing;
+        #: followers synchronize on the leader's event directly)
+        self._inflight: Dict[tuple, _Request] = {}
+        #: output-store-path -> execution lock (see _exec_lock_for)
+        self._exec_locks: "OrderedDict[str, threading.Lock]" = OrderedDict()
+        #: result bytes currently pinned by finished records in _requests
+        self._retained_bytes = 0
+        self._journals: Dict[str, TenantRequestJournal] = {}
+        self._dispatcher: Optional[threading.Thread] = None
+        self._threads: list = []
+        self._closed = threading.Event()
+        self._started = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "ComputeService":
+        """Start the dispatcher (idempotent) and, when a service_dir is
+        armed, recover every accepted-but-unfinished request."""
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+        if self.config.service_dir and self.config.recover:
+            try:
+                self.recover()
+            except Exception:
+                # recovery is additive: a corrupt journal degrades to
+                # re-submission, it must not keep the service down
+                logger.exception("service recovery failed; starting empty")
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="service-dispatch", daemon=True,
+        )
+        self._dispatcher.start()
+        from ..observability.timeseries import register_service
+
+        register_service(self)
+        return self
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop admitting; wait for running computes; seal the journals.
+
+        Queued requests complete their handles as CANCELLED so no client
+        blocks forever in ``result()`` — durable ones keep their accepted
+        journal record (NOT sealed), so a restarted service on the same
+        ``service_dir`` still recovers and runs them."""
+        self._closed.set()
+        with self._work:
+            self._work.notify_all()
+        d = self._dispatcher
+        if d is not None:
+            d.join(timeout=5.0)
+        deadline = time.monotonic() + timeout
+        for t in list(self._threads):
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        stranded = []
+        with self._work:
+            for q in self._queues.values():
+                stranded.extend(q)
+                q.clear()
+        for req in stranded:
+            # seal=False: a durable queued request's accepted record must
+            # survive the close so recovery re-runs it
+            self._finish(req, CANCELLED, seal=False)
+        from ..observability.timeseries import unregister_service
+
+        unregister_service(self)
+        for j in self._journals.values():
+            j.close()
+
+    def __enter__(self) -> "ComputeService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    # -- submission ----------------------------------------------------
+
+    def submit(self, array, tenant: str = "default") -> RequestHandle:
+        """Accept one compute for ``tenant``; returns immediately.
+
+        Durable when a service_dir is armed (payload + fsync'd accepted
+        record before return). Raises :class:`TenantThrottledError` past
+        the tenant's queued-request bound."""
+        if self._closed.is_set():
+            raise RuntimeError("service is closed")
+        if not self._started:
+            self.start()
+        tenant = str(tenant)
+        reg = get_registry()
+        with self._lock:
+            stats = self._ensure_tenant_locked(tenant)
+            q = self._queues.setdefault(tenant, deque())
+            # the bound covers queued requests PLUS submissions between
+            # their bound check and their enqueue (the durable write below
+            # happens outside the lock): a reservation makes the bound
+            # exact under concurrent submits, not just approximate
+            reserved = self._reserved.get(tenant, 0)
+            if len(q) + reserved >= self.config.max_queued_per_tenant:
+                stats.throttled += 1
+                reg.counter("tenant_throttled").inc()
+                record_decision(
+                    "service_throttled", tenant=tenant,
+                    queued=len(q) + reserved,
+                    bound=self.config.max_queued_per_tenant,
+                )
+                raise TenantThrottledError(
+                    f"tenant {tenant!r} has {len(q) + reserved} queued "
+                    f"request(s) (bound {self.config.max_queued_per_tenant})"
+                    "; backlog must drain before new submissions are "
+                    "accepted"
+                )
+            self._reserved[tenant] = reserved + 1
+        req = _Request(self, tenant, array)
+        enqueue = True
+        try:
+            if self.config.service_dir:
+                journal = self._tenant_journal(tenant)
+                if self.plan_cache is not None or self.result_cache is not None:
+                    # computed once here, reused by _execute (the durable
+                    # record and the caches key on the same fingerprint);
+                    # with both caches off it is journal metadata only —
+                    # not worth a masking-pickle pass per submit
+                    req.fingerprint, req.canonical = structural_fingerprint(
+                        array.plan.dag
+                    )
+                req.durable = journal.record_accepted(
+                    req.request_id, array, fingerprint=req.fingerprint
+                )
+        except BaseException:
+            enqueue = False  # never hand the queue a request the caller
+            raise            # believes was rejected
+        finally:
+            with self._work:
+                self._reserved[tenant] -= 1
+                if enqueue:
+                    stats = self._ensure_tenant_locked(tenant)
+                    stats.accepted += 1
+                    self._queues.setdefault(tenant, deque()).append(req)
+                    self._remember_locked(req)
+                    self._work.notify_all()
+        reg.counter("service_requests_accepted").inc()
+        record_decision(
+            "service_accept", tenant=tenant, request=req.request_id,
+            durable=req.durable,
+        )
+        return RequestHandle(req)
+
+    def handle(self, request_id: str) -> Optional[RequestHandle]:
+        with self._lock:
+            req = self._requests.get(request_id)
+        return RequestHandle(req) if req is not None else None
+
+    def recover(self) -> int:
+        """Re-enqueue every accepted-but-unfinished durable request (in
+        acceptance order, preserving request ids); returns the count."""
+        import cloudpickle
+
+        recovered = 0
+        pending = load_requests(self.config.service_dir)
+        reg = get_registry()
+        for tenant, records in pending.items():
+            journal = self._tenant_journal(tenant)
+            for rec in records:
+                rid = rec["request_id"]
+                if rec["payload_path"] is None:
+                    # accepted but its payload never made it / was lost:
+                    # seal it failed so it can't linger forever
+                    journal.record_done(
+                        rid, FAILED, error="payload unrecoverable"
+                    )
+                    continue
+                try:
+                    with open(rec["payload_path"], "rb") as f:
+                        array = cloudpickle.loads(f.read())
+                except Exception as e:
+                    logger.warning(
+                        "request %s (tenant %s): payload failed to load "
+                        "(%s); sealing failed", rid, tenant, e,
+                    )
+                    journal.record_done(rid, FAILED, error=f"payload: {e}")
+                    continue
+                req = _Request(self, tenant, array, request_id=rid)
+                req.durable = True
+                req.recovered = True
+                req.resume_journal = rec["compute_journal"]
+                with self._work:
+                    stats = self._ensure_tenant_locked(tenant)
+                    stats.accepted += 1
+                    stats.recovered += 1
+                    self._queues.setdefault(tenant, deque()).append(req)
+                    self._remember_locked(req)
+                    self._work.notify_all()
+                reg.counter("service_requests_recovered").inc()
+                record_decision(
+                    "service_recovered", tenant=tenant, request=rid,
+                    resume=bool(req.resume_journal),
+                )
+                recovered += 1
+        if recovered:
+            logger.info(
+                "service recovery: re-enqueued %d accepted request(s) "
+                "from %s", recovered, self.config.service_dir,
+            )
+        return recovered
+
+    # -- dispatch ------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while not self._closed.is_set():
+            req = None
+            try:
+                with self._work:
+                    req = self._next_admissible_locked()
+                    if req is None:
+                        self._work.wait(timeout=0.2)
+                        continue
+                    req.state = RUNNING
+                    req.started_at = time.time()
+                    self._running[req.request_id] = req
+                    self._threads = [
+                        t for t in self._threads if t.is_alive()
+                    ]
+                record_decision(
+                    "service_admit", tenant=req.tenant,
+                    request=req.request_id,
+                )
+                t = threading.Thread(
+                    target=self._run_request, args=(req,),
+                    name=f"service-run-{req.request_id}", daemon=True,
+                )
+                with self._lock:
+                    self._threads.append(t)
+                t.start()
+            except Exception as e:  # the dispatcher must never die
+                logger.exception("service dispatch failed")
+                if req is not None:
+                    # never strand an admitted request in RUNNING with no
+                    # thread behind it: fail it visibly
+                    with self._work:
+                        self._running.pop(req.request_id, None)
+                        self._ensure_tenant_locked(req.tenant).failed += 1
+                        self._work.notify_all()
+                    get_registry().counter("service_requests_failed").inc()
+                    self._finish(req, FAILED, error=e)
+                time.sleep(0.2)  # thread/fd exhaustion: don't spin
+
+    def _next_admissible_locked(self) -> Optional[_Request]:
+        if not self.admission.has_slot(len(self._running)):
+            return None
+        backlog = {t: len(q) for t, q in self._queues.items() if q}
+        tenant = self.arbiter.pick(backlog)
+        if tenant is None:
+            return None
+        return self._queues[tenant].popleft()
+
+    # -- execution -----------------------------------------------------
+
+    def _run_request(self, req: _Request) -> None:
+        reg = get_registry()
+        try:
+            value = self._execute(req)
+            with self._lock:
+                stats = self._ensure_tenant_locked(req.tenant)
+                stats.completed += 1
+                if req.plan_cache_hit:
+                    stats.plan_cache_hits += 1
+                if req.result_cache_hit:
+                    stats.result_cache_hits += 1
+            reg.counter("service_requests_completed").inc()
+            if not req.result_cache_hit:
+                # only a request that actually EXECUTED is evidence the
+                # fleet can take more load: cache hits and coalesced
+                # followers never touched it, and letting them advance
+                # the AIMD restore streak would re-trigger the pressure
+                # the step-down just relieved
+                self.admission.on_success()
+            self._finish(req, DONE, value=value)
+        except BaseException as e:  # noqa: BLE001 — reported to the handle
+            with self._lock:
+                self._ensure_tenant_locked(req.tenant).failed += 1
+            reg.counter("service_requests_failed").inc()
+            if self._is_resource_failure(e) and req.coalesced_into is None:
+                # a compute died of memory pressure: halve the number of
+                # concurrent computes before admitting the next one. Only
+                # the LEADER steps down — its followers re-raise the same
+                # error, and N+1 halvings for one pressure event would
+                # collapse the ceiling to 1
+                self.admission.on_resource_failure(len(self._running))
+            record_decision(
+                "service_request_failed", tenant=req.tenant,
+                request=req.request_id, error=type(e).__name__,
+            )
+            self._finish(req, FAILED, error=e)
+        finally:
+            with self._work:
+                self._running.pop(req.request_id, None)
+                self._work.notify_all()
+
+    def _execute(self, req: _Request):
+        from ..core.plan import arrays_to_plan
+
+        plan = arrays_to_plan(req.array)
+        use_caches = not req.recovered  # a resumed plan must re-finalize
+        fp = canonical = None
+        if use_caches and (
+            self.plan_cache is not None or self.result_cache is not None
+        ):
+            if req.fingerprint is not None:
+                # already computed on the submit path (durable requests)
+                fp, canonical = req.fingerprint, req.canonical
+            else:
+                fp, canonical = structural_fingerprint(plan.dag)
+        input_digest = None
+        if use_caches and self.result_cache is not None and fp is not None:
+            input_digest = input_state_digest(plan.dag)
+            if input_digest is None:
+                # an undigestable input (remote store, vanished dir):
+                # neither cache may serve — and sharing a plan-cache
+                # FinalizedPlan would let two concurrent identical
+                # requests race on the same store paths with no
+                # coalescing gate in front, so skip caching entirely
+                fp = canonical = None
+        if fp is not None and input_digest is not None:
+            cached = self.result_cache.lookup(fp, input_digest)
+            if cached is not None:
+                req.result_cache_hit = True
+                record_decision(
+                    "service_cache_hit", tenant=req.tenant,
+                    request=req.request_id, cache="result",
+                )
+                return cached
+        if input_digest is not None:
+            # coalesce onto an identical in-flight request: one execution
+            # serves every waiter (and fills the cache for the rest). Only
+            # with a known input digest — an undigestable input (remote
+            # store) must force a fresh run, never share a possibly-stale
+            # leader result
+            leader = None
+            key = (fp, input_digest)
+            with self._lock:
+                leader = self._inflight.get(key)
+                if leader is None:
+                    self._inflight[key] = req
+            if leader is not None:
+                req.coalesced_into = leader.request_id
+                get_registry().counter("service_requests_coalesced").inc()
+                with self._work:
+                    self._ensure_tenant_locked(req.tenant).coalesced += 1
+                    # a parked follower does no work: hand its admission
+                    # slot back so other tenants' requests can run while
+                    # it waits on the leader
+                    self._running.pop(req.request_id, None)
+                    self._work.notify_all()
+                leader.event.wait()
+                if leader.error is not None:
+                    raise leader.error
+                req.result_cache_hit = True
+                return np.array(leader.value, copy=True)
+        try:
+            value = self._execute_plan(req, plan, fp, canonical)
+            if (
+                use_caches and self.result_cache is not None
+                and fp is not None and input_digest is not None
+            ):
+                self.result_cache.put(
+                    fp, input_digest, value, compute_id=req.compute_id
+                )
+            return value
+        finally:
+            if input_digest is not None:
+                with self._lock:
+                    if self._inflight.get((fp, input_digest)) is req:
+                        del self._inflight[(fp, input_digest)]
+
+    def _execute_plan(self, req: _Request, plan, fp, canonical):
+        target_name = req.array.name
+        finalized = None
+        if self.plan_cache is not None and fp is not None:
+            entry = self.plan_cache.get(fp)
+            if entry is not None and req.array.name in (canonical or ()):
+                # map this build's output name onto the cached build's
+                # node at the same canonical position
+                try:
+                    idx = canonical.index(req.array.name)
+                    target_name = entry.canonical[idx]
+                    finalized = entry.finalized
+                    req.plan_cache_hit = True
+                    record_decision(
+                        "service_cache_hit", tenant=req.tenant,
+                        request=req.request_id, cache="plan",
+                    )
+                except (ValueError, IndexError):
+                    finalized = None
+                    target_name = req.array.name
+        if finalized is None:
+            finalized = plan._finalize(
+                optimize_graph=True, array_names=(req.array.name,)
+            )
+            if self.plan_cache is not None and fp is not None:
+                self.plan_cache.put(fp, finalized, canonical)
+        # a finalized plan's lazy targets are concrete store paths, baked
+        # at build time — shared by every plan-cache hit AND by any
+        # resubmission of the same array object. Two computes writing
+        # them concurrently (possible whenever the coalescing gate didn't
+        # catch the pair: result cache off, undigestable input, or an
+        # input mutated while the first still runs) could interleave
+        # DIFFERENT data into one store. Executions are serialized per
+        # OUTPUT store path; distinct plans are unaffected
+        with self._exec_lock_for(finalized, target_name):
+            return self._run_plan(req, plan, finalized, target_name)
+
+    #: distinct output paths whose exec locks are retained (LRU): an
+    #: evicted lock only matters if that plan runs again concurrently
+    #: 1024 distinct plans later — effectively never
+    MAX_EXEC_LOCKS = 1024
+
+    def _exec_lock_for(self, finalized, target_name) -> threading.Lock:
+        target = finalized.dag.nodes[target_name].get("target")
+        key = str(getattr(target, "store", None) or target_name)
+        with self._lock:
+            lock = self._exec_locks.get(key)
+            if lock is None:
+                lock = threading.Lock()
+                self._exec_locks[key] = lock
+                while len(self._exec_locks) > self.MAX_EXEC_LOCKS:
+                    self._exec_locks.popitem(last=False)
+            else:
+                self._exec_locks.move_to_end(key)
+            return lock
+
+    def _run_plan(self, req: _Request, plan, finalized, target_name):
+        from ..storage.zarr import open_if_lazy_zarr_array
+
+        callbacks = [_ComputeIdCallback(req)]
+        kwargs: dict = {}
+        if req.durable and self.config.service_dir:
+            from ..runtime.journal import JournalCallback
+
+            journal = self._tenant_journal(req.tenant)
+            callbacks.append(
+                JournalCallback(
+                    journal.compute_journal_path(req.request_id)
+                )
+            )
+        if req.resume_journal:
+            kwargs["resume_from_journal"] = req.resume_journal
+        elif req.recovered:
+            # accepted before the crash but never journaled a task:
+            # integrity-verified chunks (if any) still skip
+            kwargs["resume"] = True
+        plan.execute(
+            executor=self.executor,
+            callbacks=callbacks,
+            array_names=(target_name,),
+            spec=getattr(req.array, "spec", None) or self.spec,
+            finalized=finalized,
+            **kwargs,
+        )
+        target = finalized.dag.nodes[target_name]["target"]
+        arr = open_if_lazy_zarr_array(target)
+        out = arr[...] if getattr(arr, "shape", ()) else arr[()]
+        return np.asarray(out)
+
+    # -- completion / cancel -------------------------------------------
+
+    def _finish(
+        self, req: _Request, state: str,
+        value: Optional[np.ndarray] = None,
+        error: Optional[BaseException] = None,
+        seal: bool = True,
+    ) -> None:
+        req.value = value
+        req.error = error
+        req.state = state
+        req.ended_at = time.time()
+        if value is not None:
+            with self._lock:
+                self._retained_bytes += int(getattr(value, "nbytes", 0))
+                self._trim_retained_locked()
+        if seal and req.durable and self.config.service_dir:
+            try:
+                self._tenant_journal(req.tenant).record_done(
+                    req.request_id,
+                    "completed" if state == DONE else state,
+                    error=(
+                        f"{type(error).__name__}: {error}"
+                        if error is not None else None
+                    ),
+                )
+            except Exception:
+                logger.exception(
+                    "failed to seal request %s", req.request_id
+                )
+        req.event.set()
+
+    def _cancel(self, req: _Request) -> bool:
+        with self._work:
+            q = self._queues.get(req.tenant)
+            if req.state != QUEUED or q is None or req not in q:
+                return False
+            q.remove(req)
+            self._ensure_tenant_locked(req.tenant).cancelled += 1
+        get_registry().counter("service_requests_cancelled").inc()
+        record_decision(
+            "service_cancelled", tenant=req.tenant, request=req.request_id,
+        )
+        self._finish(req, CANCELLED)
+        return True
+
+    # -- helpers -------------------------------------------------------
+
+    def _ensure_tenant_locked(self, tenant: str) -> _TenantStats:
+        stats = self._tenant_stats.get(tenant)
+        if stats is None:
+            stats = _TenantStats(self.arbiter.weight(tenant))
+            self._tenant_stats[tenant] = stats
+        return stats
+
+    def _remember_locked(self, req: _Request) -> None:
+        self._requests[req.request_id] = req
+        self._trim_retained_locked()
+
+    def _trim_retained_locked(self) -> None:
+        """Evict FINISHED request records beyond the count/byte bounds,
+        oldest first, skipping live ones (a live request's handle must
+        survive until it completes). Eviction only drops the registry's
+        reference — a client still holding the handle keeps its result."""
+        over_count = len(self._requests) - MAX_RETAINED_REQUESTS
+        over_bytes = self._retained_bytes - MAX_RETAINED_RESULT_BYTES
+        if over_count <= 0 and over_bytes <= 0:
+            return
+        for rid in list(self._requests):
+            if over_count <= 0 and over_bytes <= 0:
+                break
+            r = self._requests[rid]
+            if not r.event.is_set():
+                continue
+            del self._requests[rid]
+            over_count -= 1
+            if r.value is not None:
+                nbytes = int(getattr(r.value, "nbytes", 0))
+                self._retained_bytes -= nbytes
+                over_bytes -= nbytes
+
+    def _tenant_journal(self, tenant: str) -> TenantRequestJournal:
+        with self._lock:
+            j = self._journals.get(tenant)
+            if j is None:
+                j = TenantRequestJournal(self.config.service_dir, tenant)
+                self._journals[tenant] = j
+            return j
+
+    @staticmethod
+    def _is_resource_failure(exc: BaseException) -> bool:
+        from ..runtime.memory import MemoryGuardExceededError
+
+        return isinstance(exc, (MemoryError, MemoryGuardExceededError)) or (
+            getattr(exc, "remote_type", None)
+            in ("MemoryError", "MemoryGuardExceededError")
+        )
+
+    def wait_idle(self, timeout: float = 60.0) -> bool:
+        """Block until no request is queued or running (True) or the
+        timeout passes (False)."""
+        deadline = time.monotonic() + timeout
+        with self._work:
+            while time.monotonic() < deadline:
+                if not self._running and not any(
+                    self._queues.get(t) for t in self._queues
+                ):
+                    return True
+                self._work.wait(timeout=0.1)
+        return False
+
+    # -- introspection -------------------------------------------------
+
+    def stats_snapshot(self) -> dict:
+        """Per-tenant rows + service aggregates (the ``/snapshot.json``
+        ``service`` section and the ``cubed_tpu.top`` TENANTS panel)."""
+        reg = get_registry()
+        with self._lock:
+            tenants = {}
+            for name, s in sorted(self._tenant_stats.items()):
+                queued = len(self._queues.get(name) or ())
+                running = sum(
+                    1 for r in self._running.values() if r.tenant == name
+                )
+                tenants[name] = {
+                    "weight": self.arbiter.weight(name),
+                    "queued": queued,
+                    "running": running,
+                    "accepted": s.accepted,
+                    "completed": s.completed,
+                    "failed": s.failed,
+                    "cancelled": s.cancelled,
+                    "throttled": s.throttled,
+                    "recovered": s.recovered,
+                    "coalesced": s.coalesced,
+                    "plan_cache_hits": s.plan_cache_hits,
+                    "result_cache_hits": s.result_cache_hits,
+                }
+            queue_depth = sum(len(q) for q in self._queues.values())
+            running = len(self._running)
+        reg.gauge("service_queue_depth").set(queue_depth)
+        reg.gauge("service_running").set(running)
+        return {
+            "tenants": tenants,
+            "queue_depth": queue_depth,
+            "running": running,
+            "slots": self.admission.effective_limit,
+            "throttling": self.admission.throttling,
+            "durable": bool(self.config.service_dir),
+            "service_dir": self.config.service_dir,
+            "plan_cache": (
+                {"entries": len(self.plan_cache)}
+                if self.plan_cache is not None else None
+            ),
+            "result_cache": (
+                self.result_cache.stats()
+                if self.result_cache is not None else None
+            ),
+        }

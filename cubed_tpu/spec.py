@@ -37,6 +37,7 @@ class Spec:
         journal: Optional[str] = None,
         peer_transfer: Optional[bool] = None,
         telemetry_port: Optional[int] = None,
+        service: Optional[Any] = None,
     ):
         self._work_dir = work_dir
         self._reserved_mem = convert_to_bytes(reserved_mem or 0)
@@ -95,6 +96,16 @@ class Spec:
                     f"{telemetry_port}"
                 )
         self._telemetry_port = telemetry_port
+        if service is not None and not isinstance(service, dict):
+            from .service.service import ServiceConfig
+
+            if not isinstance(service, ServiceConfig):
+                raise ValueError(
+                    "service must be a cubed_tpu.service.ServiceConfig, a "
+                    f"dict of its fields, or None; got "
+                    f"{type(service).__name__}"
+                )
+        self._service = service
 
     @property
     def work_dir(self) -> Optional[str]:
@@ -217,6 +228,18 @@ class Spec:
         ``off`` disables) or the off default
         (observability/export.py)."""
         return self._telemetry_port
+
+    @property
+    def service(self):
+        """Multi-tenant compute-service configuration (a
+        ``cubed_tpu.service.ServiceConfig`` or a dict of its fields):
+        tenant quota weights, concurrent-compute slots, plan/result cache
+        arming, and the durable service directory.
+        ``ComputeService(spec=...)`` resolves it together with the
+        ``CUBED_TPU_SERVICE_*`` env vars (env wins — see
+        ``docs/service.md``). ``None`` (the default) means service
+        defaults apply."""
+        return self._service
 
     def __repr__(self) -> str:
         return (

@@ -133,6 +133,36 @@ def render(snapshot: dict, width: int = 100) -> str:
         )
     out.append("")
 
+    # -- tenants (multi-tenant service front door) ---------------------
+    service = snapshot.get("service") or {}
+    tenants = service.get("tenants") or {}
+    if tenants:
+        throttle = " THROTTLING" if service.get("throttling") else ""
+        out.append(
+            f"TENANTS  ({service.get('running', 0)} running / "
+            f"{service.get('slots', '?')} slots, queue "
+            f"{service.get('queue_depth', 0)}{throttle})"
+        )
+        out.append(
+            f"{'TENANT':<16}{'WEIGHT':>7}{'QUEUED':>8}{'RUN':>5}"
+            f"{'DONE':>7}{'FAIL':>6}{'CACHE%':>8}{'THROTTLED':>11}"
+        )
+        for name in sorted(tenants):
+            row = tenants[name]
+            done = row.get("completed") or 0
+            hits = (
+                (row.get("plan_cache_hits") or 0)
+                + (row.get("result_cache_hits") or 0)
+            )
+            cache = f"{hits / done:.0%}" if done else "-"
+            out.append(
+                f"{name:<16}{row.get('weight', 1):>7.1f}"
+                f"{row.get('queued', 0):>8}{row.get('running', 0):>5}"
+                f"{done:>7}{row.get('failed', 0):>6}{cache:>8}"
+                f"{row.get('throttled', 0):>11}"
+            )
+        out.append("")
+
     # -- compute progress ----------------------------------------------
     out.append("COMPUTES")
     computes = snapshot.get("computes") or []

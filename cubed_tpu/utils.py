@@ -11,6 +11,7 @@ import itertools
 import platform
 import re
 import sys
+import threading
 from dataclasses import dataclass
 from math import prod
 from operator import add
@@ -29,6 +30,14 @@ import numpy as np
 #: process-global counter shared by every gensym'd plan identifier
 sym_counter = itertools.count()
 
+#: serializes draws from ``sym_counter``: plans are now built concurrently
+#: (the multi-tenant compute service accepts submissions from many client
+#: threads), and while CPython's ``next()`` on an ``itertools.count`` is
+#: atomic today, tests legitimately REASSIGN ``sym_counter`` to pin plan
+#: names — a read-swap racing a concurrent draw could mint a duplicate
+#: identifier, which would silently alias two arrays' store paths
+_sym_lock = threading.Lock()
+
 
 def gensym(name: str = "op") -> str:
     """A unique plan-node identifier with a FIXED-WIDTH counter.
@@ -42,7 +51,8 @@ def gensym(name: str = "op") -> str:
     plan nodes per process. One shared helper/counter so op and array node
     name formats can never desynchronize.
     """
-    return f"{name}-{next(sym_counter):09d}"
+    with _sym_lock:
+        return f"{name}-{next(sym_counter):09d}"
 
 
 # ---------------------------------------------------------------------------

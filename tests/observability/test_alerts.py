@@ -126,7 +126,58 @@ def test_default_rules_cover_the_documented_shapes():
     assert names == {
         "retry_budget_burn", "fleet_memory_pressure", "straggler_rate",
         "queue_depth_stall", "peer_fetch_fallback_spike",
+        "tenant_starvation",
     }
+
+
+def test_tenant_starvation_rule_fires_per_tenant():
+    """Queued work for a whole window with zero completions fires, naming
+    the starving tenant(s); a progressing tenant does not."""
+    from cubed_tpu.observability.alerts import TenantStarvationRule
+
+    now = 1000.0
+    store = TimeSeriesStore()
+    for i in range(40):
+        ts = now - 40 + i
+        # starved: constant queue, frozen completion counter
+        store.record("tenant_queued", 3, ts=ts, labels={"tenant": "starved"})
+        store.record(
+            "tenant_completed", 7, ts=ts, labels={"tenant": "starved"}
+        )
+        # busy: constant queue but completions increasing
+        store.record("tenant_queued", 5, ts=ts, labels={"tenant": "busy"})
+        store.record(
+            "tenant_completed", i, ts=ts, labels={"tenant": "busy"}
+        )
+    rule = TenantStarvationRule(window_s=30.0)
+    firing = rule.evaluate(store, now)
+    assert firing is not None
+    assert firing["tenants"] == ["starved"]
+    assert firing["metric"] == "tenant_queued"
+
+
+def test_tenant_starvation_needs_the_whole_window():
+    """A queue that just filled is starting, not starved — and a tenant
+    whose completion series is missing entirely IS starving (a service
+    wedged before its first completion never writes the counter)."""
+    from cubed_tpu.observability.alerts import TenantStarvationRule
+
+    now = 1000.0
+    rule = TenantStarvationRule(window_s=30.0)
+    fresh = TimeSeriesStore()
+    for i in range(5):  # only the last 5s of the window
+        fresh.record(
+            "tenant_queued", 4, ts=now - 5 + i, labels={"tenant": "new"}
+        )
+    assert rule.evaluate(fresh, now) is None
+
+    wedged = TimeSeriesStore()
+    for i in range(40):
+        wedged.record(
+            "tenant_queued", 4, ts=now - 40 + i, labels={"tenant": "wedged"}
+        )
+    firing = rule.evaluate(wedged, now)
+    assert firing is not None and firing["tenants"] == ["wedged"]
 
 
 # ---------------------------------------------------------------------------

@@ -72,6 +72,33 @@ def test_render_fleet_table_progress_and_alerts():
     assert "ALERTS (1 active)" in frame
 
 
+def test_render_tenant_panel():
+    """The multi-tenant service section: one row per tenant with weight,
+    queue/run/done counts, cache hit rate and throttle state."""
+    snap = _snapshot()
+    snap["service"] = {
+        "tenants": {
+            "gold": {
+                "weight": 2.0, "queued": 3, "running": 1, "completed": 10,
+                "failed": 0, "throttled": 0, "plan_cache_hits": 2,
+                "result_cache_hits": 3,
+            },
+            "free": {
+                "weight": 1.0, "queued": 7, "running": 0, "completed": 4,
+                "failed": 1, "throttled": 5, "plan_cache_hits": 0,
+                "result_cache_hits": 0,
+            },
+        },
+        "queue_depth": 10, "running": 1, "slots": 2, "throttling": True,
+    }
+    frame = top.render(snap)
+    assert "TENANTS" in frame and "THROTTLING" in frame
+    assert "gold" in frame and "free" in frame
+    assert "50%" in frame  # gold's cache hit rate: (2+3)/10
+    # a service-less snapshot renders no tenant panel at all
+    assert "TENANTS" not in top.render(_snapshot())
+
+
 def test_render_empty_snapshot_is_graceful():
     frame = top.render({"ts": time.time(), "metrics": {}, "fleet": {},
                         "computes": [], "alerts": [], "series": []})

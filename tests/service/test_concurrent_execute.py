@@ -1,0 +1,204 @@
+"""Satellite regression: concurrent ``Plan.execute`` from multiple threads
+in one process is safe — the gensym counter can't mint duplicate plan
+identifiers, intermediate array paths never collide, the compute-id env
+export can't clobber a live sibling's value, and two concurrent computes
+produce bitwise-correct results (the ``CUBED_TPU_CONTEXT_ID`` collision
+hazard from PR 8)."""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+from cubed_tpu.observability import logs
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+from cubed_tpu.storage.zarr import LazyZarrArray
+from cubed_tpu.utils import gensym
+
+
+@pytest.fixture()
+def spec(tmp_path):
+    return ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+
+
+def test_gensym_unique_under_thread_contention():
+    names: list = []
+    lock = threading.Lock()
+
+    def mint(n=300):
+        mine = [gensym("op-race") for _ in range(n)]
+        with lock:
+            names.extend(mine)
+
+    threads = [threading.Thread(target=mint) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(names) == len(set(names)) == 8 * 300
+
+
+def test_compute_scope_env_export_is_concurrency_safe():
+    """A finishing scope must not clobber a live sibling's env export."""
+    var = logs.COMPUTE_ID_ENV_VAR
+    os.environ.pop(var, None)
+    release_a = threading.Event()
+    a_exported = threading.Event()
+    b_done = threading.Event()
+    observed = {}
+
+    def compute_a():
+        with logs.compute_scope("c-AAA", export_env=True):
+            a_exported.set()
+            release_a.wait(timeout=10)
+        observed["after_a_exit"] = os.environ.get(var)
+
+    def compute_b():
+        a_exported.wait(timeout=10)
+        with logs.compute_scope("c-BBB", export_env=True):
+            pass  # B enters and exits while A is still live
+        b_done.set()
+
+    ta = threading.Thread(target=compute_a)
+    tb = threading.Thread(target=compute_b)
+    ta.start()
+    tb.start()
+    assert b_done.wait(timeout=10)
+    # B exited while A's scope is live: B saw A's id as "previous" and
+    # restored it — A's export must still stand
+    assert os.environ.get(var) == "c-AAA"
+    release_a.set()
+    ta.join(timeout=10)
+    tb.join(timeout=10)
+    # both scopes exited: the export is fully cleaned up
+    assert os.environ.get(var) is None
+    assert observed["after_a_exit"] is None
+
+
+def test_compute_scope_env_export_drops_dead_previous():
+    """Out-of-order exits: when B exits after A already finished, B must
+    DROP A's id (a dead compute), not resurrect it into the env. Each
+    scope runs on its own thread, like concurrent service computes."""
+    var = logs.COMPUTE_ID_ENV_VAR
+    os.environ.pop(var, None)
+    a_in, a_exit, a_done = (threading.Event() for _ in range(3))
+    b_in, b_exit, b_done = (threading.Event() for _ in range(3))
+
+    def compute_a():
+        with logs.compute_scope("c-dead", export_env=True):
+            a_in.set()
+            a_exit.wait(timeout=10)
+        a_done.set()
+
+    def compute_b():
+        a_in.wait(timeout=10)
+        with logs.compute_scope("c-later", export_env=True):
+            b_in.set()
+            b_exit.wait(timeout=10)
+        b_done.set()
+
+    ta = threading.Thread(target=compute_a)
+    tb = threading.Thread(target=compute_b)
+    ta.start()
+    tb.start()
+    assert b_in.wait(timeout=10)
+    a_exit.set()                     # A dies first, while B is live
+    assert a_done.wait(timeout=10)
+    assert os.environ.get(var) == "c-later"
+    b_exit.set()
+    assert b_done.wait(timeout=10)
+    # B must not restore the finished A's id
+    assert os.environ.get(var) is None
+    ta.join(timeout=10)
+    tb.join(timeout=10)
+
+    # ...but an EXTERNAL pin (operator-set, never scope-exported) is
+    # always restored
+    os.environ[var] = "operator-pin"
+    with logs.compute_scope("c-x", export_env=True):
+        assert os.environ.get(var) == "c-x"
+    assert os.environ.get(var) == "operator-pin"
+    os.environ.pop(var, None)
+
+
+def _intermediate_stores(finalized) -> set:
+    return {
+        str(d["target"].store)
+        for _, d in finalized.dag.nodes(data=True)
+        if d.get("type") == "array" and isinstance(d.get("target"), LazyZarrArray)
+    }
+
+
+def test_two_concurrent_computes_bitwise_correct_disjoint_paths(spec):
+    """The acceptance regression: two computes built and executed
+    concurrently in one process produce bitwise-correct results and write
+    their intermediates to non-colliding store paths."""
+    an = np.arange(144, dtype=np.float64).reshape(12, 12)
+
+    def build(k):
+        a = ct.from_array(an, chunks=(3, 3), spec=spec)
+        b = ct.map_blocks(lambda x, _k=k: x * _k, a, dtype=np.float64)
+        return ct.map_blocks(lambda x, _k=k: x + _k, b, dtype=np.float64)
+
+    r1, r2 = build(2.0), build(5.0)
+    # the plans' materialized targets never collide, even within one
+    # shared CUBED_TPU_CONTEXT_ID (names come from the locked counter)
+    f1 = r1.plan._finalize(array_names=(r1.name,))
+    f2 = r2.plan._finalize(array_names=(r2.name,))
+    assert _intermediate_stores(f1).isdisjoint(_intermediate_stores(f2))
+
+    results: dict = {}
+    errors: list = []
+
+    def run(key, arr, finalized):
+        try:
+            arr.plan.execute(
+                executor=AsyncPythonDagExecutor(),
+                array_names=(arr.name,),
+                spec=spec,
+                finalized=finalized,
+            )
+            results[key] = arr._read_stored()
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append((key, e))
+
+    t1 = threading.Thread(target=run, args=("a", r1, f1))
+    t2 = threading.Thread(target=run, args=("b", r2, f2))
+    t1.start()
+    t2.start()
+    t1.join(timeout=120)
+    t2.join(timeout=120)
+    assert not errors, errors
+    np.testing.assert_array_equal(results["a"], an * 2.0 + 2.0)
+    np.testing.assert_array_equal(results["b"], an * 5.0 + 5.0)
+
+
+def test_concurrent_computes_through_top_level_compute(spec):
+    """Same regression through the public ``.compute()`` path (each
+    thread owns its finalize + execute end-to-end)."""
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    results: dict = {}
+    errors: list = []
+
+    def run(k):
+        try:
+            a = ct.from_array(an, chunks=(4, 4), spec=spec)
+            r = ct.map_blocks(lambda x, _k=k: x - _k, a, dtype=np.float64)
+            results[k] = r.compute(executor=AsyncPythonDagExecutor())
+        except BaseException as e:  # noqa: BLE001
+            errors.append((k, e))
+
+    threads = [
+        threading.Thread(target=run, args=(float(k),)) for k in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for k in range(4):
+        np.testing.assert_array_equal(results[float(k)], an - float(k))
