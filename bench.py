@@ -209,12 +209,17 @@ print("smoke ok", float(x), round(time.perf_counter() - t0, 2), flush=True)
 """
 
 #: fleet sizes for the scaling sweep (tasks/sec per size; efficiency is
-#: tps(n) / (n * tps(1)))
-FLEET_SIZES = (1, 2, 4, 8)
+#: tps(n) / (n * tps(1))). 16/32 are production-ish fleet sizes: the
+#: ROADMAP item-5 target is that scaling efficiency there is a TRACKED,
+#: gated number, not an anecdote — worker processes are sleep-bound, so a
+#: 2-core container can still host 32 of them meaningfully
+FLEET_SIZES = (1, 2, 4, 8, 16, 32)
 #: tasks in the sweep workload and the per-task sleep: sleep-bound bodies
 #: make tasks/sec measure the FLEET's dispatch/requeue machinery (what the
-#: autoscaler and drain path touch), not this host's core count
-FLEET_TASKS = 64
+#: autoscaler and drain path touch), not this host's core count. 128
+#: tasks keep the largest fleet at 4 tasks/worker so the number still
+#: measures sustained dispatch, not a one-round burst
+FLEET_TASKS = 128
 FLEET_TASK_DELAY_S = 0.05
 
 FLEET_SCALING = r"""
@@ -354,11 +359,11 @@ def measure_scheduler_overlap(timeout: float):
 
 
 def measure_fleet_scaling(timeout: float):
-    """tasks/sec on the distributed fleet at 1→2→4→8 local workers.
+    """tasks/sec on the distributed fleet at 1→2→4→8→16→32 local workers.
 
     Runs tunnel-free (the fleet path never touches a device); each size
-    boots a fresh fleet, runs a sleep-bound 64-task compute, and reports
-    tasks/sec. The parent derives per-size scaling efficiency
+    boots a fresh fleet, runs a sleep-bound ``FLEET_TASKS``-task compute,
+    and reports tasks/sec. The parent derives per-size scaling efficiency
     (``tps(n) / (n * tps(1))``) so fleet-dispatch regressions become a
     tracked number instead of an anecdote. Returns ``None`` on failure —
     the scaling record is additive, never the reason a bench run dies."""
@@ -781,6 +786,115 @@ def measure_telemetry_overhead(timeout: float):
         return res
     except Exception as e:
         print(f"telemetry overhead sweep skipped: {e}", file=sys.stderr)
+        return None
+
+
+ANALYTICS_OVERHEAD = r"""
+import json, sys, tempfile, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import cubed_tpu as ct
+from cubed_tpu.observability import TraceCollector
+from cubed_tpu.observability.analytics import analyze
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+
+DEPTH, N, CHUNK = {depth!r}, {n!r}, {chunk!r}
+
+
+def bump(x):
+    return x + 1.0
+
+
+an = np.arange(N * N, dtype=np.float64).reshape(N, N)
+
+
+def run_chain(collector=None):
+    spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="2GB",
+                   scheduler="dataflow")
+    a = ct.from_array(an, chunks=(CHUNK, CHUNK), spec=spec)
+    r = a
+    for _ in range(DEPTH):
+        r = ct.map_blocks(bump, r, dtype=np.float64)
+    callbacks = [collector] if collector is not None else None
+    t0 = time.perf_counter()
+    val = np.asarray(r.compute(executor=AsyncPythonDagExecutor(),
+                               callbacks=callbacks, optimize_graph=False))
+    elapsed = time.perf_counter() - t0
+    analyze_s = 0.0
+    if collector is not None:
+        t1 = time.perf_counter()
+        rep = analyze(collector)
+        analyze_s = time.perf_counter() - t1
+        assert rep.to_dict()["critical_path"], "empty critical path"
+    assert (val == an + DEPTH).all()
+    return elapsed, analyze_s
+
+
+run_chain()  # warm-up outside both timed windows (imports, tracing, IO)
+out = {{}}
+# best-of-3 per mode (sub-second chain; scheduling noise would otherwise
+# drown the tax being measured). ARMED = a TraceCollector attached (span
+# recording + chunk-graph capture active) and analyze() run post-compute
+# — the full analytics cost a compute pays when someone is watching
+for mode in ("off", "on"):
+    best = None
+    for _ in range(3):
+        collector = TraceCollector(trace_dir=None) if mode == "on" else None
+        elapsed, analyze_s = run_chain(collector)
+        total = elapsed + analyze_s
+        if best is None or total < best[0]:
+            best = (total, elapsed, analyze_s)
+    out[mode] = {{"elapsed": best[1], "analyze_s": best[2]}}
+    print("analytics", mode, round(best[0], 3), "s",
+          file=sys.stderr, flush=True)
+off_s = max(out["off"]["elapsed"], 1e-9)
+on_total = out["on"]["elapsed"] + out["on"]["analyze_s"]
+out["overhead_pct"] = (on_total - off_s) / off_s * 100.0
+out["analyze_s"] = out["on"]["analyze_s"]
+# the generic perf gate reads this key: the ARMED total (compute with the
+# collector attached + the analyze() pass) is what must not regress
+out["elapsed"] = on_total
+print(json.dumps(out), flush=True)
+"""
+
+
+def measure_analytics_overhead(timeout: float):
+    """Deep-chain wall clock, analytics armed (TraceCollector + post-hoc
+    ``analyze()``) vs off.
+
+    Records ``{"off": {...}, "on": {...}, "overhead_pct": x, "analyze_s":
+    s, "elapsed": armed_total}`` into BENCH_METRICS.json as
+    ``analytics_overhead``; the top-level ``elapsed`` rides the generic
+    >20% perf gate, so span recording + chunk-graph capture + the
+    critical-path pass must stay cheap forever. Returns None on failure —
+    additive, never the reason a bench run dies."""
+    script = ANALYTICS_OVERHEAD.format(
+        repo=REPO, depth=SCHED_DEPTH, n=SCHED_N, chunk=SCHED_CHUNK,
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            env=_scrubbed_cpu_env(),
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"analytics overhead failed (rc={out.returncode}): "
+                f"{out.stderr[-2000:]}"
+            )
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        print(
+            f"analytics overhead: {res['overhead_pct']:+.1f}% "
+            f"({res['off']['elapsed']:.2f}s off -> "
+            f"{res['on']['elapsed']:.2f}s armed + "
+            f"{res['analyze_s']:.3f}s analyze)",
+            file=sys.stderr, flush=True,
+        )
+        return res
+    except Exception as e:
+        print(f"analytics overhead sweep skipped: {e}", file=sys.stderr)
         return None
 
 
@@ -1285,10 +1399,11 @@ def main() -> None:
                 "executor_stats": stats or None,
             }
 
-    # fleet scaling: tasks/sec at 1→2→4→8 workers, budget permitting —
-    # sleep-bound tasks, so ~20s of sweep + fleet boots
-    if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 90:
-        scaling = measure_fleet_scaling(_remaining(120))
+    # fleet scaling: tasks/sec at 1→2→4→8→16→32 workers, budget
+    # permitting — sleep-bound tasks, so the sweep cost is dominated by
+    # the 63 worker boots, not compute
+    if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 110:
+        scaling = measure_fleet_scaling(_remaining(180))
         if scaling is not None:
             metrics_record["fleet_scaling"] = scaling
     else:
@@ -1334,6 +1449,17 @@ def main() -> None:
             metrics_record["telemetry_overhead"] = tele
     else:
         print("telemetry overhead sweep skipped: out of budget",
+              file=sys.stderr)
+
+    # analytics overhead: the deep chain with a TraceCollector attached +
+    # a post-compute analyze() pass vs unobserved — the armed total rides
+    # the generic >20% perf gate
+    if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 45:
+        ana = measure_analytics_overhead(_remaining(90))
+        if ana is not None:
+            metrics_record["analytics_overhead"] = ana
+    else:
+        print("analytics overhead sweep skipped: out of budget",
               file=sys.stderr)
 
     # multi-tenant service: sustained submissions from N synthetic

@@ -102,6 +102,13 @@ class CoreArray:
     def visualize(self, *args, **kwargs):
         return self.plan.visualize(*args, **kwargs)
 
+    def explain(self, **kwargs):
+        """EXPLAIN the plan that computes this array (``Plan.explain``),
+        defaulting the spec and target array name to this array's."""
+        kwargs.setdefault("spec", self.spec)
+        kwargs.setdefault("array_names", (self.name,))
+        return self.plan.explain(**kwargs)
+
     def __getitem__(self, key):
         from .ops import index
 

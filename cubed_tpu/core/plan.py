@@ -428,6 +428,30 @@ class Plan:
         finalized = self._finalize(optimize_graph, optimize_function)
         return finalized.total_nbytes_written()
 
+    def explain(
+        self,
+        spec=None,
+        optimize_graph=True,
+        optimize_function=None,
+        array_names=None,
+    ):
+        """EXPLAIN this plan pre-execution: finalize it exactly like
+        ``execute`` would and report per-op task counts, projected memory
+        vs ``allowed_mem``, predicted bytes read/written (+ peer-eligible),
+        the fusion outcome, and the scheduler/barrier decisions — an
+        :class:`~cubed_tpu.observability.analytics.ExplainReport`
+        (``print()`` it, ``.to_dict()`` it, or ``.save(path)`` for
+        ``python -m cubed_tpu.explain``)."""
+        from ..observability.analytics import explain as _explain
+
+        return _explain(
+            self,
+            spec=spec,
+            optimize_graph=optimize_graph,
+            optimize_function=optimize_function,
+            array_names=array_names,
+        )
+
     def visualize(
         self,
         filename="cubed",
@@ -502,6 +526,12 @@ class FinalizedPlan:
             for _, d in self.dag.nodes(data=True)
             if d.get("type") == "array" and isinstance(d.get("target"), LazyZarrArray)
         )
+
+    def explain(self, spec=None):
+        """EXPLAIN this already-finalized plan (see ``Plan.explain``)."""
+        from ..observability.analytics import explain_finalized
+
+        return explain_finalized(self, spec=spec)
 
 
 def arrays_to_dag(*arrays) -> nx.MultiDiGraph:

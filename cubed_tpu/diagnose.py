@@ -135,6 +135,9 @@ def render_report(bundle: dict, timeline_limit: int = 20) -> str:
     err = m.get("error")
     if err:
         out.append(_section("failure"))
+        if not isinstance(err, dict):
+            # tolerate degenerate/older manifests that stored a bare string
+            err = {"type": "error", "message": str(err)}
         where = ""
         if err.get("op") or err.get("chunk"):
             where = f" in op {err.get('op')} chunk {err.get('chunk')}"
@@ -164,6 +167,10 @@ def render_report(bundle: dict, timeline_limit: int = 20) -> str:
                 + (f" ({util:.0%} of projection)" if util else "")
             )
 
+    # bundles written before the live-telemetry layer existed carry no
+    # "alerts"/"timeseries" keys at all — every section here treats a
+    # missing artifact as empty, never as an error (regression-tested in
+    # tests/observability/test_analytics.py)
     alerts = m.get("alerts") or []
     if alerts:
         from .observability.alerts import format_alert_row
@@ -283,6 +290,12 @@ def main(argv: Optional[list] = None) -> int:
         "--timeline-limit", type=int, default=20,
         help="max events shown per decision timeline (default 20)",
     )
+    parser.add_argument(
+        "--analyze", action="store_true",
+        help="append the ANALYZE report: dependency-weighted critical "
+        "path + wall-clock attribution (kernel/storage/peer/queue/retry/"
+        "straggler buckets) from the bundle's trace",
+    )
     args = parser.parse_args(argv)
     try:
         bundle = load_bundle(args.bundle)
@@ -290,6 +303,16 @@ def main(argv: Optional[list] = None) -> int:
         print(f"cannot read bundle {args.bundle!r}: {e}", file=sys.stderr)
         return 2
     sys.stdout.write(render_report(bundle, timeline_limit=args.timeline_limit))
+    if args.analyze:
+        from .observability.analytics import analyze
+
+        sys.stdout.write(_section("analysis") + "\n")
+        try:
+            sys.stdout.write(analyze(bundle).render())
+        except (ValueError, KeyError) as e:
+            # an old/partial bundle (no trace.json, no task spans) still
+            # renders the base report — analysis degrades with a note
+            sys.stdout.write(f"analysis unavailable: {e}\n")
     return 0
 
 

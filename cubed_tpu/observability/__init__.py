@@ -29,7 +29,15 @@ in ``runtime/types.py``); this package turns that stream into
   thread armed via ``Spec(telemetry_port=...)`` /
   ``CUBED_TPU_TELEMETRY_PORT`` (``export``), watched by an
   :class:`AlertEngine` (``alerts``) and rendered live by
-  ``python -m cubed_tpu.top``.
+  ``python -m cubed_tpu.top``;
+- **compute analytics**: :func:`explain` / ``plan.explain()`` renders the
+  finalized plan's predictions pre-execution (task counts, projected vs
+  allowed memory, predicted IO, fusion + scheduler/barrier decisions;
+  ``python -m cubed_tpu.explain``), and :func:`analyze` extracts the
+  dependency-weighted **critical path** and a wall-clock attribution
+  breakdown (kernel / storage / peer / queue wait / retry / straggler
+  excess) from a flight-recorder bundle (``analytics``;
+  ``python -m cubed_tpu.diagnose <bundle> --analyze``).
 """
 
 from .accounting import (  # noqa: F401
@@ -40,6 +48,12 @@ from .accounting import (  # noqa: F401
     scope_span,
     store_totals,
     task_scope,
+)
+from .analytics import (  # noqa: F401
+    AnalysisReport,
+    ExplainReport,
+    analyze,
+    explain,
 )
 from .callback import TracingCallback  # noqa: F401
 from .collect import (  # noqa: F401

@@ -56,6 +56,11 @@ logger = logging.getLogger(__name__)
 MAX_DECISIONS = 4096
 MAX_SAMPLES = 4096
 MAX_OOB_TASKS = 1024
+#: chunk graphs retained for post-compute analytics (one per recent
+#: compute) and the per-graph task bound — a million-task graph must not
+#: pin a million edge lists in the ring; truncation is counted, not silent
+MAX_CHUNK_GRAPHS = 4
+MAX_GRAPH_TASKS = 50_000
 
 _ring_lock = threading.Lock()
 _decisions: deque = deque(maxlen=MAX_DECISIONS)
@@ -64,6 +69,10 @@ _samples: deque = deque(maxlen=MAX_SAMPLES)
 #: and client-side recompute repairs — work with no TaskEndEvent to ride,
 #: merged into the trace at export like the decision ring
 _oob_tasks: deque = deque(maxlen=MAX_OOB_TASKS)
+#: chunk-level dependency edges per recent compute (dataflow scheduler
+#: records them while spans are armed); the flight recorder embeds them in
+#: its manifest so ``analytics.analyze`` can walk the true critical path
+_chunk_graphs: deque = deque(maxlen=MAX_CHUNK_GRAPHS)
 
 
 #: extra consumers of decision entries beyond the bounded ring — the
@@ -183,6 +192,61 @@ def record_repair_spans(chunk, store, scope_stats: dict) -> None:
         _oob_tasks.append(entry)
 
 
+def record_chunk_graph(edges: dict, compute_id: Optional[str] = None) -> None:
+    """Retain one compute's chunk-level dependency edges for analytics.
+
+    ``edges`` maps ``"<op>\\t<chunk>"`` task keys to lists of the task keys
+    they depend on (``ChunkGraph.edges_by_key``). Graphs beyond
+    ``MAX_GRAPH_TASKS`` tasks are truncated to the bound (counted in
+    ``chunk_graph_tasks_truncated``) — the analytics layer degrades to the
+    op-graph approximation for the missing tail, it never silently loses
+    the whole graph."""
+    if compute_id is None:
+        compute_id = logs.current_compute_id()
+    truncated = 0
+    if len(edges) > MAX_GRAPH_TASKS:
+        truncated = len(edges) - MAX_GRAPH_TASKS
+        edges = dict(list(edges.items())[:MAX_GRAPH_TASKS])
+        get_registry().counter("chunk_graph_tasks_truncated").inc(truncated)
+        logger.warning(
+            "chunk graph for compute %s exceeds the %d-task analytics "
+            "bound; %d task(s) truncated (critical-path extraction falls "
+            "back to op-level edges for them)",
+            compute_id, MAX_GRAPH_TASKS, truncated,
+        )
+    entry = {
+        "ts": clock.now(),
+        "compute_id": compute_id,
+        "edges": edges,
+        "truncated": truncated,
+    }
+    with _ring_lock:
+        _chunk_graphs.append(entry)
+
+
+def chunk_graph_for(
+    compute_id: Optional[str] = None, since: Optional[float] = None,
+) -> Optional[dict]:
+    """The most recent recorded chunk graph matching ``compute_id`` (or,
+    when None, the newest one recorded at/after ``since``); None when the
+    compute ran without the dataflow scheduler or unobserved."""
+    with _ring_lock:
+        entries = list(_chunk_graphs)
+    for entry in reversed(entries):
+        if compute_id is not None and entry["compute_id"] == compute_id:
+            return entry["edges"]
+    if compute_id is not None and since is None:
+        return None
+    for entry in reversed(entries):
+        # id-less fallback (graphs recorded outside a compute scope —
+        # direct scheduler use in tests): newest graph in the window
+        if entry["compute_id"] is None and (
+            since is None or entry["ts"] >= since
+        ):
+            return entry["edges"]
+    return None
+
+
 def decisions_since(t0: float) -> list:
     with _ring_lock:
         return [d for d in _decisions if d["ts"] >= t0]
@@ -250,6 +314,11 @@ class TraceCollector(EventLogCallback):
         #: worker/pid key -> smallest observed (result-receipt - worker-end)
         #: delta, the latency-bounded clock-offset estimate
         self._raw_offsets: dict[str, float] = {}
+        #: op -> sorted producing-op names, captured from the finalized dag
+        #: at compute start — the op-level dependency skeleton analytics
+        #: falls back to when no chunk graph was recorded (op-level
+        #: scheduler, or a task beyond the chunk-graph bound)
+        self._op_graph: dict[str, list] = {}
         self._lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------
@@ -267,6 +336,39 @@ class TraceCollector(EventLogCallback):
         self._peaks = {}
         self._durations = {}
         self._raw_offsets = {}
+        self._op_graph = {}
+        try:
+            dag = event.dag
+            nodes = dict(dag.nodes(data=True))
+            for name, d in nodes.items():
+                if d.get("type") != "op" or d.get("primitive_op") is None:
+                    continue
+                preds = set()
+                for pred in dag.predecessors(name):
+                    pd = nodes[pred]
+                    if pd.get("type") == "op":
+                        if pd.get("primitive_op") is not None:
+                            preds.add(pred)
+                        continue
+                    for producer in dag.predecessors(pred):
+                        pr = nodes[producer]
+                        if (
+                            pr.get("type") == "op"
+                            and pr.get("primitive_op") is not None
+                        ):
+                            preds.add(producer)
+                self._op_graph[name] = sorted(preds)
+        except Exception:  # introspection must never fail a compute
+            logger.exception("op-graph capture failed; analytics degrades")
+
+    def op_graph(self) -> dict:
+        """``op -> [producing op, ...]`` for the compute's finalized dag."""
+        return {k: list(v) for k, v in self._op_graph.items()}
+
+    def chunk_graph(self) -> Optional[dict]:
+        """This compute's recorded chunk-level edges (dataflow scheduler,
+        spans armed), or None — see :func:`chunk_graph_for`."""
+        return chunk_graph_for(self.compute_id, since=self._t0)
 
     def on_task_end(self, event) -> None:
         # deliberately NOT super(): fold into bounded records instead of

@@ -182,6 +182,12 @@ class FlightRecorder(TraceCollector):
             "timeseries": self._timeseries_dump(),
             "stragglers": self.stragglers(),
             "clock_offsets": self.clock_offsets(),
+            # the dependency structure analytics (analyze()/diagnose
+            # --analyze) walks for the critical path: the op-level skeleton
+            # always, the chunk-level edges when the dataflow scheduler
+            # recorded them (spans armed)
+            "op_graph": self.op_graph(),
+            "chunk_graph": self.chunk_graph(),
             "task_records": len(self._records),
             "task_records_dropped": self.records_dropped,
         }

@@ -612,6 +612,31 @@ class TelemetrySampler:
                     "tenant_throttled_total", row.get("throttled"), ts=now,
                     labels=labels,
                 )
+                # the tenant_cost_* family: cumulative consumption per
+                # tenant (task-seconds, store/peer bytes, retry draw) from
+                # the service's _CostTracker fold — what a quota/billing
+                # story reads off /metrics
+                cost = row.get("cost") or {}
+                self.store.record(
+                    "tenant_cost_task_seconds", cost.get("task_seconds"),
+                    ts=now, labels=labels,
+                )
+                self.store.record(
+                    "tenant_cost_bytes_read", cost.get("bytes_read"),
+                    ts=now, labels=labels,
+                )
+                self.store.record(
+                    "tenant_cost_bytes_written", cost.get("bytes_written"),
+                    ts=now, labels=labels,
+                )
+                self.store.record(
+                    "tenant_cost_peer_bytes", cost.get("peer_bytes"),
+                    ts=now, labels=labels,
+                )
+                self.store.record(
+                    "tenant_cost_retries", cost.get("retries"),
+                    ts=now, labels=labels,
+                )
 
     def _sample_computes(self, now: float) -> None:
         for row in compute_progress():

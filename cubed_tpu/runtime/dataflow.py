@@ -178,6 +178,34 @@ class ChunkGraph:
         #: ops that became barriers (for logs/decisions)
         self.barrier_ops: List[str] = []
 
+    def edges_by_key(self) -> Dict[str, list]:
+        """Per-task dependency edges keyed by the SAME identity the merged
+        trace stamps on task events: ``"<op>\\t<chunk_key(m)>"`` (the
+        executors' ``key_of`` is ``utils.chunk_key`` over the mappable
+        item, so a trace task record joins an edge key exactly). This is
+        what the analytics layer (``observability/analytics.py``) walks to
+        extract the dependency-weighted critical path from a
+        flight-recorder bundle — JSON-ready, values sorted for stable
+        output."""
+        from .utils import chunk_key
+
+        keys: List[Optional[str]] = [None] * len(self.items)
+
+        def key_for(idx: int) -> str:
+            k = keys[idx]
+            if k is None:
+                op, m = self.items[idx]
+                k = keys[idx] = f"{op}\t{chunk_key(m)}"
+            return k
+
+        out: Dict[str, list] = {}
+        for idx in range(len(self.items)):
+            deps = self.dependencies.get(idx)
+            out[key_for(idx)] = (
+                [key_for(d) for d in sorted(deps)] if deps else []
+            )
+        return out
+
 
 def _op_predecessor_ops(dag, name: str, nodes: dict) -> Set[str]:
     """Direct producing ops of *name*'s inputs: array predecessors resolve
@@ -457,11 +485,22 @@ class DataflowScheduler:
         dataflow mode an op's lifetime is first-dispatch → last-complete,
         which keeps per-op wall clocks and trace lanes meaningful under
         overlap."""
-        from ..observability.collect import record_decision
+        from ..observability import accounting
+        from ..observability.collect import (
+            record_chunk_graph,
+            record_decision,
+        )
 
         metrics = get_registry()
         if self.graph.barrier_tasks:
             metrics.counter("op_barrier_waits").inc(self.graph.barrier_tasks)
+        if accounting.spans_enabled():
+            # a trace collector is watching this compute: hand it the
+            # chunk-level edges so post-compute analytics can walk the
+            # TRUE dependency-weighted critical path instead of the
+            # op-barrier approximation (pay-for-what-you-watch, same
+            # arming as span recording)
+            record_chunk_graph(self.graph.edges_by_key())
         record_decision(
             "dataflow_graph",
             ops=len(self.graph.op_order),

@@ -236,6 +236,14 @@ class RequestHandle:
         request starts executing."""
         return self._request.compute_id
 
+    @property
+    def cost(self) -> Optional[dict]:
+        """What this request's execution consumed (task-seconds, store
+        bytes R/W, peer bytes, retry draw) — None until it ran, and None
+        forever for cache hits/coalesced followers (they cost ~nothing)."""
+        cost = self._request.cost
+        return dict(cost) if cost is not None else None
+
     def status(self) -> str:
         return self._request.state
 
@@ -280,7 +288,7 @@ class _Request:
         "value", "error", "submitted_at", "started_at", "ended_at",
         "plan_cache_hit", "result_cache_hit", "recovered",
         "resume_journal", "durable", "compute_id", "coalesced_into",
-        "fingerprint", "canonical",
+        "fingerprint", "canonical", "cost",
     )
 
     def __init__(self, service: "ComputeService", tenant: str, array,
@@ -307,6 +315,9 @@ class _Request:
         #: _execute so the masking-pickle pass runs once per request
         self.fingerprint: Optional[str] = None
         self.canonical: Optional[list] = None
+        #: what this request's execution consumed (``_CostTracker``;
+        #: None until it runs — cache hits keep it None = zero cost)
+        self.cost: Optional[dict] = None
 
 
 class _ComputeIdCallback:
@@ -320,11 +331,63 @@ class _ComputeIdCallback:
         self._request.compute_id = getattr(event, "compute_id", None)
 
 
+class _CostTracker:
+    """Per-request cost accounting, folded from the compute's own event
+    stream (exact per compute even when requests run concurrently — the
+    same reason ``_ComputeAggregator``'s per_op numbers are exact):
+
+    - **task_seconds**: summed task-body durations, measured where each
+      task ran — the fleet-time the request consumed;
+    - **bytes_read / bytes_written**: store IO attributed to its tasks;
+    - **peer_bytes**: bytes served worker-to-worker instead of from the
+      store (the ``peer_bytes_fetched`` scope counter riding task stats);
+    - **retries**: completions that needed attempt > 0 — the request's
+      draw on the shared retry budget.
+
+    A result-cache hit or coalesced follower never attaches one of these
+    to an execution, so cached answers honestly cost ~zero — exactly the
+    incentive the cache exists to create."""
+
+    __slots__ = (
+        "task_seconds", "bytes_read", "bytes_written", "peer_bytes",
+        "retries",
+    )
+
+    def __init__(self):
+        self.task_seconds = 0.0
+        self.bytes_read = 0
+        self.bytes_written = 0
+        self.peer_bytes = 0
+        self.retries = 0
+
+    def on_task_end(self, event) -> None:
+        start = getattr(event, "function_start_tstamp", None)
+        end = getattr(event, "function_end_tstamp", None)
+        if start is not None and end is not None:
+            self.task_seconds += max(0.0, end - start)
+        self.bytes_read += getattr(event, "bytes_read", None) or 0
+        self.bytes_written += getattr(event, "bytes_written", None) or 0
+        counters = getattr(event, "counters", None) or {}
+        self.peer_bytes += counters.get("peer_bytes_fetched", 0) or 0
+        if getattr(event, "attempt", 0):
+            self.retries += 1
+
+    def as_dict(self) -> dict:
+        return {
+            "task_seconds": round(self.task_seconds, 6),
+            "bytes_read": self.bytes_read,
+            "bytes_written": self.bytes_written,
+            "peer_bytes": self.peer_bytes,
+            "retries": self.retries,
+        }
+
+
 class _TenantStats:
     __slots__ = (
         "weight", "accepted", "completed", "failed", "cancelled",
         "throttled", "recovered", "plan_cache_hits", "result_cache_hits",
-        "coalesced",
+        "coalesced", "cost_task_seconds", "cost_bytes_read",
+        "cost_bytes_written", "cost_peer_bytes", "cost_retries",
     )
 
     def __init__(self, weight: float):
@@ -338,6 +401,14 @@ class _TenantStats:
         self.plan_cache_hits = 0
         self.result_cache_hits = 0
         self.coalesced = 0
+        #: cumulative cost accounting (``_CostTracker``): what this
+        #: tenant's executed requests actually consumed — failed requests
+        #: included, because their fleet time was spent either way
+        self.cost_task_seconds = 0.0
+        self.cost_bytes_read = 0
+        self.cost_bytes_written = 0
+        self.cost_peer_bytes = 0
+        self.cost_retries = 0
 
 
 class ComputeService:
@@ -826,7 +897,8 @@ class ComputeService:
     def _run_plan(self, req: _Request, plan, finalized, target_name):
         from ..storage.zarr import open_if_lazy_zarr_array
 
-        callbacks = [_ComputeIdCallback(req)]
+        cost = _CostTracker()
+        callbacks = [_ComputeIdCallback(req), cost]
         kwargs: dict = {}
         if req.durable and self.config.service_dir:
             from ..runtime.journal import JournalCallback
@@ -843,18 +915,34 @@ class ComputeService:
             # accepted before the crash but never journaled a task:
             # integrity-verified chunks (if any) still skip
             kwargs["resume"] = True
-        plan.execute(
-            executor=self.executor,
-            callbacks=callbacks,
-            array_names=(target_name,),
-            spec=getattr(req.array, "spec", None) or self.spec,
-            finalized=finalized,
-            **kwargs,
-        )
+        try:
+            plan.execute(
+                executor=self.executor,
+                callbacks=callbacks,
+                array_names=(target_name,),
+                spec=getattr(req.array, "spec", None) or self.spec,
+                finalized=finalized,
+                **kwargs,
+            )
+        finally:
+            # a FAILED compute still spent the fleet's time: fold the cost
+            # either way, so per-tenant accounting reflects consumption,
+            # not just successful consumption
+            self._fold_cost(req, cost)
         target = finalized.dag.nodes[target_name]["target"]
         arr = open_if_lazy_zarr_array(target)
         out = arr[...] if getattr(arr, "shape", ()) else arr[()]
         return np.asarray(out)
+
+    def _fold_cost(self, req: _Request, cost: _CostTracker) -> None:
+        req.cost = cost.as_dict()
+        with self._lock:
+            stats = self._ensure_tenant_locked(req.tenant)
+            stats.cost_task_seconds += cost.task_seconds
+            stats.cost_bytes_read += cost.bytes_read
+            stats.cost_bytes_written += cost.bytes_written
+            stats.cost_peer_bytes += cost.peer_bytes
+            stats.cost_retries += cost.retries
 
     # -- completion / cancel -------------------------------------------
 
@@ -993,6 +1081,16 @@ class ComputeService:
                     "coalesced": s.coalesced,
                     "plan_cache_hits": s.plan_cache_hits,
                     "result_cache_hits": s.result_cache_hits,
+                    # cumulative cost accounting — the sampler turns these
+                    # into the tenant_cost_* series (/metrics), and the
+                    # cubed_tpu.top COST panel renders them
+                    "cost": {
+                        "task_seconds": round(s.cost_task_seconds, 6),
+                        "bytes_read": s.cost_bytes_read,
+                        "bytes_written": s.cost_bytes_written,
+                        "peer_bytes": s.cost_peer_bytes,
+                        "retries": s.cost_retries,
+                    },
                 }
             queue_depth = sum(len(q) for q in self._queues.values())
             running = len(self._running)

@@ -8,14 +8,19 @@ view:
 - a **fleet table** — one row per worker: connectivity, draining/
   pressured flags, RSS, load (outstanding/threads), lifetime tasks,
   peer-cache footprint and hit rate;
+- a **COST panel** — per-tenant consumption when a multi-tenant service
+  is live: task-seconds, store bytes read/written, peer bytes, retry
+  draw (the service's ``_CostTracker`` fold, also exported as the
+  ``tenant_cost_*`` series on ``/metrics``);
 - **compute progress** — tasks done/total with a live task rate and ETA
   (rate from the ``compute_tasks_done`` series' trailing window);
 - **recent alerts** — the alert engine's last firings, active ones
   flagged.
 
 ``--once`` prints a single refresh and exits (scripts, tests);
-``--interval`` sets the refresh period. The endpoint defaults to
-``127.0.0.1:$CUBED_TPU_TELEMETRY_PORT``.
+``--interval`` sets the refresh period; ``--snapshot <file>`` renders a
+saved ``/snapshot.json`` offline (no live fleet needed) and exits. The
+endpoint defaults to ``127.0.0.1:$CUBED_TPU_TELEMETRY_PORT``.
 """
 
 from __future__ import annotations
@@ -163,6 +168,33 @@ def render(snapshot: dict, width: int = 100) -> str:
             )
         out.append("")
 
+        # -- per-tenant cost accounting --------------------------------
+        costs = {
+            name: row.get("cost")
+            for name, row in tenants.items()
+            if isinstance(row.get("cost"), dict)
+        }
+        if costs:
+            out.append("COST  (per-tenant consumption, cumulative)")
+            out.append(
+                f"{'TENANT':<16}{'TASK-SEC':>10}{'READ':>11}"
+                f"{'WRITTEN':>11}{'PEER':>11}{'RETRIES':>9}"
+            )
+            for name in sorted(costs):
+                cost = costs[name]
+                secs = cost.get("task_seconds")
+                secs_s = (
+                    f"{secs:.2f}" if isinstance(secs, (int, float)) else "-"
+                )
+                out.append(
+                    f"{name:<16}{secs_s:>10}"
+                    f"{_fmt_mem(cost.get('bytes_read')):>11}"
+                    f"{_fmt_mem(cost.get('bytes_written')):>11}"
+                    f"{_fmt_mem(cost.get('peer_bytes')):>11}"
+                    f"{cost.get('retries', 0):>9}"
+                )
+            out.append("")
+
     # -- compute progress ----------------------------------------------
     out.append("COMPUTES")
     computes = snapshot.get("computes") or []
@@ -239,7 +271,24 @@ def main(argv: Optional[list] = None) -> int:
         "--once", action="store_true",
         help="render one frame and exit",
     )
+    parser.add_argument(
+        "--snapshot", metavar="FILE", default=None,
+        help="render a saved /snapshot.json file offline and exit "
+        "(no live endpoint needed — post-mortems, tests, CI)",
+    )
     args = parser.parse_args(argv)
+    if args.snapshot:
+        try:
+            with open(args.snapshot) as f:
+                snapshot = json.load(f)
+        except (OSError, ValueError) as e:
+            print(
+                f"cannot read snapshot file {args.snapshot!r}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+        sys.stdout.write(render(snapshot))
+        return 0
     endpoint = args.endpoint or default_endpoint()
     while True:
         try:

@@ -1,0 +1,145 @@
+"""EXPLAIN tests: plan predictions (tasks, memory vs allowed, predicted
+IO, fusion, scheduler/barrier decisions), report round-trip, and the
+``python -m cubed_tpu.explain`` CLI."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+from cubed_tpu import explain as explain_cli
+from cubed_tpu.observability.analytics import ExplainReport, render_explain
+
+
+@pytest.fixture
+def spec(tmp_path):
+    return ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+
+
+def _chain(spec, depth=2):
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    r = a
+    for _ in range(depth):
+        r = ct.map_blocks(lambda x: x + 1.0, r, dtype=np.float64)
+    return r
+
+
+def test_explain_totals_match_plan_introspection(spec):
+    r = _chain(spec)
+    rep = r.explain()
+    d = rep.to_dict()
+    assert d["totals"]["tasks"] > 0
+    # totals agree with the finalized plan's own introspection
+    finalized = r.plan._finalize(True, None, (r.name,))
+    assert d["totals"]["tasks"] == finalized.num_tasks()
+    assert d["totals"]["arrays"] == finalized.num_arrays()
+    assert d["totals"]["max_projected_mem"] == finalized.max_projected_mem()
+    assert d["totals"]["allowed_mem"] == spec.allowed_mem
+    assert d["totals"]["bytes_written"] >= 64 * 8  # the output array
+
+
+def test_explain_rows_and_render(spec):
+    r = _chain(spec)
+    rep = r.explain()
+    d = rep.to_dict()
+    ops = {row["op"]: row for row in d["ops"]}
+    # the map_blocks op is chunk-structured with per-task IO predictions
+    real = [
+        row for name, row in ops.items() if name != "create-arrays"
+    ]
+    assert real and all(row["tasks"] >= 1 for row in real)
+    assert any(row["chunk_structured"] for row in real)
+    assert any(row["bytes_read"] > 0 for row in real)
+    text = rep.render()
+    assert "EXPLAIN" in text
+    assert "scheduler=oplevel" in text
+    for name in ops:
+        assert name in text
+    assert str(rep) == text
+
+
+def test_explain_fusion_counts(spec):
+    # an unfused 3-op elementwise chain collapses under optimization
+    r = _chain(spec, depth=3)
+    d = r.explain().to_dict()
+    fusion = d["fusion"]
+    assert fusion["ops_before"] >= fusion["ops_after"]
+    unopt = r.explain(optimize_graph=False).to_dict()
+    assert unopt["fusion"]["ops_before"] == unopt["fusion"]["ops_after"]
+
+
+def test_explain_reports_scheduler_and_barriers(tmp_path):
+    spec = ct.Spec(
+        work_dir=str(tmp_path), allowed_mem="500MB", scheduler="dataflow"
+    )
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    # a rechunk has no chunk-level block function: an op-level barrier
+    r = ct.map_blocks(
+        lambda x: x + 1.0, a.rechunk((8, 2)), dtype=np.float64
+    )
+    d = r.explain().to_dict()
+    assert d["scheduler"] == "dataflow"
+    assert d["barriers"]["chunk_edges"] is not None
+    rows = {row["op"]: row for row in d["ops"]}
+    assert any(
+        not row["chunk_structured"]
+        for name, row in rows.items()
+        if name != "create-arrays"
+    )
+    # the rechunk consumer waits on an op-level barrier
+    assert any(row["barrier"] for row in rows.values())
+
+
+def test_explain_peer_eligible_bytes(tmp_path):
+    spec = ct.Spec(
+        work_dir=str(tmp_path), allowed_mem="500MB", peer_transfer=True
+    )
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    r = ct.map_blocks(lambda x: x + 1.0, a, dtype=np.float64)
+    r2 = ct.map_blocks(lambda x: x * 2.0, r, dtype=np.float64)
+    d = r2.explain(optimize_graph=False).to_dict()
+    assert d["peer_transfer"] is True
+    # the second op reads the first op's output — peer-eligible bytes
+    assert d["totals"]["peer_eligible_bytes"] > 0
+
+
+def test_explain_report_roundtrip_and_cli(spec, tmp_path, capsys):
+    r = _chain(spec)
+    rep = r.explain()
+    path = str(tmp_path / "explain.json")
+    rep.save(path)
+    loaded = ExplainReport.load(path)
+    assert loaded.to_dict() == rep.to_dict()
+    assert explain_cli.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "EXPLAIN" in out
+    assert explain_cli.main([path, "--json"]) == 0
+    assert '"totals"' in capsys.readouterr().out
+
+
+def test_explain_cli_subprocess(spec, tmp_path):
+    r = _chain(spec)
+    path = str(tmp_path / "explain.json")
+    r.explain().save(path)
+    out = subprocess.run(
+        [sys.executable, "-m", "cubed_tpu.explain", path],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "EXPLAIN" in out.stdout
+
+
+def test_explain_cli_missing_path(capsys):
+    assert explain_cli.main(["/nonexistent/explain.json"]) == 2
+    assert "cannot read" in capsys.readouterr().err
+
+
+def test_render_explain_tolerates_empty():
+    assert "EXPLAIN" in render_explain({})

@@ -1,10 +1,13 @@
-"""Docs-rot guard: every metric registered in the codebase must appear in
-the canonical inventory table in docs/observability.md.
+"""Docs-rot guard: every metric registered in the codebase, every
+decision-ring kind recorded, and every default alert-rule name must appear
+in the canonical tables in docs/observability.md.
 
 Greps literal ``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")``
-/ ``record_scoped_counter("...")`` registrations out of ``cubed_tpu/`` and
-fails naming any that the docs don't mention — so adding a metric without
-documenting it breaks tier-1, not a future reader's trust.
+/ ``record_scoped_counter("...")`` registrations and
+``record_decision("...")`` call sites out of ``cubed_tpu/``, imports the
+default alert-rule set, and fails naming anything the docs don't mention —
+so adding a metric, a decision kind, or an alert rule without documenting
+it breaks tier-1, not a future reader's trust.
 """
 
 from __future__ import annotations
@@ -21,14 +24,36 @@ _PATTERNS = [
     re.compile(r'record_scoped_counter\(\s*\n?\s*"([a-z0-9_]+)"'),
 ]
 
+#: decision-ring kinds: the first (string-literal) argument of every
+#: record_decision call site; the docstring mention in alerts.py matches
+#: too, harmlessly — it names a real kind
+_DECISION_PATTERN = re.compile(r'record_decision\(\s*\n?\s*"([a-z0-9_]+)"')
+
+
+def _sources() -> list:
+    return [
+        p for p in (REPO / "cubed_tpu").rglob("*.py")
+    ]
+
 
 def registered_metric_names() -> set:
     names: set = set()
-    for path in (REPO / "cubed_tpu").rglob("*.py"):
+    for path in _sources():
         src = path.read_text(encoding="utf-8")
         for pat in _PATTERNS:
             names.update(pat.findall(src))
     return names
+
+
+def recorded_decision_kinds() -> set:
+    kinds: set = set()
+    for path in _sources():
+        kinds.update(_DECISION_PATTERN.findall(path.read_text(encoding="utf-8")))
+    return kinds
+
+
+def _doc() -> str:
+    return (REPO / "docs" / "observability.md").read_text(encoding="utf-8")
 
 
 def test_metric_registrations_are_found():
@@ -42,10 +67,42 @@ def test_metric_registrations_are_found():
 
 
 def test_every_registered_metric_is_documented():
-    doc = (REPO / "docs" / "observability.md").read_text(encoding="utf-8")
+    doc = _doc()
     missing = sorted(n for n in registered_metric_names() if n not in doc)
     assert not missing, (
         "metrics registered in cubed_tpu/ but missing from the "
         f"docs/observability.md metrics table: {missing} — add each to the "
         "canonical inventory (kind + source) so the metrics docs can't rot"
+    )
+
+
+def test_decision_kind_grep_is_found():
+    kinds = recorded_decision_kinds()
+    assert "retry" in kinds
+    assert "straggler" in kinds
+    assert "alert_fired" in kinds
+    assert len(kinds) >= 25
+
+
+def test_every_decision_kind_is_documented():
+    doc = _doc()
+    missing = sorted(k for k in recorded_decision_kinds() if k not in doc)
+    assert not missing, (
+        "decision kinds recorded in cubed_tpu/ but missing from the "
+        f"docs/observability.md decision-ring table: {missing} — add each "
+        "to the canonical kinds inventory so the decision docs can't rot"
+    )
+
+
+def test_every_default_alert_rule_is_documented():
+    from cubed_tpu.observability.alerts import default_rules
+
+    doc = _doc()
+    names = [r.name for r in default_rules()]
+    assert len(names) >= 5  # the grep-equivalent sanity: rules exist
+    missing = sorted(n for n in names if n not in doc)
+    assert not missing, (
+        "default alert rules missing from the docs/observability.md "
+        f"alert-rule table: {missing} — document the rule (kind, fires "
+        "when, default) so the alert docs can't rot"
     )

@@ -99,6 +99,58 @@ def test_render_tenant_panel():
     assert "TENANTS" not in top.render(_snapshot())
 
 
+def test_render_cost_panel():
+    """Tenant rows carrying cost sub-dicts render the COST panel."""
+    snap = _snapshot()
+    snap["service"] = {
+        "tenants": {
+            "gold": {
+                "weight": 2.0, "queued": 0, "running": 0, "completed": 5,
+                "failed": 0, "throttled": 0, "plan_cache_hits": 0,
+                "result_cache_hits": 0,
+                "cost": {
+                    "task_seconds": 12.5, "bytes_read": 1_000_000,
+                    "bytes_written": 2_000_000, "peer_bytes": 0,
+                    "retries": 1,
+                },
+            },
+        },
+        "queue_depth": 0, "running": 0, "slots": 2, "throttling": False,
+    }
+    frame = top.render(snap)
+    assert "COST" in frame and "TASK-SEC" in frame
+    assert "12.50" in frame  # gold's task-seconds
+    assert "2.0 MB" in frame  # bytes written
+    # tenant rows WITHOUT cost dicts render no COST panel (old snapshots)
+    del snap["service"]["tenants"]["gold"]["cost"]
+    assert "COST" not in top.render(snap)
+
+
+def test_main_snapshot_offline_mode(capsys):
+    """--snapshot renders a saved /snapshot.json with no live endpoint —
+    the checked-in fixture covers fleet, tenants, cost, compute progress
+    and alerts in one frame."""
+    import os
+
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "snapshot.json"
+    )
+    rc = top.main(["--snapshot", fixture])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "cubed_tpu.top" in out
+    assert "local-0" in out and "local-1" in out
+    assert "TENANTS" in out and "gold" in out and "free" in out
+    assert "COST" in out and "184.25" in out
+    assert "c-8e3fcfe019" in out and "1620/3240" in out
+    assert "fleet_memory_pressure" in out
+
+
+def test_main_snapshot_missing_file(capsys):
+    assert top.main(["--snapshot", "/nonexistent/snap.json"]) == 2
+    assert "cannot read snapshot" in capsys.readouterr().err
+
+
 def test_render_empty_snapshot_is_graceful():
     frame = top.render({"ts": time.time(), "metrics": {}, "fleet": {},
                         "computes": [], "alerts": [], "series": []})

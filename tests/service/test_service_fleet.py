@@ -61,6 +61,16 @@ def test_service_over_live_fleet_with_tenant_metrics(spec):
                 text = resp.read().decode()
             assert 'tenant_queued{tenant="gold"}' in text
             assert 'tenant_completed{tenant="free"}' in text
+            # per-tenant COST accounting scraped live: the computes above
+            # really consumed fleet task-seconds, attributed per tenant
+            cost_lines = [
+                line for line in text.splitlines()
+                if line.startswith(
+                    'cubed_tpu_tenant_cost_task_seconds{tenant="gold"}'
+                )
+            ]
+            assert cost_lines, "tenant_cost_task_seconds{tenant=} missing"
+            assert float(cost_lines[0].rsplit(" ", 1)[1]) > 0
             accepted = [
                 float(line.rsplit(" ", 1)[1])
                 for line in text.splitlines()
@@ -75,6 +85,18 @@ def test_service_over_live_fleet_with_tenant_metrics(spec):
             assert tenants["gold"]["completed"] == 3
             assert tenants["free"]["completed"] == 3
             assert tenants["gold"]["weight"] == 2.0
+            # cost rows ride /snapshot.json: both tenants consumed real
+            # fleet task-seconds and wrote their output arrays
+            for tenant in ("gold", "free"):
+                cost = tenants[tenant].get("cost") or {}
+                assert cost.get("task_seconds", 0) > 0
+                assert cost.get("bytes_written", 0) >= an.nbytes
+            # ...and the top dashboard renders them as the COST panel
+            from cubed_tpu import top
+
+            frame = top.render(snap)
+            assert "COST" in frame and "TASK-SEC" in frame
+            assert "gold" in frame and "free" in frame
             # the fleet really ran these: live workers visible
             assert (snap.get("fleet") or {}).get("workers_live", 0) >= 1
     finally:
