@@ -670,6 +670,163 @@ def measure_p2p_transfer(timeout: float):
         return None
 
 
+#: rechunk-shuffle workload: a transpose-heavy pipeline (two all-to-all
+#: rechunks between elementwise maps) where the rechunk exchange
+#: dominates bytes moved — the last store round-trip the peer data plane
+#: kills. allowed_mem is sized so the copy regions stay strips (several
+#: shuffle tasks per stage) instead of consolidating into one whole-array
+#: copy
+RECHUNK_N = 128
+RECHUNK_CHUNK = 32
+RECHUNK_ALLOWED = "700KB"
+
+RECHUNK_SHUFFLE = r"""
+import json, sys, tempfile, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import cubed_tpu as ct
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime.dataflow import build_chunk_graph
+from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+
+N, CHUNK, ALLOWED = {n!r}, {chunk!r}, {allowed!r}
+
+
+def bump(x):
+    return x + 1.0
+
+
+an = np.arange(N * N, dtype=np.float64).reshape(N, N)
+out = {{}}
+
+
+def build(mode):
+    spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem=ALLOWED)
+    a = ct.from_array(an, chunks=(CHUNK, N), spec=spec)
+    r = ct.map_blocks(bump, a, dtype=np.float64)
+    r = r.rechunk((N, CHUNK))          # row chunks -> column chunks
+    r = ct.map_blocks(bump, r, dtype=np.float64)
+    r = r.rechunk((CHUNK, N))          # ... and back: transpose-heavy
+    r = ct.map_blocks(bump, r, dtype=np.float64)
+    return r
+
+
+for mode in ("store_only", "peer"):
+    if mode == "store_only":
+        # the acceptance fact the scheduler is on the hook for: the
+        # chunk graph classifies every rechunk stage as chunked, never a
+        # barrier (recorded into BENCH_METRICS.json, asserted in tests)
+        g = build_chunk_graph(
+            build(mode).plan._finalize(optimize_graph=False).dag
+        )
+        rechunk_kinds = [
+            k for n_, k in g.op_kind.items() if "rechunk" in n_
+        ]
+        out["rechunk_chunked"] = bool(rechunk_kinds) and all(
+            k == "rechunk" for k in rechunk_kinds
+        ) and not any("rechunk" in n_ for n_ in g.barrier_ops)
+    # best-of-2: these computes are sub-second, and container scheduling
+    # noise would otherwise drown the wall-clock comparison
+    best = None
+    for _attempt in range(2):
+        r = build(mode)
+        ex = DistributedDagExecutor(
+            n_local_workers=2, peer_transfer=(mode == "peer")
+        )
+        try:
+            ex._ensure_fleet()  # boot outside the timed window
+            reg = get_registry()
+            before = reg.snapshot()
+            t0 = time.perf_counter()
+            # optimize_graph=False keeps the maps unfused so the exchange
+            # stages read real intermediate arrays
+            val = np.asarray(r.compute(executor=ex, optimize_graph=False))
+            elapsed = time.perf_counter() - t0
+            delta = reg.snapshot_delta(before)
+        finally:
+            ex.close()
+        assert (val == an + 3.0).all()
+        rec = {{
+            "elapsed": elapsed,
+            "bytes_read": delta.get("bytes_read", 0),
+            "store_read_bytes_saved": delta.get(
+                "store_read_bytes_saved", 0
+            ),
+            "peer_hits": delta.get("peer_hits", 0),
+            "peer_misses": delta.get("peer_misses", 0),
+            "peer_bytes_fetched": delta.get("peer_bytes_fetched", 0),
+            "peer_range_fetches": delta.get("peer_range_fetches", 0),
+            "shuffle_bytes_peer": delta.get("shuffle_bytes_peer", 0),
+            "peer_fetch_fallbacks": delta.get("peer_fetch_fallbacks", 0),
+            "placement_locality_hits": delta.get(
+                "placement_locality_hits", 0
+            ),
+        }}
+        if best is None or rec["elapsed"] < best["elapsed"]:
+            best = rec
+    out[mode] = best
+    print("rechunk_shuffle", mode, round(best["elapsed"], 2), "s",
+          file=sys.stderr, flush=True)
+hits = out["peer"]["peer_hits"]
+misses = out["peer"]["peer_misses"]
+out["hit_rate"] = hits / max(hits + misses, 1)
+# the headline: fraction of the store-only read volume the peer-routed
+# shuffle eliminated (the acceptance bar is >=40%)
+out["saved_fraction"] = out["peer"]["store_read_bytes_saved"] / max(
+    out["store_only"]["bytes_read"], 1
+)
+out["wall_ratio"] = out["peer"]["elapsed"] / max(
+    out["store_only"]["elapsed"], 1e-9
+)
+print(json.dumps(out), flush=True)
+"""
+
+
+def measure_rechunk_shuffle(timeout: float):
+    """Transpose-heavy (rechunk-dominated) fleet run, store-only vs
+    peer-shuffle.
+
+    Same plan twice on a 2-worker local fleet under the default dataflow
+    scheduler: once with every rechunk byte round-tripping through the
+    store, once with the all-to-all routed over the peer data plane
+    (sub-chunk range fetches + locality-placed fan-in). Records wall
+    clock per mode, ``saved_fraction`` (store read bytes eliminated; the
+    acceptance bar is >=40%), and ``rechunk_chunked`` (the chunk graph
+    classified every rechunk stage as chunked). Rides the same
+    history/perf-gate pipeline as ``p2p_transfer``. Returns None on
+    failure — additive, never the reason a bench run dies."""
+    script = RECHUNK_SHUFFLE.format(
+        repo=REPO, n=RECHUNK_N, chunk=RECHUNK_CHUNK, allowed=RECHUNK_ALLOWED,
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            env=_scrubbed_cpu_env(),
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"rechunk shuffle failed (rc={out.returncode}): "
+                f"{out.stderr[-2000:]}"
+            )
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        print(
+            f"rechunk shuffle: saved_fraction {res['saved_fraction']:.0%}, "
+            f"hit rate {res['hit_rate']:.0%}, "
+            f"{res['peer']['peer_range_fetches']} range fetch(es), "
+            f"rechunk_chunked={res['rechunk_chunked']}, "
+            f"wall {res['store_only']['elapsed']:.2f}s store-only vs "
+            f"{res['peer']['elapsed']:.2f}s peer",
+            file=sys.stderr, flush=True,
+        )
+        return res
+    except Exception as e:
+        print(f"rechunk shuffle sweep skipped: {e}", file=sys.stderr)
+        return None
+
+
 #: telemetry-overhead config: the scheduler deep chain (same shape, no
 #: injected straggler — sleep would mask sampler cost) run twice, live
 #: telemetry off vs armed (1s sampler + HTTP endpoint + a 0.5s scraper
@@ -1440,6 +1597,16 @@ def main() -> None:
     else:
         print("p2p transfer sweep skipped: out of budget", file=sys.stderr)
 
+    # rechunk shuffle: the transpose-heavy pipeline store-only vs the
+    # peer-routed all-to-all (two fleet boots + two short computes)
+    if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 60:
+        shuf = measure_rechunk_shuffle(_remaining(120))
+        if shuf is not None:
+            metrics_record["rechunk_shuffle"] = shuf
+    else:
+        print("rechunk shuffle sweep skipped: out of budget",
+              file=sys.stderr)
+
     # telemetry-sampler overhead: the deep chain with the live-telemetry
     # pipeline armed (1s sampler + scraped /metrics endpoint) vs off —
     # the armed wall clock rides the generic >20% perf gate
@@ -1677,15 +1844,17 @@ def perf_regressions(prev: dict, cur: dict) -> list:
                     f"{old_df:.2f}s ({pct:+.1f}%)"
                 )
             continue
-        if name == "p2p_transfer":
-            # the data-plane win must not rot: saved bytes dropping >20%
+        if name in ("p2p_transfer", "rechunk_shuffle"):
+            # the data-plane wins must not rot: saved bytes dropping >20%
             # or the peer-enabled wall clock growing >20% both gate
+            # (p2p_transfer is the deep elementwise chain; rechunk_shuffle
+            # the transpose-heavy all-to-all — same record shape)
             pct = _delta_pct(
                 cfg.get("saved_fraction"), old.get("saved_fraction")
             )
             if pct is not None and pct <= -PERF_GATE_THRESHOLD_PCT:
                 out.append(
-                    f"p2p_transfer saved_fraction "
+                    f"{name} saved_fraction "
                     f"{cfg['saved_fraction']:.2f} vs "
                     f"{old['saved_fraction']:.2f} ({pct:+.1f}%)"
                 )
@@ -1694,7 +1863,7 @@ def perf_regressions(prev: dict, cur: dict) -> list:
             pct = _delta_pct(cur_pe, old_pe)
             if pct is not None and pct >= PERF_GATE_THRESHOLD_PCT:
                 out.append(
-                    f"p2p_transfer peer wall {cur_pe:.2f}s vs "
+                    f"{name} peer wall {cur_pe:.2f}s vs "
                     f"{old_pe:.2f}s ({pct:+.1f}%)"
                 )
             continue
@@ -1773,36 +1942,42 @@ def _print_scheduler_deltas(cur: dict, old: dict, label: str) -> None:
         )
 
 
-def _print_p2p_deltas(cur: dict, old: dict, label: str) -> None:
-    """P2P data-plane trajectory: saved read bytes, hit rate, and per-mode
-    wall clock, with a LOUD flag when the saved fraction falls under the
-    30% acceptance bar or the shared gate rules flag a regression."""
+def _print_p2p_deltas(
+    cur: dict, old: dict, label: str,
+    name: str = "p2p_transfer", bar: float = 0.30,
+) -> None:
+    """Data-plane trajectory (the deep-chain ``p2p_transfer`` and the
+    transpose-heavy ``rechunk_shuffle`` share a record shape): saved read
+    bytes, hit rate, and per-mode wall clock, with a LOUD flag when the
+    saved fraction falls under the config's acceptance bar (30% for the
+    chain, 40% for the shuffle) or the shared gate rules flag a
+    regression."""
     sf = cur.get("saved_fraction")
     hr = cur.get("hit_rate")
     so = (cur.get("store_only") or {}).get("elapsed")
     pe = (cur.get("peer") or {}).get("elapsed")
     if isinstance(sf, (int, float)) and isinstance(pe, (int, float)):
         print(
-            f"trajectory p2p_transfer: saved_fraction {sf:.0%}, hit rate "
+            f"trajectory {name}: saved_fraction {sf:.0%}, hit rate "
             f"{(hr or 0):.0%}, store-only {so:.2f}s vs peer {pe:.2f}s",
             file=sys.stderr,
         )
-        if sf < 0.30:
+        if sf < bar:
             print(
-                "P2P REGRESSION: store_read_bytes_saved fell under the 30% "
-                f"acceptance bar (saved_fraction {sf:.0%})",
+                f"P2P REGRESSION: {name} store_read_bytes_saved fell under "
+                f"the {bar:.0%} acceptance bar (saved_fraction {sf:.0%})",
                 file=sys.stderr,
             )
     else:
-        print("trajectory p2p_transfer: incomplete record", file=sys.stderr)
+        print(f"trajectory {name}: incomplete record", file=sys.stderr)
     if not old:
-        print("trajectory p2p_transfer: no prior record to compare against "
+        print(f"trajectory {name}: no prior record to compare against "
               f"in {label}" if label else
-              "trajectory p2p_transfer: first record", file=sys.stderr)
+              f"trajectory {name}: first record", file=sys.stderr)
         return
     regressed = perf_regressions(
-        {"configs": {"p2p_transfer": old}},
-        {"configs": {"p2p_transfer": cur}},
+        {"configs": {name: old}},
+        {"configs": {name: cur}},
     )
     if regressed:
         print(
@@ -1812,7 +1987,7 @@ def _print_p2p_deltas(cur: dict, old: dict, label: str) -> None:
         )
     else:
         print(
-            f"trajectory p2p_transfer: within "
+            f"trajectory {name}: within "
             f"{PERF_GATE_THRESHOLD_PCT:.0f}% of {label}",
             file=sys.stderr,
         )
@@ -1891,6 +2066,10 @@ def _print_trajectory_deltas(metrics_record: dict, prev_trajectory) -> None:
         if metric == "p2p_transfer":
             _print_p2p_deltas(cur, old if isinstance(old, dict) else {},
                               label)
+            continue
+        if metric == "rechunk_shuffle":
+            _print_p2p_deltas(cur, old if isinstance(old, dict) else {},
+                              label, name="rechunk_shuffle", bar=0.40)
             continue
         if metric == "multitenant_service":
             _print_multitenant_deltas(

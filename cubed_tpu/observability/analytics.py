@@ -8,11 +8,13 @@ questions an operator actually asks:
 - **EXPLAIN** (:func:`explain`, ``plan.explain()``, ``python -m
   cubed_tpu.explain``) renders the finalized plan *before* execution:
   per-op task counts, projected memory against ``allowed_mem``, predicted
-  bytes read/written (and how many of those read bytes are peer-eligible —
-  reads of intermediate arrays the p2p data plane can serve), the fusion
-  outcome (ops before vs after optimization), and the scheduler/barrier
-  decisions the dataflow scheduler would make (chunk-structured ops vs
-  conservative op-level barriers, chunk-level edge count).
+  bytes read/written (how many of those read bytes are peer-eligible —
+  reads of intermediate arrays the p2p data plane can serve — and the
+  predicted all-to-all shuffle volume of each rechunk stage when p2p is
+  armed), the fusion outcome (ops before vs after optimization), and the
+  scheduler/barrier decisions the dataflow scheduler would make
+  (chunk-structured ops — blockwise AND rechunk — vs conservative
+  op-level barriers, chunk-level edge count).
 
 - **ANALYZE** (:func:`analyze`, ``python -m cubed_tpu.diagnose <bundle>
   --analyze``) consumes a flight-recorder bundle (or a live
@@ -23,8 +25,8 @@ questions an operator actually asks:
   op-level dependency skeleton, and decomposes the wall clock into
   attribution buckets::
 
-      kernel | storage_read | storage_write | peer_fetch | retry
-      | queue_wait | straggler_excess | uninstrumented | other
+      kernel | storage_read | storage_write | peer_fetch | shuffle
+      | retry | queue_wait | straggler_excess | uninstrumented | other
 
   The decomposition is exact by construction (segments tile the
   ``[compute start, compute end]`` interval), so the buckets always sum to
@@ -54,21 +56,26 @@ logger = logging.getLogger(__name__)
 
 #: sub-span name -> attribution bucket. ``integrity_verify`` folds into
 #: ``storage_read`` (it is part of the verified read path);
-#: ``retry_sleep``/``recompute_repair`` both count as retry overhead.
+#: ``retry_sleep``/``recompute_repair`` both count as retry overhead;
+#: ``shuffle_fetch`` (peer fetches inside a rechunk task's exchange
+#: window — whole-chunk or sub-chunk ranged) gets its own ``shuffle``
+#: bucket so the all-to-all's data movement is visible as such instead of
+#: blending into generic peer/storage time.
 SPAN_BUCKETS = {
     "kernel_apply": "kernel",
     "storage_read": "storage_read",
     "integrity_verify": "storage_read",
     "storage_write": "storage_write",
     "peer_fetch": "peer_fetch",
+    "shuffle_fetch": "shuffle",
     "retry_sleep": "retry",
     "recompute_repair": "retry",
 }
 
 #: every attribution bucket, in render order
 BUCKETS = (
-    "kernel", "storage_read", "storage_write", "peer_fetch", "retry",
-    "queue_wait", "straggler_excess", "uninstrumented", "other",
+    "kernel", "storage_read", "storage_write", "peer_fetch", "shuffle",
+    "retry", "queue_wait", "straggler_excess", "uninstrumented", "other",
 )
 
 #: straggler thresholds (match TraceCollector's live-watch defaults)
@@ -175,18 +182,18 @@ def explain_finalized(
     except Exception:
         logger.exception("explain: chunk-graph construction failed")
     barrier_ops = set(graph.barrier_ops) if graph is not None else set()
+    op_kinds = graph.op_kind if graph is not None else {}
     n_edges = (
         sum(len(d) for d in graph.dependencies.values())
         if graph is not None else None
     )
-
     try:
         from ..primitive.blockwise import apply_blockwise
     except Exception:  # pragma: no cover - blockwise always importable
         apply_blockwise = None
 
     rows: List[dict] = []
-    total_read = total_written = total_peer = 0
+    total_read = total_written = total_peer = total_shuffle = 0
     for name in nx.topological_sort(dag):
         d = nodes[name]
         if d.get("type") != "op" or d.get("primitive_op") is None:
@@ -205,10 +212,26 @@ def explain_finalized(
             if _is_intermediate(dag, arr_name, nodes):
                 peer_eligible += nbytes
         pipeline = op.pipeline
-        structured = (
-            pipeline is not None
-            and apply_blockwise is not None
-            and pipeline.function is apply_blockwise
+        # the chunk graph's own classification when it built (rechunk is
+        # chunk-structured via its shuffle edges); the blockwise check is
+        # only the degraded fallback for an unbuildable graph
+        kind = op_kinds.get(name)
+        if kind is not None:
+            structured = kind != "barrier"
+        else:
+            structured = (
+                pipeline is not None
+                and apply_blockwise is not None
+                and pipeline.function is apply_blockwise
+            )
+        #: predicted all-to-all exchange volume of a rechunk stage — its
+        #: INTERMEDIATE source bytes, i.e. what the peer data plane can
+        #: actually route worker-to-worker when armed (a first stage
+        #: reading a client-written source array still reads the store,
+        #: so counting it would fake a predicted-vs-measured gap)
+        shuffle_bytes = (
+            peer_eligible
+            if peer and kind == "rechunk" else 0
         )
         rows.append({
             "op": name,
@@ -219,11 +242,13 @@ def explain_finalized(
             "bytes_written": bytes_written,
             "bytes_read": bytes_read,
             "peer_eligible_bytes": peer_eligible if peer else 0,
+            "shuffle_bytes": shuffle_bytes,
             "chunk_structured": structured,
             "barrier": name in barrier_ops,
         })
         total_read += bytes_read
         total_written += bytes_written
+        total_shuffle += shuffle_bytes
         if peer:
             total_peer += peer_eligible
     n_ops = sum(1 for _ in iter_op_nodes(dag))
@@ -250,6 +275,7 @@ def explain_finalized(
             "bytes_written": total_written,
             "bytes_read": total_read,
             "peer_eligible_bytes": total_peer,
+            "predicted_shuffle_bytes": total_shuffle,
         },
         "barriers": {
             "ops": sorted(barrier_ops),
@@ -299,11 +325,16 @@ def render_explain(data: dict) -> str:
         f" ({proj / allowed:.0%} of allowed_mem)"
         if isinstance(proj, (int, float)) and allowed else ""
     )
+    shuffle_total = totals.get("predicted_shuffle_bytes")
     out.append(
         f"projected mem {_fmt_mem(proj)} vs allowed {_fmt_mem(allowed)}"
         f"{frac}; predicted IO: read {_fmt_mem(totals.get('bytes_read'))}, "
         f"write {_fmt_mem(totals.get('bytes_written'))}, peer-eligible "
         f"{_fmt_mem(totals.get('peer_eligible_bytes'))}"
+        + (
+            f", shuffle {_fmt_mem(shuffle_total)}"
+            if shuffle_total else ""
+        )
     )
     fusion = data.get("fusion")
     if fusion and fusion.get("ops_before") is not None:

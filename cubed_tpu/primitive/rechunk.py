@@ -37,11 +37,21 @@ from .blockwise import gensym
 
 
 def copy_read_to_write(chunk_key, *, config: CubedCopySpec) -> None:
-    """Task body: read one region from the source and write it to the target."""
+    """Task body: read one region from the source and write it to the target.
+
+    The read runs inside a shuffle exchange scope: on an armed fleet the
+    region's source chunks arrive over the peer data plane (sub-chunk byte
+    ranges when the region barely touches a chunk — runtime/transfer.py),
+    with any miss/peer-death/mismatch falling back to the store read
+    inside the storage layer; observability attributes the peer time to
+    the ``shuffle`` bucket (span ``shuffle_fetch``)."""
+    from ..runtime.shuffle import exchange_scope
+
     read_arr = config.read.open()
     write_arr = config.write.open()
     sel = chunk_key
-    data = read_arr[sel]
+    with exchange_scope():
+        data = read_arr[sel]
     write_arr[sel] = data
 
 

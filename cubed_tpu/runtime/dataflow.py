@@ -11,11 +11,14 @@ whole-chunk) storage writes. This module turns that into a scheduler:
 
 - :func:`build_chunk_graph` expands the op-level DAG into a chunk-level
   task graph — one node per task, with a per-task dependency set derived
-  from the op's ``block_function``. Ops without chunk-level structure
-  (rechunk copy regions, ``create-arrays``, any pipeline whose task body
-  is not ``apply_blockwise``) become conservative op-level barriers: all
-  their tasks wait for every predecessor task, and all their consumers
-  wait for all of their tasks.
+  from the op's ``block_function``, or — for rechunk copy stages — from
+  the pure region-overlap index computation in ``runtime/shuffle.py``
+  (source chunk → overlapping target tasks: the all-to-all shuffle edge
+  set, so rechunk is NOT a barrier). Ops without chunk-level structure
+  (``create-arrays``, any other pipeline whose task body is not
+  ``apply_blockwise``) become conservative op-level barriers: all their
+  tasks wait for every predecessor task, and all their consumers wait for
+  all of their tasks.
 - :class:`DataflowScheduler` drives a whole compute through ONE
   ``map_unordered`` call: tasks of every op are merged into a single
   completion-ordered map whose ``dependencies`` gate each task until its
@@ -36,11 +39,16 @@ degenerate case of this graph where only intra-generation edges are empty.
 
 Mode resolution mirrors integrity/memory-guard: the
 ``CUBED_TPU_SCHEDULER`` env var (operator override) wins over
-``Spec(scheduler=...)``, and the default is ``"oplevel"`` — the exact
-historical behavior. The sequential oracle and the jax executor always
-keep op ordering (the oracle is the bitwise reference; the jax executor
-fuses whole segments into single XLA programs where the barrier question
-does not arise).
+``Spec(scheduler=...)``, and the default is ``"dataflow"`` — with rechunk
+chunk-structured there is no workload class left that the barrier
+protects (``"oplevel"`` remains the explicit escape hatch, and is also
+what a defaulted scheduler falls back to when the caller set
+``batch_size`` — dataflow cannot honor batching, and silently dropping a
+user's memory-bounding knob under a flipped default would be worse than
+the barrier). The sequential oracle and the jax executor always keep op
+ordering (the oracle is the bitwise reference; the jax executor fuses
+whole segments into single XLA programs where the barrier question does
+not arise).
 
 Observability: the resolved mode lands on the ``scheduler_mode`` gauge and
 the decision ring; ``tasks_dispatched_early`` counts tasks dispatched
@@ -71,7 +79,7 @@ from .types import OperationEndEvent, OperationStartEvent, callbacks_on
 logger = logging.getLogger(__name__)
 
 MODES = ("oplevel", "dataflow")
-DEFAULT_MODE = "oplevel"
+DEFAULT_MODE = "dataflow"
 SCHEDULER_ENV_VAR = "CUBED_TPU_SCHEDULER"
 
 #: the metadata bootstrap op injected by Plan.create_lazy_zarr_arrays; it
@@ -91,15 +99,44 @@ def resolve_scheduler(spec: Any = None) -> str:
     """The effective scheduler mode (env > Spec > default).
 
     A malformed env value raises loudly — a typo silently falling back to
-    the op-level default would hide the very overlap the operator asked
+    a different mode would hide the very behavior the operator asked
     for."""
+    explicit = requested_scheduler(spec)
+    return explicit if explicit is not None else DEFAULT_MODE
+
+
+def effective_scheduler(spec: Any = None, batch_size=None) -> str:
+    """The mode an async executor actually runs: :func:`resolve_scheduler`
+    plus the ONE policy rule for the ``batch_size`` conflict — dataflow
+    cannot batch (one dependency index space), so a merely DEFAULTED
+    dataflow yields to the user's explicit memory-bounding knob and runs
+    op-level, while an EXPLICIT dataflow request wins (the executor then
+    warns that batching is ignored). Shared by the three async executors
+    so the rule cannot drift between them."""
+    scheduler = resolve_scheduler(spec)
+    if (
+        scheduler == "dataflow"
+        and batch_size
+        and requested_scheduler(spec) is None
+    ):
+        return "oplevel"
+    return scheduler
+
+
+def requested_scheduler(spec: Any = None) -> Optional[str]:
+    """The EXPLICITLY requested mode (env > Spec), or None when the caller
+    left the scheduler defaulted. The async executors use the distinction
+    to resolve conflicts with other knobs (``batch_size`` under a
+    defaulted dataflow falls back to op-level; an explicit dataflow wins
+    and warns), and the sequential oracle warns only about an explicit
+    dataflow request it cannot honor."""
     raw = os.environ.get(SCHEDULER_ENV_VAR)
     if raw:
         return _validate(raw)
     s = getattr(spec, "scheduler", None)
     if s is not None:
         return _validate(s)
-    return DEFAULT_MODE
+    return None
 
 
 def record_scheduler_mode(mode: str, executor: Optional[str] = None) -> None:
@@ -148,6 +185,19 @@ def _store_of(target) -> str:
 _key_str = _task_chunk_key
 
 
+def task_hint_key(m) -> str:
+    """The locality-hint identity of a mappable item, shared by
+    ``DataflowScheduler.locality_hints`` and the distributed executor's
+    submit path: the dotted out-chunk key for blockwise items, the
+    region identity for rechunk slice-regions (whose ``_task_chunk_key``
+    would drop the leading slice and collide)."""
+    from .shuffle import is_region_item, region_identity
+
+    if is_region_item(m):
+        return region_identity(m)
+    return _task_chunk_key(m)
+
+
 class ChunkGraph:
     """The chunk-level task graph of one finalized plan.
 
@@ -168,6 +218,11 @@ class ChunkGraph:
         #: included: overlap with the bootstrap is not "early")
         self.op_upstream: Dict[str, Set[str]] = {}
         self.pipelines: Dict[str, Any] = {}
+        #: op -> chunk-structure kind: ``"blockwise"`` (key-function
+        #: walked), ``"rechunk"`` (shuffle region-overlap edges), or
+        #: ``"barrier"`` (no chunk-level structure) — what EXPLAIN renders
+        #: as the per-op scheduler decision
+        self.op_kind: Dict[str, str] = {}
         #: item index -> tuple of (store, chunk file key) pairs the task
         #: reads — derived during the same block-function walk that builds
         #: dependencies; feeds the coordinator's locality-aware placement
@@ -238,6 +293,8 @@ def build_chunk_graph(
     """
     from ..primitive.blockwise import apply_blockwise
 
+    from . import shuffle
+
     g = ChunkGraph()
     nodes = dict(dag.nodes(data=True))
     if resume and state is None:
@@ -276,24 +333,43 @@ def build_chunk_graph(
         pipeline = primitive_op.pipeline
         mappable, _skipped = pending_mappable(name, node, resume, state)
         mappable = list(mappable)
-        structured = pipeline.function is apply_blockwise
+        if pipeline.function is apply_blockwise:
+            kind = "blockwise"
+        elif shuffle.is_rechunk_pipeline(pipeline):
+            kind = "rechunk"
+        else:
+            kind = "barrier"
+        structured = kind != "barrier"
         chunk_structured[name] = structured
+        g.op_kind[name] = kind
         g.op_order.append(name)
         g.op_num_tasks[name] = primitive_op.num_tasks
         g.op_pending[name] = len(mappable)
         g.pipelines[name] = pipeline
+
+        def out_keys_of(m) -> list:
+            """The output chunk key(s) a task writes — one for a blockwise
+            out-key item, every covered target chunk for a rechunk region
+            (write regions align to the target grid, so each target chunk
+            has exactly one producing task)."""
+            if kind == "rechunk":
+                return shuffle.rechunk_task_writes(m, pipeline.config)
+            return [_task_chunk_key(m)]
+
         indices: List[int] = []
         keys: Dict[str, Optional[int]] = {}
         if structured:
             for m in pipeline.mappable:
-                keys[_task_chunk_key(m)] = None  # satisfied unless pending
+                for k in out_keys_of(m):
+                    keys[k] = None  # satisfied unless pending
         for m in mappable:
             idx = len(g.items)
             g.items.append((name, m))
             g.array_names.append(name)
             indices.append(idx)
             if structured:
-                keys[_task_chunk_key(m)] = idx
+                for k in out_keys_of(m):
+                    keys[k] = idx
         op_item_indices[name] = indices
         key_index[name] = keys
 
@@ -339,6 +415,20 @@ def build_chunk_graph(
         if non_bootstrap_barrier:
             g.barrier_ops.append(name)
 
+        def iter_reads(m):
+            """``(store, chunk key str)`` pairs a task reads — the block
+            function's key walk for blockwise, the shuffle region-overlap
+            computation for rechunk (``runtime/shuffle.py``)."""
+            if g.op_kind[name] == "rechunk":
+                yield from shuffle.rechunk_task_reads(m, pipeline.config)
+                return
+            structure = pipeline.config.block_function(m)
+            for key in _iter_keys(structure):
+                proxy = pipeline.config.reads_map.get(key[0])
+                if proxy is None:
+                    raise KeyError(key[0])
+                yield _store_of(proxy.array), _key_str(key)
+
         covered_ops: Set[str] = set()
         for idx in op_item_indices[name]:
             _, m = g.items[idx]
@@ -347,21 +437,17 @@ def build_chunk_graph(
             if non_bootstrap_barrier:
                 g.barrier_tasks += 1
             try:
-                structure = pipeline.config.block_function(m)
-                for key in _iter_keys(structure):
-                    proxy = pipeline.config.reads_map.get(key[0])
-                    if proxy is None:
-                        raise KeyError(key[0])
-                    reads.append((_store_of(proxy.array), _key_str(key)))
-                    producer = store_to_op.get(_store_of(proxy.array))
+                for store, key_str in iter_reads(m):
+                    reads.append((store, key_str))
+                    producer = store_to_op.get(store)
                     if producer is None or producer not in in_graph:
                         continue  # source array, or op satisfied by resume
                     covered_ops.add(producer)
                     if not chunk_structured[producer]:
                         continue  # already in barrier_base
-                    entry = key_index[producer].get(_key_str(key))
+                    entry = key_index[producer].get(key_str)
                     if entry is None:
-                        if _key_str(key) in key_index[producer]:
+                        if key_str in key_index[producer]:
                             continue  # resume-satisfied chunk
                         # unknown chunk key: the key functions disagree —
                         # fall back to a barrier on that producer rather
@@ -370,7 +456,7 @@ def build_chunk_graph(
                             "dataflow: task %s of %s reads unknown chunk "
                             "%s of %s; degrading that edge to an op "
                             "barrier", _task_chunk_key(m), name,
-                            _key_str(key), producer,
+                            key_str, producer,
                         )
                         deps.update(op_item_indices[producer])
                     else:
@@ -467,7 +553,7 @@ class DataflowScheduler:
         out: Dict[tuple, tuple] = {}
         for idx, reads in self.graph.reads.items():
             op, m = self.graph.items[idx]
-            out[(op, _task_chunk_key(m))] = reads
+            out[(op, task_hint_key(m))] = reads
         return out
 
     @property
@@ -536,7 +622,7 @@ class DataflowScheduler:
 
                 _, m = self.graph.items[i]
                 record_decision(
-                    "dispatch_early", op=op, chunk=_task_chunk_key(m),
+                    "dispatch_early", op=op, chunk=task_hint_key(m),
                     upstream_pending=sum(
                         self._pending.get(p, 0)
                         for p in self.graph.op_upstream[op]

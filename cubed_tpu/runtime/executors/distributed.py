@@ -36,15 +36,15 @@ from ...observability import logs as obs_logs
 from .. import transfer
 from ..dataflow import (
     DataflowScheduler,
+    effective_scheduler,
     record_scheduler_mode,
-    resolve_scheduler,
+    task_hint_key,
 )
 from ..distributed import Coordinator, NoWorkersError
 from ..memory import AdmissionController
 from ..pipeline import (
     RecomputeResolver,
     ResumeState,
-    _task_chunk_key,
     pending_mappable,
     visit_node_generations,
     visit_nodes,
@@ -466,7 +466,10 @@ class DistributedDagExecutor(DagExecutor):
         # corrupt chunk's (store, key); the repair task runs client-side
         # against the shared store the whole fleet reads
         resolver = RecomputeResolver(dag)
-        scheduler = resolve_scheduler(spec)
+        # a defaulted dataflow yields to an explicit batch_size (the rule
+        # lives in dataflow.effective_scheduler); explicit requests win
+        # and warn below
+        scheduler = effective_scheduler(spec, batch_size)
         record_scheduler_mode(scheduler, executor=self.name)
         # peer-to-peer chunk transfer: env > Spec > executor arg > off.
         # Armed for this compute's duration — the coordinator attaches the
@@ -634,9 +637,11 @@ class _InterleavedPool:
         pipeline = self.pipelines[name]
         locality = None
         if self.locality_hints is not None and isinstance(m, (tuple, list)):
-            # only blockwise out-key items have chunk keys (create-arrays
-            # and rechunk tasks carry other shapes — and no hints anyway)
-            locality = self.locality_hints.get((name, _task_chunk_key(m)))
+            # blockwise out-key items key by their dotted chunk key,
+            # rechunk slice-regions by their region identity (shared
+            # contract: dataflow.task_hint_key) — create-arrays items
+            # carry other shapes and simply have no hints
+            locality = self.locality_hints.get((name, task_hint_key(m)))
         return self.coordinator.submit(
             stats_wrapper, pipeline.function, m, config=pipeline.config,
             locality=locality,

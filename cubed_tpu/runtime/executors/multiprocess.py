@@ -33,7 +33,7 @@ from ...observability.metrics import get_registry
 from ..dataflow import (
     DataflowScheduler,
     record_scheduler_mode,
-    resolve_scheduler,
+    effective_scheduler,
 )
 from ..memory import AdmissionController
 from ..pipeline import (
@@ -246,7 +246,10 @@ class MultiprocessDagExecutor(DagExecutor):
         pool = concurrent.futures.ProcessPoolExecutor(
             max_workers=self.max_workers, mp_context=ctx
         )
-        scheduler = resolve_scheduler(spec)
+        # a defaulted dataflow yields to an explicit batch_size (the rule
+        # lives in dataflow.effective_scheduler); explicit requests win
+        # and warn below
+        scheduler = effective_scheduler(spec, batch_size)
         record_scheduler_mode(scheduler, executor=self.name)
         try:
             if scheduler == "dataflow":

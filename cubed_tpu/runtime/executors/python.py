@@ -70,11 +70,13 @@ class PythonDagExecutor(DagExecutor):
         retries = self.retries if retries is None else retries
         policy = resolve_policy(retry_policy or self.retry_policy, retries)
         budget = compute_retry_budget(policy, dag)
-        from ..dataflow import resolve_scheduler
+        from ..dataflow import requested_scheduler
 
-        if resolve_scheduler(spec) == "dataflow":
+        if requested_scheduler(spec) == "dataflow":
             # the oracle's value IS its strict op ordering (bitwise
-            # reference for the overlapped executors) — documented no-op
+            # reference for the overlapped executors) — documented no-op;
+            # only an EXPLICIT request is worth a note now that dataflow
+            # is the async executors' default
             logger.debug(
                 "scheduler=dataflow requested; the sequential oracle "
                 "keeps op-level ordering by design"

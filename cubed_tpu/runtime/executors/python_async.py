@@ -28,8 +28,8 @@ from ...observability.metrics import get_registry
 from ..backup import should_launch_backup
 from ..dataflow import (
     DataflowScheduler,
+    effective_scheduler,
     record_scheduler_mode,
-    resolve_scheduler,
 )
 from ..memory import (
     AdmissionController,
@@ -687,7 +687,10 @@ class AsyncPythonDagExecutor(DagExecutor):
             ResumeState(quarantine=True, journal=journal) if resume else None
         )
         resolver = RecomputeResolver(dag)
-        scheduler = resolve_scheduler(spec)
+        # a defaulted dataflow yields to an explicit batch_size (the rule
+        # lives in dataflow.effective_scheduler); explicit requests win
+        # and warn below
+        scheduler = effective_scheduler(spec, batch_size)
         record_scheduler_mode(scheduler, executor=self.name)
 
         with concurrent.futures.ThreadPoolExecutor(

@@ -18,6 +18,7 @@ from typing import Iterator, Optional
 import networkx as nx
 
 from ..observability.metrics import get_registry
+from .shuffle import chunk_key_str
 
 logger = logging.getLogger(__name__)
 
@@ -155,8 +156,10 @@ def _target_nchunks(target) -> int:
 def _task_chunk_key(m) -> str:
     """The output chunk key a blockwise task writes: mappable items are
     ``(out_name, i, j, ...)`` out-keys, matching the store's dotted chunk
-    file names (scalar arrays write chunk ``"0"``)."""
-    return ".".join(str(i) for i in m[1:]) if len(m) > 1 else "0"
+    file names (scalar arrays write chunk ``"0"``). Delegates to the ONE
+    dotted-key formatter (``shuffle.chunk_key_str``, shared with the
+    store layer and the rechunk edge math) so the formats can't drift."""
+    return chunk_key_str(tuple(m[1:]))
 
 
 def already_computed(
@@ -206,18 +209,24 @@ def pending_mappable(
     Returns ``(mappable, n_skipped)``. For a blockwise op whose output
     store is partially complete, only the tasks whose output chunk is
     missing or failed verification remain — resuming an op with 999/1000
-    valid chunks re-runs 1 task, not 1000. Ops whose tasks don't map 1:1
-    to output chunks (create-arrays, rechunk copy regions) run in full.
-    Skips are counted in ``tasks_skipped_resume`` unless ``record=False``
-    (plan introspection must not bump execution metrics).
+    valid chunks re-runs 1 task, not 1000. A rechunk copy stage is
+    likewise chunk-granular: a region task is done when EVERY target
+    chunk its region covers verifies (``runtime/shuffle.py`` computes the
+    coverage), so a compute killed mid-rechunk resumes only the regions
+    that never landed. Ops whose tasks have no output-chunk mapping at
+    all (create-arrays) run in full. Skips are counted in
+    ``tasks_skipped_resume`` unless ``record=False`` (plan introspection
+    must not bump execution metrics).
     """
     primitive_op = node["primitive_op"]
     pipeline = primitive_op.pipeline
     if not resume or state is None:
         return pipeline.mappable, 0
     from ..primitive.blockwise import apply_blockwise
+    from .shuffle import is_rechunk_pipeline, rechunk_task_writes
 
-    if pipeline.function is not apply_blockwise:
+    rechunk = is_rechunk_pipeline(pipeline)
+    if pipeline.function is not apply_blockwise and not rechunk:
         return pipeline.mappable, 0
     targets = primitive_op.target_arrays or (
         [primitive_op.target_array]
@@ -237,14 +246,18 @@ def pending_mappable(
     pending = []
     skipped = 0
     for m in pipeline.mappable:
-        key = _task_chunk_key(m)
-        # a task is done only when EVERY output array has its chunk (a
-        # multi-output op with one corrupt side output re-runs the task)
+        keys = (
+            rechunk_task_writes(m, pipeline.config) if rechunk
+            else [_task_chunk_key(m)]
+        )
+        # a task is done only when EVERY output array has EVERY chunk the
+        # task writes (a multi-output op with one corrupt side output —
+        # or a rechunk region with one missing covered chunk — re-runs)
         # AND, when resuming from a coordinator-crash journal, the journal
         # recorded the task complete (journal ∩ integrity frontier)
-        if all(key in valid for valid in valid_sets) and (
-            state.journal_allows_task_skip(name, _mappable_key(m))
-        ):
+        if all(
+            key in valid for valid in valid_sets for key in keys
+        ) and state.journal_allows_task_skip(name, _mappable_key(m)):
             skipped += 1
         else:
             pending.append(m)
@@ -258,15 +271,16 @@ def pending_mappable(
 
 
 class RecomputeResolver:
-    """Maps a corrupt chunk back to the blockwise task that produces it.
+    """Maps a corrupt chunk back to the task that produces it (a
+    blockwise out-key task, or the rechunk region copy covering it).
 
     When a task-scope read raises ``ChunkIntegrityError`` (classified
     RECOMPUTE), the executor asks this resolver for a thunk re-running the
     producing op's task for exactly that chunk. The thunk runs client-side
     against the shared store — valid for every executor, since tasks only
     communicate through storage. Returns None when the store isn't one of
-    this plan's blockwise outputs (the failure then degrades to a plain
-    retry, which surfaces loudly once retries exhaust).
+    this plan's blockwise or rechunk outputs (the failure then degrades
+    to a plain retry, which surfaces loudly once retries exhaust).
     """
 
     def __init__(self, dag):
@@ -289,13 +303,22 @@ class RecomputeResolver:
             return None
         pipeline = node["primitive_op"].pipeline
         from ..primitive.blockwise import apply_blockwise
+        from .shuffle import is_rechunk_pipeline, rechunk_task_writes
 
-        if pipeline.function is not apply_blockwise:
+        rechunk = is_rechunk_pipeline(pipeline)
+        if pipeline.function is not apply_blockwise and not rechunk:
             return None
         key = payload.get("chunk_key")
         task_input = None
         for m in pipeline.mappable:
-            if _task_chunk_key(m) == key:
+            # for a rechunk stage the repair re-runs the region copy that
+            # covers the corrupt chunk (idempotent whole-chunk writes, so
+            # rewriting the region's other chunks is harmless)
+            if rechunk:
+                if key in rechunk_task_writes(m, pipeline.config):
+                    task_input = m
+                    break
+            elif _task_chunk_key(m) == key:
                 task_input = m
                 break
         if task_input is None:

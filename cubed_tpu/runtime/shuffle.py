@@ -1,0 +1,247 @@
+"""All-to-all rechunk on the peer data plane: the shuffle layer.
+
+The paper's execution model forbids inter-worker communication, so a
+rechunk is two things at once: a full write+read round-trip through the
+Zarr store, and — because its copy regions were opaque to the chunk graph
+— a conservative op-level barrier in the dataflow scheduler. Both are
+killable with machinery that already exists, and this module is the glue:
+
+- **Chunk-level shuffle edges.** A rechunk task's mappable item is a
+  slice-region over the write grid; which source chunks it overlaps and
+  which target chunks it covers are pure index computations
+  (:func:`rechunk_task_reads` / :func:`rechunk_task_writes`, same shape as
+  blockwise key walking). ``build_chunk_graph`` (``runtime/dataflow.py``)
+  uses them to give every rechunk task its exact dependency set, so
+  rechunk stops being a barrier: a target-chunk task dispatches the moment
+  the source chunks it overlaps are written, overlapping with both its
+  producers and its consumers in the dataflow frontier.
+
+- **Peer-routed exchange.** The same read set feeds the coordinator's
+  locality-aware placement (put a target task on the worker holding the
+  most overlapping source bytes) and the task body's reads ride the PR 9
+  peer data plane. Because a target task often touches only a fraction of
+  each source chunk, :func:`byte_ranges` turns the needed sub-region of a
+  C-order chunk into coalesced byte ranges for the sub-chunk fetch
+  protocol (``runtime/transfer.py``) — a transpose-ish shuffle moves the
+  bytes it needs, not whole chunks it barely touches.
+
+- **The fallback contract is inherited, not re-implemented.** Zarr stays
+  the durable write-through tier; any peer miss, death, timeout, or
+  checksum mismatch degrades to the store read inside
+  ``ZarrV2Array`` — so resume, the journal, and integrity manifests are
+  untouched, and a mid-shuffle worker loss costs store reads, never
+  correctness or retry budget.
+
+:func:`exchange_scope` marks the rechunk task body's read window so the
+observability layer can attribute peer time during a shuffle to its own
+``shuffle`` bucket (span ``shuffle_fetch``) instead of folding it into
+generic peer/storage time — see ``observability/analytics.py``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+#: bound on byte ranges per sub-chunk fetch: past this, the per-range
+#: bookkeeping costs more than the bytes it saves — fetch the whole chunk
+MAX_FETCH_RANGES = 512
+
+#: a region covering at least this fraction of the chunk fetches the whole
+#: chunk instead (one range, and the cache entry verifies against the
+#: manifest end to end)
+RANGE_FETCH_MAX_FRACTION = 0.75
+
+
+# ----------------------------------------------------------------------
+# recognizing rechunk pipelines and naming their tasks
+# ----------------------------------------------------------------------
+
+
+def is_rechunk_pipeline(pipeline) -> bool:
+    """True for a rechunk copy stage (task body reads one source region
+    and writes it to the target — ``primitive/rechunk.copy_read_to_write``)."""
+    from ..primitive.rechunk import copy_read_to_write
+
+    return getattr(pipeline, "function", None) is copy_read_to_write
+
+
+def is_region_item(m) -> bool:
+    """True for a rechunk mappable item: a tuple of slices over the write
+    grid (blockwise items are ``(out_name, i, j, ...)`` tuples instead)."""
+    return (
+        isinstance(m, tuple)
+        and len(m) > 0
+        and all(isinstance(s, slice) for s in m)
+    )
+
+
+def region_identity(m) -> str:
+    """A compact, stable identity for a slice-region mappable item —
+    ``"0:4,8:16"`` — used wherever blockwise items use their dotted chunk
+    key (locality hints; NOT the trace join key, which stays
+    ``utils.chunk_key``)."""
+    return ",".join(f"{s.start}:{s.stop}" for s in m)
+
+
+def chunk_key_str(idx: Tuple[int, ...]) -> str:
+    """The store's dotted chunk file name for a chunk index tuple —
+    THE dotted-key format contract: ``ZarrV2Array._chunk_key`` (the file
+    names on disk) and ``pipeline._task_chunk_key`` (the out-key side)
+    both delegate here, so the three users of the format cannot drift
+    apart (a drift would silently degrade every rechunk edge to an
+    op-level barrier and break chunk-granular resume matching)."""
+    return ".".join(str(i) for i in idx) if idx else "0"
+
+
+# ----------------------------------------------------------------------
+# region <-> chunk-grid index math (the shuffle edge computation)
+# ----------------------------------------------------------------------
+
+
+def chunks_overlapping_region(
+    region: Tuple[slice, ...], chunks: Tuple[int, ...],
+) -> Iterator[Tuple[int, ...]]:
+    """Chunk index tuples of a ``chunks``-gridded array that a slice-region
+    overlaps. The pure index computation both shuffle edge directions are
+    built from: with the *source* chunking these are the chunks a rechunk
+    task reads; with the *target* chunking, the chunks it writes."""
+    if not region:
+        yield ()
+        return
+    ranges = []
+    for s, c in zip(region, chunks):
+        c = max(1, int(c))
+        start = int(s.start or 0)
+        stop = int(s.stop if s.stop is not None else start)
+        first = start // c
+        last = max(first, (max(stop - 1, start)) // c)
+        ranges.append(range(first, last + 1))
+    yield from itertools.product(*ranges)
+
+
+def region_chunk_keys(
+    region: Tuple[slice, ...], chunks: Tuple[int, ...],
+) -> List[str]:
+    """Dotted chunk keys overlapped by a region (see
+    :func:`chunks_overlapping_region`)."""
+    return [chunk_key_str(i) for i in chunks_overlapping_region(region, chunks)]
+
+
+def rechunk_task_reads(m, config) -> List[tuple]:
+    """``[(source store, source chunk key), ...]`` a rechunk task reads:
+    the source chunks its region overlaps. Feeds both the dataflow edges
+    and the coordinator's locality placement (shuffle fan-in lands on the
+    worker holding the most of these bytes)."""
+    src = config.read.array
+    store = str(getattr(src, "store", "") or "")
+    chunks = tuple(config.read.chunks)
+    return [(store, chunk_key_str(i)) for i in chunks_overlapping_region(m, chunks)]
+
+
+def rechunk_task_writes(m, config) -> List[str]:
+    """Dotted target chunk keys a rechunk task's region covers. Write
+    regions are aligned to the target chunk grid (the planner keeps
+    consolidated write chunks exact multiples of the target chunks), so
+    every target chunk is covered by exactly one task."""
+    chunks = tuple(config.write.chunks)
+    return region_chunk_keys(m, chunks)
+
+
+# ----------------------------------------------------------------------
+# sub-chunk byte ranges (the wire format of a partial-chunk fetch)
+# ----------------------------------------------------------------------
+
+
+def byte_ranges(
+    chunk_shape: Tuple[int, ...],
+    itemsize: int,
+    inner_sel: Tuple[slice, ...],
+) -> Optional[List[Tuple[int, int]]]:
+    """Coalesced ``(offset, nbytes)`` ranges of a C-order chunk covering
+    ``inner_sel`` (unit-step slices within the chunk), enumerated in the
+    region's own C order — so the concatenated payload IS the selected
+    sub-array's C-order buffer. Returns None when a range read is not
+    worth it (full coverage, strided selection, too many ranges, or the
+    region is nearly the whole chunk — see :data:`MAX_FETCH_RANGES` /
+    :data:`RANGE_FETCH_MAX_FRACTION`); the caller then fetches the whole
+    chunk."""
+    if not chunk_shape:
+        return None
+    sel = []
+    region_elems = 1
+    for s, extent in zip(inner_sel, chunk_shape):
+        step = s.step or 1
+        if step != 1:
+            return None
+        start = int(s.start or 0)
+        stop = min(int(s.stop if s.stop is not None else extent), extent)
+        if stop <= start:
+            return None
+        sel.append((start, stop))
+        region_elems *= stop - start
+    chunk_elems = math.prod(chunk_shape)
+    if region_elems >= chunk_elems:
+        return None  # full chunk: the whole-chunk path verifies end to end
+    if region_elems * itemsize > RANGE_FETCH_MAX_FRACTION * chunk_elems * itemsize:
+        return None
+
+    # the largest suffix of axes fully covered: runs are contiguous across
+    # it, anchored at the last partially-covered axis
+    ndim = len(chunk_shape)
+    full_from = ndim
+    for ax in reversed(range(ndim)):
+        if sel[ax] == (0, chunk_shape[ax]):
+            full_from = ax
+        else:
+            break
+    # strides in elements, C order
+    strides = [1] * ndim
+    for ax in reversed(range(ndim - 1)):
+        strides[ax] = strides[ax + 1] * chunk_shape[ax + 1]
+    run_axis = full_from - 1  # the contiguous-run axis (last partial one)
+    if run_axis < 0:
+        return None  # fully covered (caught above, but belt and braces)
+    run_elems = (sel[run_axis][1] - sel[run_axis][0]) * strides[run_axis]
+    lead_counts = [sel[ax][1] - sel[ax][0] for ax in range(run_axis)]
+    n_ranges = math.prod(lead_counts) if lead_counts else 1
+    if n_ranges > MAX_FETCH_RANGES:
+        return None
+    ranges: List[Tuple[int, int]] = []
+    base = sum(sel[ax][0] * strides[ax] for ax in range(run_axis + 1))
+    for combo in itertools.product(*(range(n) for n in lead_counts)):
+        off = base
+        for ax, i in enumerate(combo):
+            off += i * strides[ax]
+        ranges.append((off * itemsize, run_elems * itemsize))
+    return ranges
+
+
+# ----------------------------------------------------------------------
+# the exchange scope (observability: shuffle time gets its own bucket)
+# ----------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+class exchange_scope:
+    """Marks the current thread as inside a rechunk task's read window, so
+    peer fetches issued under it record ``shuffle_fetch`` spans (the
+    ``shuffle`` attribution bucket) and count ``shuffle_bytes_peer``
+    instead of blending into generic peer-fetch time."""
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "exchange", False)
+        _tls.exchange = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _tls.exchange = self._prev
+
+
+def in_exchange() -> bool:
+    return bool(getattr(_tls, "exchange", False))
+
+

@@ -32,6 +32,17 @@ already exists, without touching any durability guarantee:
   read — transparently, inside the read path, so fallbacks never surface
   as task failures and draw zero retry budget.
 
+- **Sub-chunk byte ranges (the shuffle fast path).** A rechunk target
+  task often overlaps a sliver of each source chunk; ``chunk_get`` with
+  ``ranges`` (:func:`fetch_chunk_ranges`) fetches exactly the coalesced
+  byte ranges the region needs (``runtime/shuffle.byte_ranges``). The
+  whole-chunk manifest CRC cannot verify a sub-payload directly, so the
+  serving worker returns both a payload CRC (wire integrity) and its
+  cached chunk's insert-time CRC + length — which must match the
+  manifest entry (cache-copy integrity). Fetches inside a rechunk
+  exchange record ``shuffle_fetch`` spans (the ANALYZE ``shuffle``
+  bucket) and ``shuffle_bytes_peer``.
+
 - **Locality-aware placement.** Under ``Spec(scheduler="dataflow")`` the
   chunk graph knows exactly which chunks each task reads
   (``dataflow.ChunkGraph.reads``); the coordinator scores each dispatch by
@@ -234,7 +245,11 @@ class ChunkCache:
     def __init__(self, max_bytes: int = DEFAULT_CACHE_BYTES):
         self.max_bytes = max(0, int(max_bytes))
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[tuple, bytes]" = OrderedDict()
+        #: (store, key) -> (raw stored bytes, crc32 of those bytes) — the
+        #: crc is computed once at insert so sub-chunk range serving can
+        #: prove "my cached copy matches the manifest" without re-hashing
+        #: the whole chunk per request
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
         self.bytes = 0
         self.evictions = 0
         self.pressure_evictions = 0
@@ -275,12 +290,12 @@ class ChunkCache:
             ck = (str(store), str(key))
             old = self._entries.pop(ck, None)
             if old is not None:
-                self.bytes -= len(old)
-            self._entries[ck] = data
+                self.bytes -= len(old[0])
+            self._entries[ck] = (data, _crc(data))
             self.bytes += n
             while self.bytes > self.max_bytes and self._entries:
                 dropped_key, dropped = self._entries.popitem(last=False)
-                self.bytes -= len(dropped)
+                self.bytes -= len(dropped[0])
                 self._note_evicted(dropped_key)
                 evicted += 1
             self.evictions += evicted
@@ -290,11 +305,17 @@ class ChunkCache:
         return True
 
     def get(self, store: str, key: str) -> Optional[bytes]:
+        entry = self.get_with_crc(store, key)
+        return entry[0] if entry is not None else None
+
+    def get_with_crc(self, store: str, key: str) -> Optional[tuple]:
+        """``(bytes, crc32)`` of a cached chunk, or None — the crc was
+        computed at insert time from the durably written bytes."""
         with self._lock:
-            data = self._entries.get((str(store), str(key)))
-            if data is not None:
+            entry = self._entries.get((str(store), str(key)))
+            if entry is not None:
                 self._entries.move_to_end((str(store), str(key)))
-            return data
+            return entry
 
     def evict_for_pressure(self, level: str) -> int:
         """Shed footprint when the PR 4 memory guard reports pressure:
@@ -311,7 +332,7 @@ class ChunkCache:
         with self._lock:
             while self.bytes > target and self._entries:
                 dropped_key, dropped = self._entries.popitem(last=False)
-                self.bytes -= len(dropped)
+                self.bytes -= len(dropped[0])
                 if target > 0:
                     self._note_evicted(dropped_key)
                 evicted += 1
@@ -578,9 +599,39 @@ class PeerRuntime:
                     # injected mid-conversation reset: the reader sees a
                     # dead connection and must fall back to the store
                     return
-                data = self.cache.get(store, key)
-                if data is not None:
-                    get_registry().counter("peer_chunks_served").inc()
+                entry = self.cache.get_with_crc(store, key)
+                ranges = msg.get("ranges")
+                if entry is None:
+                    send_frame(sock, {
+                        "type": "chunk_data", "store": store, "key": key,
+                        "data": None,
+                    })
+                    continue
+                data, full_crc = entry
+                get_registry().counter("peer_chunks_served").inc()
+                if ranges:
+                    # sub-chunk shuffle fetch: concatenated byte ranges of
+                    # the cached chunk plus enough evidence to verify —
+                    # a crc over the payload (transport integrity) and the
+                    # insert-time crc + length of the WHOLE cached chunk,
+                    # which the reader checks against the authoritative
+                    # manifest entry (cache-copy integrity): together the
+                    # sub-bytes are as trustworthy as a whole-chunk fetch
+                    try:
+                        payload = b"".join(
+                            data[int(off):int(off) + int(n)]
+                            for off, n in ranges
+                        )
+                    except (TypeError, ValueError):
+                        payload = None
+                    send_frame(sock, {
+                        "type": "chunk_data", "store": store, "key": key,
+                        "data": payload,
+                        "crc": _crc(payload) if payload is not None else None,
+                        "full_crc": full_crc,
+                        "total": len(data),
+                    })
+                    continue
                 send_frame(sock, {
                     "type": "chunk_data", "store": store, "key": key,
                     "data": data,
@@ -682,12 +733,12 @@ class PeerRuntime:
         except OSError:
             pass
 
-    def fetch_bytes(
-        self, addr: tuple, store: str, key: str, timeout_s: float
-    ) -> Optional[bytes]:
+    def _fetch_reply(
+        self, addr: tuple, msg: dict, timeout_s: float
+    ) -> Optional[dict]:
         """One framed chunk_get round-trip to a peer; None on any failure
-        (connect refused/timeout, torn frame, peer reset mid-response) or a
-        serve-side cache miss — the caller falls back to the store."""
+        (connect refused/timeout, torn frame, peer reset mid-response) —
+        the caller falls back to the store."""
         from .distributed import CorruptFrameError, recv_frame, send_frame
 
         pair = self._acquire_conn(addr, timeout_s)
@@ -696,9 +747,7 @@ class PeerRuntime:
         sock, lock = pair
         try:
             try:
-                send_frame(sock, {
-                    "type": "chunk_get", "store": str(store), "key": str(key),
-                })
+                send_frame(sock, msg)
                 reply = recv_frame(sock)
             except (ConnectionError, OSError, CorruptFrameError):
                 self._discard_conn(addr, pair)
@@ -708,7 +757,29 @@ class PeerRuntime:
         if not isinstance(reply, dict) or reply.get("type") != "chunk_data":
             self._discard_conn(addr, pair)
             return None
-        return reply.get("data")
+        return reply
+
+    def fetch_bytes(
+        self, addr: tuple, store: str, key: str, timeout_s: float
+    ) -> Optional[bytes]:
+        """Whole-chunk fetch: the stored bytes, or None on any failure or
+        a serve-side cache miss."""
+        reply = self._fetch_reply(addr, {
+            "type": "chunk_get", "store": str(store), "key": str(key),
+        }, timeout_s)
+        return reply.get("data") if reply is not None else None
+
+    def fetch_range_reply(
+        self, addr: tuple, store: str, key: str, ranges, timeout_s: float
+    ) -> Optional[dict]:
+        """Sub-chunk fetch: the full reply dict (payload + payload crc +
+        the serving cache's whole-chunk crc/length), or None on failure —
+        verification against the manifest entry happens in
+        :func:`fetch_chunk_ranges`."""
+        return self._fetch_reply(addr, {
+            "type": "chunk_get", "store": str(store), "key": str(key),
+            "ranges": [(int(o), int(n)) for o, n in ranges],
+        }, timeout_s)
 
     def pressure_tick(self, level: str) -> int:
         return self.cache.evict_for_pressure(level)
@@ -795,6 +866,30 @@ def _verify(data: bytes, entry: dict) -> bool:
     return len(data) == entry.get("n") and _crc(data) == entry.get("c")
 
 
+def _fetch_span_name() -> str:
+    """``shuffle_fetch`` inside a rechunk task's exchange window (so the
+    analytics layer attributes the time to its own ``shuffle`` bucket),
+    ``peer_fetch`` everywhere else."""
+    from .shuffle import in_exchange
+
+    return "shuffle_fetch" if in_exchange() else "peer_fetch"
+
+
+def _count_peer_hit(nbytes: int, saved: int) -> None:
+    """Shared hit accounting: ``nbytes`` moved over the peer plane,
+    ``saved`` store-read bytes avoided (for a sub-chunk fetch the whole
+    chunk read is avoided, so saved > fetched — exactly the point)."""
+    from .shuffle import in_exchange
+
+    record_scoped_counter("peer_hits")
+    if nbytes:
+        record_scoped_counter("peer_bytes_fetched", nbytes)
+    if saved:
+        record_scoped_counter("store_read_bytes_saved", saved)
+    if in_exchange() and nbytes:
+        record_scoped_counter("shuffle_bytes_peer", nbytes)
+
+
 def _fallback(store: str, key: str, reason: str) -> None:
     from ..observability.collect import record_decision
 
@@ -827,7 +922,7 @@ def fetch_chunk(store: str, key: str, entry: dict) -> Optional[bytes]:
         record_scoped_counter("peer_hits")
         record_scoped_counter("store_read_bytes_saved", len(data))
         return data
-    with scope_span("peer_fetch", cat="transfer", key=key) as sp:
+    with scope_span(_fetch_span_name(), cat="transfer", key=key) as sp:
         inj = get_injector()
         act = (
             inj.peer_fetch_fault(f"{store}/{key}") if inj is not None else None
@@ -875,9 +970,105 @@ def fetch_chunk(store: str, key: str, entry: dict) -> Optional[bytes]:
             record_scoped_counter("peer_misses")
             sp.attrs["fallback"] = "checksum_mismatch"
             return None
-        record_scoped_counter("peer_hits")
-        record_scoped_counter("peer_bytes_fetched", len(data))
-        record_scoped_counter("store_read_bytes_saved", len(data))
+        _count_peer_hit(len(data), len(data))
         sp.attrs["bytes"] = len(data)
         sp.attrs["peer"] = worker
         return data
+
+
+def fetch_chunk_ranges(
+    store: str, key: str, entry: dict, ranges,
+) -> tuple:
+    """Sub-chunk read-path entry point: ``(payload, attempted)``.
+
+    ``payload`` is the concatenated byte ranges of one chunk from the
+    local cache or a peer, or None. ``attempted`` tells the caller what a
+    None means: False — the peer path never engaged (disarmed, no
+    ranges), so the whole-chunk PEER path may still try; True — a lookup
+    or fetch was attempted and missed/failed, and the caller must go
+    straight to the store read (retrying the whole-chunk peer path would
+    re-draw the fault injector, re-count a miss, and re-dial the same
+    peer for one logical read — the fallback accounting here is the
+    single authoritative record). The shuffle's bytes-moved win lives
+    here: a rechunk target task pulls exactly the regions of each source
+    chunk it overlaps (``shuffle.byte_ranges``) instead of whole chunks
+    it barely touches.
+
+    Verification is double-layered because the whole-chunk manifest CRC
+    cannot check a sub-payload directly: the serving peer returns its
+    cached chunk's insert-time crc + length — which must match the
+    authoritative manifest ``entry`` (proves the cache copy is the real
+    chunk) — plus a crc over the payload itself (proves the sub-bytes
+    crossed the wire intact). Either failing is a transparent store
+    fallback, like every other peer defect.
+    """
+    rt = _runtime
+    cfg = _armed
+    if rt is None or cfg is None or not cfg.enabled or not ranges:
+        return None, False
+    from .faults import get_injector
+
+    store = str(store)
+    want = sum(int(n) for _off, n in ranges)
+    local = rt.cache.get_with_crc(store, key)
+    if local is not None and _verify(local[0], entry):
+        # producer-local: slice process memory, no RPC
+        data = local[0]
+        payload = b"".join(data[int(o):int(o) + int(n)] for o, n in ranges)
+        record_scoped_counter("peer_hits")
+        record_scoped_counter("store_read_bytes_saved", entry.get("n") or 0)
+        return payload, True
+    with scope_span(_fetch_span_name(), cat="transfer", key=key) as sp:
+        sp.attrs["ranges"] = len(ranges)
+        inj = get_injector()
+        act = (
+            inj.peer_fetch_fault(f"{store}/{key}") if inj is not None else None
+        )
+        if act == "drop":
+            _fallback(store, key, "injected_drop")
+            record_scoped_counter("peer_misses")
+            sp.attrs["fallback"] = "injected_drop"
+            return None, True
+        loc = rt.locate(store, key, cfg.locate_timeout_s)
+        if loc is None:
+            record_scoped_counter("peer_misses")
+            sp.attrs["fallback"] = "no_location"
+            return None, True
+        worker, addr = loc
+        if worker == rt.wname:
+            record_scoped_counter("peer_misses")
+            sp.attrs["fallback"] = "evicted_local"
+            return None, True
+        if act == "delay":
+            import time as _time
+
+            _time.sleep(inj.config.peer_delay_s)
+        reply = rt.fetch_range_reply(
+            addr, store, key, ranges, cfg.fetch_timeout_s
+        )
+        payload = reply.get("data") if reply is not None else None
+        if payload is None:
+            _fallback(store, key, "peer_unreachable_or_miss")
+            record_scoped_counter("peer_misses")
+            sp.attrs["fallback"] = "peer_unreachable_or_miss"
+            return None, True
+        if act == "corrupt" and payload:
+            flipped = bytearray(payload)
+            flipped[0] ^= 0x01
+            payload = bytes(flipped)
+        ok = (
+            len(payload) == want
+            and _crc(payload) == reply.get("crc")
+            and reply.get("total") == entry.get("n")
+            and reply.get("full_crc") == entry.get("c")
+        )
+        if not ok:
+            _fallback(store, key, "checksum_mismatch")
+            record_scoped_counter("peer_misses")
+            sp.attrs["fallback"] = "checksum_mismatch"
+            return None, True
+        record_scoped_counter("peer_range_fetches")
+        _count_peer_hit(len(payload), entry.get("n") or 0)
+        sp.attrs["bytes"] = len(payload)
+        sp.attrs["peer"] = worker
+        return payload, True

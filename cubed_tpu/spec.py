@@ -181,15 +181,18 @@ class Spec:
     @property
     def scheduler(self) -> Optional[str]:
         """Task-scheduling mode on the async executors (threads /
-        processes / distributed): ``"oplevel"`` (the effective default —
-        every task of op N finishes before any task of op N+1 starts) or
-        ``"dataflow"`` (chunk-granular: a downstream task dispatches the
-        moment its specific input chunks are written, across op
-        boundaries; ops without chunk-level structure — rechunk,
-        create-arrays — remain conservative barriers). ``None`` defers to
-        the ``CUBED_TPU_SCHEDULER`` env var (operator override, wins) or
-        the op-level default. The sequential oracle and the jax executor
-        always keep op ordering (runtime/dataflow.py)."""
+        processes / distributed): ``"dataflow"`` (the effective default —
+        chunk-granular: a downstream task dispatches the moment its
+        specific input chunks are written, across op boundaries; rechunk
+        contributes its true shuffle edges via ``runtime/shuffle.py``, so
+        only ops without any chunk-level structure — ``create-arrays`` —
+        remain conservative barriers) or ``"oplevel"`` (the explicit
+        escape hatch — every task of op N finishes before any task of op
+        N+1 starts; also what a defaulted scheduler falls back to when
+        ``batch_size`` is set, since dataflow cannot batch). ``None``
+        defers to the ``CUBED_TPU_SCHEDULER`` env var (operator override,
+        wins) or the dataflow default. The sequential oracle and the jax
+        executor always keep op ordering (runtime/dataflow.py)."""
         return self._scheduler
 
     @property

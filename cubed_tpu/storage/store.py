@@ -40,6 +40,7 @@ from ..observability.accounting import (
 from ..observability.metrics import get_registry
 from ..runtime import transfer as p2p
 from ..runtime.faults import FaultInjectedIOError, get_injector
+from ..runtime.shuffle import byte_ranges, chunk_key_str
 from ..runtime.resilience import RetryPolicy
 from ..utils import join_path
 from . import integrity
@@ -443,18 +444,25 @@ class ZarrV2Array:
     # -- chunk IO ----------------------------------------------------------
 
     def _chunk_key(self, idx: tuple[int, ...]) -> str:
-        if not idx:
-            return "0"
-        return ".".join(str(i) for i in idx)
+        # the ONE dotted chunk-file-key formatter, shared with the
+        # dataflow/shuffle edge math (a drift would silently degrade every
+        # rechunk edge to a barrier and break resume-key matching)
+        return chunk_key_str(idx)
 
     def _chunk_nbytes(self) -> int:
         return prod(self.chunks) * self.dtype.itemsize if self.chunks else self.dtype.itemsize
 
-    def _read_chunk(self, idx: tuple[int, ...]) -> Optional[np.ndarray]:
-        """Read the full (padded) chunk at block index *idx*, or None if absent."""
+    def _read_chunk(
+        self, idx: tuple[int, ...], allow_peer: bool = True
+    ) -> Optional[np.ndarray]:
+        """Read the full (padded) chunk at block index *idx*, or None if
+        absent. ``allow_peer=False`` skips the peer fast path — used after
+        a sub-chunk range fetch already attempted (and missed/failed) the
+        peer for this chunk, so one logical read never draws the fault
+        injector or counts a miss twice."""
         key = self._chunk_key(idx)
         verify = integrity.verify_reads_active()
-        if p2p.task_fetch_active():
+        if allow_peer and p2p.task_fetch_active():
             # peer-fetch fast path (fleet workers, Spec/executor-armed):
             # bytes come from the producing worker's chunk cache, verified
             # (CRC32 + length) against the authoritative manifest entry
@@ -496,6 +504,44 @@ class ZarrV2Array:
             data = self._codec[1](data)
         arr = np.frombuffer(data, dtype=self.dtype)
         return arr.reshape(self.chunks if self.shape else ())
+
+    def _read_chunk_region(
+        self, idx: tuple[int, ...], chunk_sel: tuple[slice, ...]
+    ) -> tuple[Optional[np.ndarray], bool]:
+        """Peer-fetch exactly the sub-region of one chunk that a bulk read
+        needs (the shuffle fast path: a rechunk target task overlapping a
+        sliver of a source chunk pulls that sliver, not the whole chunk).
+
+        Returns ``(region, peer_attempted)``: the selected sub-array, or
+        None with ``peer_attempted`` saying whether the peer path already
+        tried (and missed/failed) for this chunk — the caller then reads
+        the store directly instead of re-trying the whole-chunk peer
+        path, so one logical read records exactly one peer outcome. Only
+        for uncompressed stores (a codec makes byte ranges of the stored
+        object meaningless), unit-step selections, manifest-recorded
+        chunks, and regions small enough that ranged fetching beats a
+        whole-chunk fetch (``shuffle.byte_ranges`` decides)."""
+        if self._codec is not None or not p2p.task_fetch_active():
+            return None, False
+        if any((s.step or 1) != 1 for s in chunk_sel):
+            return None, False
+        key = self._chunk_key(idx)
+        entry = self._manifest()[0].get(key)
+        if entry is None:
+            return None, False  # unverifiable: never take the peer path
+        ranges = byte_ranges(
+            self.chunks if self.shape else (), self.dtype.itemsize, chunk_sel
+        )
+        if ranges is None:
+            return None, False
+        payload, attempted = p2p.fetch_chunk_ranges(
+            self.store, key, entry, ranges
+        )
+        if payload is None:
+            return None, attempted
+        region_shape = tuple(s.stop - s.start for s in chunk_sel)
+        arr = np.frombuffer(payload, dtype=self.dtype)
+        return arr.reshape(region_shape), True
 
     def _manifest(self) -> tuple[dict, bool]:
         """Merged checksum manifest ``(entries, had_shards)``, cached per
@@ -693,9 +739,6 @@ class ZarrV2Array:
 
         # iterate over chunks intersecting the selection
         for cidx in self._chunks_overlapping(sel):
-            chunk = self._read_chunk(cidx)
-            if chunk is None:
-                chunk = self._empty_chunk()
             c_starts = tuple(i * c for i, c in zip(cidx, self.chunks))
             chunk_sel = []
             out_sel = []
@@ -720,6 +763,20 @@ class ZarrV2Array:
                 )
             if skip:
                 continue
+            # sub-chunk peer fetch first (shuffle reads touching a sliver
+            # of the chunk move only that sliver); an ineligible read
+            # falls through to the whole-chunk peer-then-store path, an
+            # attempted-and-failed one goes straight to the store (the
+            # range path's fallback record is the one peer outcome)
+            region, peer_tried = self._read_chunk_region(
+                cidx, tuple(chunk_sel)
+            )
+            if region is not None:
+                out[tuple(out_sel)] = region
+                continue
+            chunk = self._read_chunk(cidx, allow_peer=not peer_tried)
+            if chunk is None:
+                chunk = self._empty_chunk()
             out[tuple(out_sel)] = chunk[tuple(chunk_sel)]
         if int_axes:
             out = out.squeeze(axis=tuple(int_axes))

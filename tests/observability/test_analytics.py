@@ -118,7 +118,8 @@ def test_analyze_dataflow_names_straggler_and_attributes_wall(tmp_path):
 
 def test_analyze_oplevel_falls_back_to_op_graph(tmp_path):
     fr = FlightRecorder(bundle_dir=str(tmp_path / "fr"), always=True)
-    _run_chain(tmp_path, scheduler=None, recorder=fr)  # op-level default
+    # the explicit op-level escape hatch records no chunk edges
+    _run_chain(tmp_path, scheduler="oplevel", recorder=fr)
     report = analyze(fr.bundle_path)
     d = report.to_dict()
     assert d["critical_path_source"] == "op_graph"

@@ -57,7 +57,7 @@ def test_explain_rows_and_render(spec):
     assert any(row["bytes_read"] > 0 for row in real)
     text = rep.render()
     assert "EXPLAIN" in text
-    assert "scheduler=oplevel" in text
+    assert "scheduler=dataflow" in text  # the effective default
     for name in ops:
         assert name in text
     assert str(rep) == text
@@ -73,27 +73,39 @@ def test_explain_fusion_counts(spec):
     assert unopt["fusion"]["ops_before"] == unopt["fusion"]["ops_after"]
 
 
-def test_explain_reports_scheduler_and_barriers(tmp_path):
+def test_explain_reports_scheduler_and_rechunk_chunked(tmp_path):
     spec = ct.Spec(
-        work_dir=str(tmp_path), allowed_mem="500MB", scheduler="dataflow"
+        work_dir=str(tmp_path), allowed_mem="500MB", scheduler="dataflow",
+        peer_transfer=True,
     )
     an = np.arange(64, dtype=np.float64).reshape(8, 8)
     a = ct.from_array(an, chunks=(4, 4), spec=spec)
-    # a rechunk has no chunk-level block function: an op-level barrier
+    # rechunk contributes true chunk-level shuffle edges now — EXPLAIN
+    # must report it as chunked (not a barrier) with its predicted
+    # exchange volume when the peer data plane is armed
+    b = ct.map_blocks(lambda x: x + 1.0, a, dtype=np.float64)
     r = ct.map_blocks(
-        lambda x: x + 1.0, a.rechunk((8, 2)), dtype=np.float64
+        lambda x: x * 2.0, b.rechunk((8, 2)), dtype=np.float64
     )
-    d = r.explain().to_dict()
+    d = r.explain(spec=spec, optimize_graph=False).to_dict()
     assert d["scheduler"] == "dataflow"
     assert d["barriers"]["chunk_edges"] is not None
     rows = {row["op"]: row for row in d["ops"]}
-    assert any(
-        not row["chunk_structured"]
-        for name, row in rows.items()
-        if name != "create-arrays"
-    )
-    # the rechunk consumer waits on an op-level barrier
-    assert any(row["barrier"] for row in rows.values())
+    rechunk_rows = [
+        row for row in rows.values() if row["kind"] == "rechunk"
+    ]
+    assert rechunk_rows
+    for row in rechunk_rows:
+        assert row["chunk_structured"] and not row["barrier"], row
+    # no op-level barriers remain (create-arrays is the bootstrap, never
+    # counted), and the shuffle volume is predicted
+    assert d["barriers"]["ops"] == []
+    assert sum(r["shuffle_bytes"] for r in rechunk_rows) > 0
+    assert d["totals"]["predicted_shuffle_bytes"] > 0
+    # without the peer plane armed the prediction reads zero (store path)
+    store_only = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+    off = r.explain(spec=store_only, optimize_graph=False).to_dict()
+    assert off["totals"]["predicted_shuffle_bytes"] == 0
 
 
 def test_explain_peer_eligible_bytes(tmp_path):

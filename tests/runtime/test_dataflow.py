@@ -66,7 +66,13 @@ class _StatsCapture:
 
 
 def test_resolve_scheduler_default_and_spec(tmp_path):
-    assert resolve_scheduler(None) == DEFAULT_MODE == "oplevel"
+    # the dataflow scheduler is the default since rechunk stopped being a
+    # barrier (ROADMAP item 5 first half); oplevel is the explicit escape
+    # hatch
+    assert resolve_scheduler(None) == DEFAULT_MODE == "dataflow"
+    assert resolve_scheduler(
+        ct.Spec(work_dir=str(tmp_path), scheduler="oplevel")
+    ) == "oplevel"
     assert resolve_scheduler(_dataflow_spec(tmp_path)) == "dataflow"
 
 
@@ -154,41 +160,64 @@ def test_chunk_graph_reduction_fan_in(tmp_path):
     assert any(complete for _, _, complete in fan_in_pairs), fan_in_pairs
 
 
-def test_chunk_graph_rechunk_is_barrier(tmp_path):
-    """Rechunk tasks (no chunk-level structure) wait for every producer
-    task, and their consumers wait for every rechunk task; the bootstrap
-    create-arrays op is excluded from the barrier metric."""
-    spec = _dataflow_spec(tmp_path)
-    an = np.arange(64, dtype=np.float64).reshape(8, 8)
-    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+def test_chunk_graph_rechunk_is_chunked(tmp_path):
+    """Rechunk is no longer a barrier: every rechunk task depends on
+    exactly the producer tasks whose chunks its region overlaps
+    (``runtime/shuffle.py`` region math), its consumers depend on the
+    covering rechunk task only, and the barrier metric stays zero."""
+    from cubed_tpu.runtime import shuffle
+
+    # tight allowed_mem so the rechunk write regions stay column strips
+    # (several tasks) instead of consolidating into one whole-array copy
+    spec = ct.Spec(
+        work_dir=str(tmp_path), allowed_mem="700KB", scheduler="dataflow",
+    )
+    an = np.arange(128 * 128, dtype=np.float64).reshape(128, 128)
+    a = ct.from_array(an, chunks=(32, 128), spec=spec)
     b = xp.add(a, 1.0)
-    r = ct.rechunk(b, (4, 4))
+    r = ct.rechunk(b, (128, 32))
     c = xp.add(r, 5.0)
     g = build_chunk_graph(_finalized_dag(c))
 
     by_op = {}
     for idx, (name, _m) in enumerate(g.items):
         by_op.setdefault(name, []).append(idx)
-    structured = {
-        name for name in g.op_order
-        if name in by_op and "rechunk" not in name
-    }
-    rechunk_ops = [n for n in g.op_order if n not in structured]
-    assert rechunk_ops, g.op_order
+    rechunk_ops = [n for n, k in g.op_kind.items() if k == "rechunk"]
+    assert rechunk_ops, g.op_kind
+    assert g.barrier_tasks == 0
+    assert g.barrier_ops == []
     add_op = g.op_order[1]
     create_idxs = set(by_op["create-arrays"])
+    add_key_to_idx = {
+        _task_chunk_key(g.items[i][1]): i for i in by_op[add_op]
+    }
     first_rechunk = rechunk_ops[0]
+    pipeline = g.pipelines[first_rechunk]
+    assert len(by_op[first_rechunk]) > 1, "consolidated into one task"
     for idx in by_op[first_rechunk]:
-        assert set(by_op[add_op]) <= g.dependencies[idx]
-    # consumer of the rechunked array: barrier on the final rechunk stage
-    final_op = g.op_order[-1]
+        _, m = g.items[idx]
+        expected = {
+            add_key_to_idx[key]
+            for _store, key in shuffle.rechunk_task_reads(m, pipeline.config)
+        }
+        assert g.dependencies[idx] - create_idxs == expected
+        # locality: the graph recorded the exact source chunks this
+        # shuffle task reads (what placement scores workers by)
+        assert g.reads[idx], idx
+    # the consumer of the rechunked array depends only on the rechunk
+    # task(s) covering the chunks it reads — not on the whole stage
     last_rechunk = rechunk_ops[-1]
+    rech_cover = {}
+    for i in by_op[last_rechunk]:
+        _, m = g.items[i]
+        for key in shuffle.rechunk_task_writes(m, g.pipelines[last_rechunk].config):
+            rech_cover[key] = i
+    final_op = g.op_order[-1]
     for idx in by_op[final_op]:
-        assert set(by_op[last_rechunk]) <= g.dependencies[idx]
-    assert g.barrier_tasks > 0
-    # deps on create-arrays exist everywhere but never count as barriers
-    for idx in by_op[add_op]:
-        assert g.dependencies[idx] == create_idxs
+        _, m = g.items[idx]
+        deps = g.dependencies[idx] - create_idxs
+        expected = {rech_cover[_task_chunk_key(m)]}
+        assert deps == expected, (m, deps, expected)
 
 
 def test_chunk_graph_resume_satisfies_deps(tmp_path):

@@ -213,9 +213,17 @@ def test_chaos_corruption_kill_resume_distributed(tmp_path):
 def _recompute_repairs_mid_compute(tmp_path, executor):
     """A corrupt intermediate chunk is detected at read time (verify mode),
     quarantined, its producing task re-run, and the reader retried — the
-    compute completes bitwise-correct without resume."""
+    compute completes bitwise-correct without resume.
+
+    Pinned to the op-level escape hatch: the corruptor fires on the
+    producing op's END event, which only precedes every consumer read
+    under the op barrier — with the (default) dataflow scheduler the
+    consumers overlap the producer and may read before the corruption
+    lands. The dataflow-mode RECOMPUTE proof (corrupt-on-first-task-end,
+    mid-overlap) lives in test_dataflow.py."""
     an = np.arange(100.0, dtype=np.float64).reshape(10, 10)
-    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB", integrity="verify")
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB",
+                   integrity="verify", scheduler="oplevel")
     a = ct.from_array(an, chunks=(2, 2), spec=spec)
     b = xp.add(a, 1.0)
     c = xp.multiply(b, 2.0)  # optimize_graph=False keeps b materialized
