@@ -1015,6 +1015,101 @@ print(json.dumps(out), flush=True)
 """
 
 
+STORE_BROWNOUT = r"""
+import itertools, json, os, sys, tempfile, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import cubed_tpu as ct
+from cubed_tpu import utils as ct_utils
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+from cubed_tpu.runtime.resilience import RetryPolicy
+from cubed_tpu.storage import health
+
+N, CHUNK, RATE = 24, 2, 0.25
+an = np.arange(N * N, dtype=np.float64).reshape(N, N)
+
+
+def run(base):
+    # pinned gensym names: both modes must roll IDENTICAL seeded
+    # throttle decisions (chunk keys embed the array names)
+    ct_utils.sym_counter = itertools.count(base)
+    spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="2GB",
+                   fault_injection=dict(seed=23, storage_throttle_rate=RATE))
+    a = ct.from_array(an, chunks=(CHUNK, CHUNK), spec=spec)
+    b = a * 2.0 + 1.0
+    before = get_registry().snapshot()
+    t0 = time.perf_counter()
+    val = np.asarray(b.compute(
+        executor=AsyncPythonDagExecutor(
+            max_workers=4,
+            retry_policy=RetryPolicy(retries=6, backoff_base=0.01, seed=0),
+        ),
+    ))
+    elapsed = time.perf_counter() - t0
+    assert (val == an * 2.0 + 1.0).all(), "brownout result not bitwise"
+    d = get_registry().snapshot_delta(before)
+    return {{
+        "elapsed": elapsed,
+        "task_retries": int(d.get("task_retries", 0) or 0),
+        "store_throttled": int(d.get("store_throttled", 0) or 0),
+        "store_breaker_trips": int(d.get("store_breaker_trips", 0) or 0),
+    }}
+
+
+out = {{}}
+os.environ[health.BREAKER_ENV_VAR] = "off"
+out["breaker_off"] = run(90_000)
+health.reset_breakers()
+os.environ.pop(health.BREAKER_ENV_VAR, None)
+out["breaker_on"] = run(90_000)
+out["retry_draw_saved"] = (
+    out["breaker_off"]["task_retries"] - out["breaker_on"]["task_retries"]
+)
+# the generic perf gate reads this key: the breaker-ON wall clock under
+# a seeded brownout is what must not regress
+out["elapsed"] = out["breaker_on"]["elapsed"]
+print(json.dumps(out), flush=True)
+"""
+
+
+def measure_store_brownout(timeout: float):
+    """Seeded store brownout (25% 429/503-shaped throttles), health
+    breaker on vs off: retry-budget draw and wall clock for both modes
+    into BENCH_METRICS.json as ``store_brownout``. The breaker-on wall
+    rides the generic >20% perf gate; the breaker must also draw
+    strictly less retry budget than the off baseline (asserted in
+    tier-1 chaos, recorded here as a tracked number)."""
+    script = STORE_BROWNOUT.format(repo=REPO)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            env=_scrubbed_cpu_env(),
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"store brownout failed (rc={out.returncode}): "
+                f"{out.stderr[-2000:]}"
+            )
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        print(
+            "store brownout: breaker on "
+            f"{res['breaker_on']['elapsed']:.2f}s / "
+            f"{res['breaker_on']['task_retries']} retries drawn vs off "
+            f"{res['breaker_off']['elapsed']:.2f}s / "
+            f"{res['breaker_off']['task_retries']} retries "
+            f"({res['retry_draw_saved']} saved)",
+            file=sys.stderr, flush=True,
+        )
+        return res
+    except Exception as e:
+        print(f"store brownout sweep skipped: {e}", file=sys.stderr)
+        return None
+
+
 def measure_analytics_overhead(timeout: float):
     """Deep-chain wall clock, analytics armed (TraceCollector + post-hoc
     ``analyze()``) vs off.
@@ -1627,6 +1722,17 @@ def main() -> None:
             metrics_record["analytics_overhead"] = ana
     else:
         print("analytics overhead sweep skipped: out of budget",
+              file=sys.stderr)
+
+    # store brownout: seeded 429/503 throttles, health breaker on vs off
+    # (wall clock + retry-budget draw; the breaker-on wall rides the
+    # generic perf gate)
+    if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 45:
+        brn = measure_store_brownout(_remaining(90))
+        if brn is not None:
+            metrics_record["store_brownout"] = brn
+    else:
+        print("store brownout sweep skipped: out of budget",
               file=sys.stderr)
 
     # multi-tenant service: sustained submissions from N synthetic

@@ -229,12 +229,30 @@ class Plan:
         array_names: Optional[tuple] = None,
         spec=None,
         finalized: Optional["FinalizedPlan"] = None,
+        deadline_s: Optional[float] = None,
+        cancellation=None,
         **kwargs,
     ) -> None:
         if executor is None:
             from ..runtime.executors.python import PythonDagExecutor
 
             executor = PythonDagExecutor()
+
+        # end-to-end time bound (runtime/cancellation.py): deadline_s
+        # mints a per-compute CancellationToken (or tightens one the
+        # caller passed — the service threads its own through here so
+        # RequestHandle.cancel() reaches RUNNING computes); the token is
+        # checked by every dispatch loop, carried on distributed task
+        # messages, and enforced cooperatively inside task bodies
+        from ..runtime import cancellation as cancel_mod
+
+        cancel_token = cancellation
+        if deadline_s is not None:
+            if cancel_token is None:
+                cancel_token = cancel_mod.CancellationToken()
+            cancel_token.set_deadline(deadline_s)
+        if cancel_token is not None:
+            kwargs["cancellation"] = cancel_token
 
         if resume_from_journal is not None:
             # coordinator-crash recovery: the journal's completed-task set
@@ -311,6 +329,11 @@ class Plan:
             all_callbacks, "on_compute_start",
             ComputeStartEvent(dag, resume, compute_id=compute_id),
         )
+        if cancel_token is not None:
+            # registered under the compute id for the compute's duration:
+            # the coordinator reads it per task message, in-process chunk
+            # IO checks it between reads/writes (unregistered in finally)
+            cancel_mod.register_compute(compute_id, cancel_token)
         compute_error: Optional[BaseException] = None
         try:
             # Spec-level chaos config arms fault injection for this
@@ -387,6 +410,8 @@ class Plan:
             # increments in their windows (docs/observability.md). The
             # event-derived numbers (per_op, tasks/bytes via the
             # aggregator's own fold) are exact per compute either way.
+            if cancel_token is not None:
+                cancel_mod.unregister_compute(compute_id)
             stats: dict = {}
             try:
                 executor_own = getattr(executor, "stats", None)

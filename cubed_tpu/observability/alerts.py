@@ -297,6 +297,15 @@ def default_rules(retry_budget_hint: float = 50.0) -> list:
             "the tenant's quota weight, and whether another tenant's "
             "long computes hold every admission slot",
         ),
+        ThresholdRule(
+            "store_brownout", metric="store_throttled", rate=True,
+            threshold=0.5, window_s=30.0, severity="critical",
+            description="the store is answering 429/503/SlowDown faster "
+            "than 1 per 2s over 30s: a brownout — the per-store health "
+            "breaker is pacing storage concurrency (check "
+            "store_breaker_state); expect degraded throughput, raise "
+            "provisioned store throughput or lean on the peer data plane",
+        ),
     ]
 
 

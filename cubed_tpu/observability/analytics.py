@@ -70,12 +70,18 @@ SPAN_BUCKETS = {
     "shuffle_fetch": "shuffle",
     "retry_sleep": "retry",
     "recompute_repair": "retry",
+    # brownout time: waiting for a breaker IO slot + paced in-place
+    # throttle retries (storage/health.py) — kept out of storage_read/
+    # write so "the store was slow" and "the store told us to slow down"
+    # are distinguishable in the attribution
+    "throttle_wait": "throttle_wait",
 }
 
 #: every attribution bucket, in render order
 BUCKETS = (
     "kernel", "storage_read", "storage_write", "peer_fetch", "shuffle",
-    "retry", "queue_wait", "straggler_excess", "uninstrumented", "other",
+    "retry", "throttle_wait", "queue_wait", "straggler_excess",
+    "uninstrumented", "other",
 )
 
 #: straggler thresholds (match TraceCollector's live-watch defaults)

@@ -1,0 +1,390 @@
+"""End-to-end deadlines and cooperative cancellation: bound *time* the way
+the rest of the stack bounds memory.
+
+The paper's guarantee is a bounded resource per task — but until this
+module nothing bounded how LONG a compute may run: a browned-out store or
+a pathological kernel ran forever, and a client's only recourse was
+killing its own process (recoverable thanks to the journal, but never
+graceful). A :class:`CancellationToken` closes that gap with the same
+layered discipline the memory guard uses:
+
+- **One token per compute.** ``Plan.execute(deadline_s=...)`` (or an
+  explicit ``cancellation=CancellationToken()``) mints it;
+  ``ComputeService.submit(deadline_s=...)`` threads one through every
+  request so ``RequestHandle.cancel()`` finally works on RUNNING
+  requests, not just queued ones. The deadline is an absolute wall-clock
+  epoch so it can cross process boundaries unchanged.
+
+- **The dispatch loop is the first enforcement point.**
+  ``map_unordered`` checks the token every iteration: a tripped token
+  stops new submissions, cancels pending futures, and raises the typed
+  error (:class:`ComputeCancelledError` /
+  :class:`ComputeDeadlineExceededError` — picklable, classified
+  ``CANCELLED`` by the resilience layer, drawing ZERO retry budget).
+
+- **Workers abort cooperatively.** Every distributed task message
+  carries the token's wire form (compute id + deadline + cancelled
+  flag); an explicit cancel additionally broadcasts a ``compute_cancel``
+  frame so pre-started fleet workers learn within one frame delivery,
+  not one task round-trip. Worker-side checks run in
+  ``execute_with_stats`` (before the task body) and between chunk
+  reads/writes in ``storage/store.py`` — tasks abort at the next safe
+  boundary, never mid-write, so the store and journal stay consistent
+  and ``resume_compute`` after a deadline abort is bitwise-correct.
+
+Token lookup is keyed by the compute id already riding the
+``logs.compute_id_var`` contextvar (set by ``Plan.execute`` client-side
+and per task message worker-side), so concurrent computes in one process
+— the multi-tenant service's normal state — cancel independently.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from ..observability.metrics import get_registry
+
+#: bounded worker-side token registries (a long-lived fleet worker serves
+#: many computes; stale tokens must age out, not accumulate)
+MAX_WORKER_TOKENS = 128
+#: compute ids cancelled via ``compute_cancel`` frames, retained so a
+#: cancel that RACES its compute's first task message still sticks
+MAX_CANCELLED_IDS = 512
+
+
+class ComputeCancelledError(RuntimeError):
+    """The compute's cancellation token was tripped (explicit
+    ``CancellationToken.cancel()`` — a client cancel, a service shutdown).
+
+    Picklable (it crosses pool and fleet boundaries like any task
+    failure) and classified ``CANCELLED`` by the resilience layer: no
+    retry, no backoff, zero retry-budget draw — cancellation is an
+    *instruction*, not a failure to recover from."""
+
+    def __init__(self, message: str = "compute cancelled",
+                 compute_id: Optional[str] = None,
+                 reason: Optional[str] = None):
+        super().__init__(message)
+        self.compute_id = compute_id
+        self.reason = reason
+
+    def __reduce__(self):
+        return (
+            type(self),
+            (self.args[0] if self.args else "", self.compute_id, self.reason),
+        )
+
+
+class ComputeDeadlineExceededError(ComputeCancelledError):
+    """The compute ran past its deadline (``deadline_s``). A subclass of
+    :class:`ComputeCancelledError` so every cooperative-abort check covers
+    both; kept distinct so callers (and the service's request states) can
+    tell an operator-initiated cancel from an SLO violation."""
+
+
+class CancellationToken:
+    """One compute's deadline + cancel flag, shared by every layer.
+
+    Thread-safe; cheap to poll (``cancelled`` is an event check plus one
+    ``time.time()`` comparison). ``on_abort`` callbacks fire exactly once
+    — on explicit :meth:`cancel`, or when the first enforcement point
+    observes an expired deadline (:meth:`notify_abort`) — which is how
+    the distributed executor broadcasts ``compute_cancel`` to the fleet
+    the moment the token trips."""
+
+    def __init__(self, deadline_s: Optional[float] = None,
+                 deadline_epoch: Optional[float] = None,
+                 compute_id: Optional[str] = None):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._callbacks: list[Callable[[], None]] = []
+        self._notified = False
+        #: True when cancel() tripped the token BEFORE the deadline
+        #: passed: error() must then report the explicit cancel even if
+        #: the deadline has also expired by observation time
+        self._explicit = False
+        self.reason: Optional[str] = None
+        self.compute_id = compute_id
+        self.deadline_epoch: Optional[float] = deadline_epoch
+        if deadline_s is not None:
+            self.set_deadline(deadline_s)
+
+    # -- arming --------------------------------------------------------
+
+    def set_deadline(self, deadline_s: float) -> None:
+        """Arm (or tighten) the deadline to ``deadline_s`` seconds from
+        now. A later deadline never loosens an armed earlier one."""
+        epoch = time.time() + float(deadline_s)
+        with self._lock:
+            if self.deadline_epoch is None or epoch < self.deadline_epoch:
+                self.deadline_epoch = epoch
+
+    def on_abort(self, fn: Callable[[], None]) -> None:
+        """Register a callback fired once when the token trips (already
+        tripped -> fired immediately)."""
+        fire = False
+        with self._lock:
+            if self._notified:
+                fire = True
+            else:
+                self._callbacks.append(fn)
+        if fire:
+            try:
+                fn()
+            except Exception:
+                pass
+
+    # -- state ---------------------------------------------------------
+
+    @property
+    def expired(self) -> bool:
+        d = self.deadline_epoch
+        return d is not None and time.time() >= d
+
+    @property
+    def cancelled(self) -> bool:
+        """True once the token has tripped (explicit cancel or deadline)."""
+        return self._event.is_set() or self.expired
+
+    def remaining(self) -> Optional[float]:
+        """Seconds until the deadline (None = no deadline; <= 0 expired)."""
+        d = self.deadline_epoch
+        return None if d is None else d - time.time()
+
+    # -- tripping ------------------------------------------------------
+
+    def cancel(self, reason: Optional[str] = None) -> None:
+        """Trip the token explicitly. Idempotent; fires the abort
+        callbacks (fleet broadcast) from the CALLER's thread so a cancel
+        reaches workers without waiting for the dispatch loop to wake."""
+        with self._lock:
+            if self.reason is None:
+                self.reason = reason
+            if not self.expired:
+                # which bound tripped FIRST is decided here, not at the
+                # (possibly much later) observation point
+                self._explicit = True
+        self._event.set()
+        self.notify_abort()
+
+    def notify_abort(self) -> None:
+        """Fire the abort callbacks exactly once (also called by the
+        first enforcement point to observe an expired deadline)."""
+        with self._lock:
+            if self._notified:
+                return
+            self._notified = True
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            try:
+                fn()
+            except Exception:
+                pass
+
+    def error(self) -> ComputeCancelledError:
+        """The typed error this token aborts with: whichever bound
+        tripped FIRST wins — an explicit cancel() issued before the
+        deadline passed reports the cancel even when the dispatch loop
+        only observes it after expiry."""
+        if self._explicit or (self._event.is_set() and not self.expired):
+            return ComputeCancelledError(
+                f"compute {self.compute_id or '<unnamed>'} cancelled"
+                + (f": {self.reason}" if self.reason else ""),
+                compute_id=self.compute_id, reason=self.reason,
+            )
+        if self.expired:
+            return ComputeDeadlineExceededError(
+                f"compute {self.compute_id or '<unnamed>'} exceeded its "
+                f"deadline (epoch {self.deadline_epoch})",
+                compute_id=self.compute_id, reason="deadline",
+            )
+        return ComputeCancelledError(
+            f"compute {self.compute_id or '<unnamed>'} cancelled"
+            + (f": {self.reason}" if self.reason else ""),
+            compute_id=self.compute_id, reason=self.reason,
+        )
+
+    def check(self) -> None:
+        """Raise the typed error if tripped (cooperative-abort check)."""
+        if self.cancelled:
+            raise self.error()
+
+    # -- wire ----------------------------------------------------------
+
+    def wire(self) -> Optional[dict]:
+        """The plain-dict form riding distributed task messages. ``None``
+        when there is nothing to enforce (no deadline, not cancelled) —
+        workers then skip registration entirely."""
+        cancelled = self._event.is_set()
+        if self.deadline_epoch is None and not cancelled:
+            return None
+        return {
+            "compute": self.compute_id,
+            "deadline": self.deadline_epoch,
+            "cancelled": cancelled,
+            "reason": self.reason,
+        }
+
+    def __repr__(self) -> str:
+        return (
+            f"CancellationToken(compute={self.compute_id!r}, "
+            f"deadline_epoch={self.deadline_epoch}, "
+            f"cancelled={self.cancelled})"
+        )
+
+
+# ----------------------------------------------------------------------
+# per-process registries: client side (Plan.execute) and worker side
+# (task-message wire arming + compute_cancel frames)
+# ----------------------------------------------------------------------
+
+_lock = threading.Lock()
+#: client-process: compute id -> the token Plan.execute armed for it
+_client_tokens: "OrderedDict[str, CancellationToken]" = OrderedDict()
+#: worker-process: compute id -> the token mirrored off task messages
+_worker_tokens: "OrderedDict[str, CancellationToken]" = OrderedDict()
+#: worker-process: compute ids cancelled via compute_cancel frames (kept
+#: so a cancel frame racing the compute's first task message still lands)
+_cancelled_ids: "OrderedDict[str, float]" = OrderedDict()
+#: fast path: True only while ANY token is registered in this process —
+#: the per-chunk-IO check must cost one attribute read when unused
+_any_tokens = False
+
+
+def _refresh_any() -> None:
+    global _any_tokens
+    _any_tokens = bool(_client_tokens or _worker_tokens)
+
+
+def register_compute(compute_id: str, token: CancellationToken) -> None:
+    """Client side: associate a compute's token with its id for the
+    duration of ``Plan.execute`` (the coordinator reads it per task
+    message; in-process task threads read it per chunk IO)."""
+    token.compute_id = token.compute_id or compute_id
+    with _lock:
+        _client_tokens[compute_id] = token
+        _refresh_any()
+
+
+def unregister_compute(compute_id: str) -> None:
+    with _lock:
+        _client_tokens.pop(compute_id, None)
+        _refresh_any()
+
+
+def wire_for_compute(compute_id: Optional[str]) -> Optional[dict]:
+    """The wire form of the current compute's token, for task messages
+    (None = nothing to enforce). Read per submit, so a cancel that trips
+    mid-compute rides every LATER task message too — a worker that missed
+    the broadcast still learns."""
+    if compute_id is None:
+        return None
+    with _lock:
+        token = _client_tokens.get(compute_id)
+    return token.wire() if token is not None else None
+
+
+def arm_from_wire(raw: Optional[dict]) -> Optional[CancellationToken]:
+    """Worker side: adopt the token a task message carried. Registered by
+    compute id (bounded LRU), merged with any ``compute_cancel`` frame
+    that arrived first."""
+    if not isinstance(raw, dict):
+        return None
+    cid = raw.get("compute")
+    if not cid:
+        return None
+    with _lock:
+        token = _worker_tokens.get(cid)
+        if token is None:
+            token = CancellationToken(compute_id=cid)
+            _worker_tokens[cid] = token
+            while len(_worker_tokens) > MAX_WORKER_TOKENS:
+                _worker_tokens.popitem(last=False)
+        else:
+            _worker_tokens.move_to_end(cid)
+        already_cancelled = cid in _cancelled_ids
+        _refresh_any()
+    deadline = raw.get("deadline")
+    if deadline is not None:
+        with token._lock:
+            if (
+                token.deadline_epoch is None
+                or deadline < token.deadline_epoch
+            ):
+                token.deadline_epoch = float(deadline)
+    if raw.get("cancelled") or already_cancelled:
+        token.cancel(raw.get("reason"))
+    return token
+
+
+def cancel_compute(compute_id: Optional[str],
+                   reason: Optional[str] = None) -> None:
+    """Worker side: a ``compute_cancel`` frame arrived. Trips the
+    registered token (or records the id so a racing task message's
+    arming finds the cancel waiting)."""
+    if not compute_id:
+        return
+    with _lock:
+        _cancelled_ids[compute_id] = time.time()
+        while len(_cancelled_ids) > MAX_CANCELLED_IDS:
+            _cancelled_ids.popitem(last=False)
+        token = _worker_tokens.get(compute_id)
+    if token is not None:
+        token.cancel(reason or "coordinator compute_cancel")
+
+
+def current_token() -> Optional[CancellationToken]:
+    """The token governing the CURRENT compute, resolved through the
+    compute-id CONTEXTVAR only (set by ``Plan.execute`` client-side and
+    per task message worker-side). Deliberately NOT the env-var fallback
+    ``logs.current_compute_id`` uses: the env export is last-writer-wins
+    across concurrent computes, so a pool task thread of compute A could
+    resolve compute B's id and abort on B's tripped token — the
+    dispatch-loop check covers in-process pool threads instead. None
+    when no compute is armed — the common fast path, one flag read."""
+    if not _any_tokens:
+        return None
+    from ..observability.logs import compute_id_var
+
+    cid = compute_id_var.get()
+    if not cid:
+        return None
+    with _lock:
+        return _client_tokens.get(cid) or _worker_tokens.get(cid)
+
+
+def check_current() -> None:
+    """Cooperative-abort check at a safe boundary (task start, between
+    chunk reads/writes): raises the typed error when the governing token
+    has tripped. A no-op (one attribute read) with no tokens armed."""
+    token = current_token()
+    if token is not None and token.cancelled:
+        raise token.error()
+
+
+def abort(token: CancellationToken) -> ComputeCancelledError:
+    """The one counted/recorded abort path every dispatch loop shares:
+    counts ``deadline_aborts`` or ``cancellations``, records the decision
+    (``deadline_exceeded`` / ``compute_cancelled``), fires the token's
+    abort callbacks (fleet broadcast), and returns the error to raise."""
+    from ..observability.collect import record_decision
+
+    token.notify_abort()
+    err = token.error()
+    reg = get_registry()
+    if isinstance(err, ComputeDeadlineExceededError):
+        reg.counter("deadline_aborts").inc()
+        record_decision(
+            "deadline_exceeded", compute=token.compute_id,
+            deadline_epoch=token.deadline_epoch,
+        )
+    else:
+        reg.counter("cancellations").inc()
+        record_decision(
+            "compute_cancelled", compute=token.compute_id,
+            reason=token.reason,
+        )
+    return err

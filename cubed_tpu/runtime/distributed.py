@@ -365,6 +365,7 @@ class Coordinator:
             "workers_reconnected": 0, "leases_expired": 0,
             "frames_corrupt": 0, "workers_rejected": 0,
             "peer_locate_requests": 0, "placement_locality_hits": 0,
+            "compute_cancels_sent": 0,
         }
         #: (store, chunk key) -> producing worker, fed by the `produced`
         #: lists piggybacked on sequenced result frames; drives the
@@ -1427,6 +1428,7 @@ class Coordinator:
             from ..observability import accounting, logs
             from ..observability.collect import record_decision
             from ..storage import integrity
+            from . import cancellation as cancel_mod
             from . import memory
             from . import transfer as p2p
             from .faults import wire_config
@@ -1469,6 +1471,14 @@ class Coordinator:
                 # workers cache/advertise/fetch exactly when this compute
                 # asked for the p2p data plane
                 "peer": p2p.wire_config(),
+                # ... and the compute's cancellation token (deadline epoch
+                # + cancelled flag, None = unbounded): workers abort
+                # cooperatively between chunk reads/writes the moment it
+                # trips — read per submit, so a cancel mid-compute rides
+                # every later task message even if the broadcast was lost
+                "cancel": cancel_mod.wire_for_compute(
+                    logs.current_compute_id()
+                ),
             }
             try:
                 send_frame(conn.sock, msg, conn.send_lock)
@@ -1495,6 +1505,44 @@ class Coordinator:
             if first_use:
                 self.stats["blobs_sent"] += 1
             return fut
+
+    def broadcast_cancel(
+        self, compute_id: Optional[str], reason: Optional[str] = None
+    ) -> int:
+        """Send a ``compute_cancel`` frame to every connected worker so
+        the fleet aborts that compute's tasks cooperatively (between
+        chunk reads/writes). Best-effort by design: a worker that misses
+        the frame (disconnected, mid-partition) still learns from the
+        tripped token riding any later task message, and its in-flight
+        results are simply discarded client-side. Returns the number of
+        workers notified."""
+        if not compute_id:
+            return 0
+        with self._lock:
+            conns = [
+                w for w in self._workers if w.alive and w.connected
+            ]
+        notified = 0
+        for conn in conns:
+            try:
+                send_frame(
+                    conn.sock,
+                    {
+                        "type": "compute_cancel",
+                        "compute": compute_id,
+                        "reason": reason,
+                    },
+                    conn.send_lock,
+                )
+                notified += 1
+            except (ConnectionError, OSError):
+                continue  # the task-message path is the backstop
+        self.stats["compute_cancels_sent"] += notified
+        logger.info(
+            "broadcast compute_cancel for %s to %d worker(s)",
+            compute_id, notified,
+        )
+        return notified
 
     def stats_snapshot(self) -> dict:
         """Counters plus a per-worker load view (outstanding tasks, ghost
@@ -1576,7 +1624,7 @@ ACK_STALE_S = 1.5
 #: dimension and never crosses into client metrics
 _WORKER_FOLD_COUNTERS = (
     "peer_hits", "peer_misses", "chunks_verified",
-    "chunks_corrupt_detected",
+    "chunks_corrupt_detected", "store_throttled",
 )
 
 #: cap on the per-heartbeat metrics-delta payload (numeric keys): the
@@ -1774,6 +1822,7 @@ def run_worker(
     )
     from ..storage import integrity
     from ..utils import current_measured_mem
+    from . import cancellation
     from . import memory
     from . import transfer as p2p
     from .faults import arm_from_wire, get_injector
@@ -2056,6 +2105,13 @@ def run_worker(
                 arm_spans_from_wire(msg.get("spans"))
             if "peer" in msg:
                 p2p.arm_from_wire(msg.get("peer"))
+            if msg.get("cancel") is not None:
+                # the compute's cancellation token (deadline epoch +
+                # cancelled flag), registered by compute id: the checks in
+                # execute_with_stats and the storage layer resolve it via
+                # this task's compute-id context, so concurrent computes
+                # on one worker cancel independently
+                cancellation.arm_from_wire(msg.get("cancel"))
             if injector is not None:
                 action = injector.worker_task_tick(wname)
                 if action == "crash":
@@ -2333,6 +2389,14 @@ def run_worker(
         elif mtype == "chunk_location":
             if peer_rt is not None:
                 peer_rt.on_location(msg)
+        elif mtype == "compute_cancel":
+            # cooperative cancellation: trip (or pre-record) the named
+            # compute's token so every in-flight task aborts at its next
+            # chunk-IO boundary and queued assignments of that compute
+            # fail fast instead of running
+            cancellation.cancel_compute(
+                msg.get("compute"), msg.get("reason")
+            )
         elif mtype == "drain":
             # graceful scale-down (or an operator-initiated drain):
             # same path as the SIGTERM handler, reason carried over

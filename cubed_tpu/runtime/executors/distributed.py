@@ -154,8 +154,8 @@ class DistributedDagExecutor(DagExecutor):
         self.lease_s = lease_s
         #: peer-to-peer chunk transfer (runtime/transfer.py): None defers
         #: to CUBED_TPU_P2P / Spec(peer_transfer=...), the effective
-        #: default being off — the store-only data plane is the exact
-        #: historical behavior
+        #: default being ON — store-only (peer_transfer=False or
+        #: CUBED_TPU_P2P=off) is the explicit escape hatch
         self.peer_transfer = peer_transfer
         self.retries = retries
         self.use_backups = use_backups
@@ -424,6 +424,7 @@ class DistributedDagExecutor(DagExecutor):
         compute_arrays_in_parallel: Optional[bool] = None,
         retry_policy: Optional[RetryPolicy] = None,
         journal=None,
+        cancellation=None,
         **kwargs,
     ) -> None:
         retries = self.retries if retries is None else retries
@@ -457,6 +458,19 @@ class DistributedDagExecutor(DagExecutor):
                 "workers with 'python -m cubed_tpu.runtime.worker "
                 f"{host}:{port}' or configure n_local_workers/min_workers "
                 "so the fleet is populated before computing"
+            )
+
+        if cancellation is not None:
+            # the moment the token trips — an explicit cancel from any
+            # thread, or the dispatch loop observing an expired deadline —
+            # broadcast a compute_cancel frame so every fleet worker
+            # aborts cooperatively at its next safe boundary instead of
+            # waiting for its next task message to carry the tripped state
+            cid = obs_logs.current_compute_id()
+            cancellation.on_abort(
+                lambda: coord.broadcast_cancel(
+                    cid, reason=cancellation.reason
+                )
             )
 
         state = (
@@ -522,6 +536,7 @@ class DistributedDagExecutor(DagExecutor):
                             dependencies=sched.dependencies,
                             on_input_submit=sched.on_submit,
                             on_input_done=sched.on_done,
+                            cancellation=cancellation,
                         )
                 finally:
                     sched.finish()
@@ -549,6 +564,7 @@ class DistributedDagExecutor(DagExecutor):
                         executor_name=self.name,
                         recompute_resolver=resolver,
                         admission=admission,
+                        cancellation=cancellation,
                     )
                     end_generation(generation, callbacks)
             else:
@@ -573,6 +589,7 @@ class DistributedDagExecutor(DagExecutor):
                         executor_name=self.name,
                         recompute_resolver=resolver,
                         admission=admission,
+                        cancellation=cancellation,
                         config=pipeline.config,
                     )
                     callbacks_on(

@@ -449,7 +449,15 @@ class JaxExecutor(DagExecutor):
         resume_state = (
             ResumeState(quarantine=True, journal=journal) if resume else None
         )
+        cancellation = kwargs.get("cancellation")
         for name, node in visit_nodes(dag, resume=resume, state=resume_state):
+            if cancellation is not None and cancellation.cancelled:
+                # cooperative abort at the op/segment boundary (a fused
+                # device segment is not an interruptible unit): flushes
+                # nothing partial — materialized arrays are whole
+                from ..cancellation import abort as _cancel_abort
+
+                raise _cancel_abort(cancellation)
             primitive_op = node["primitive_op"]
             kind = self._classify(primitive_op) if self.fuse_plan else "eager"
             if kind == "trace":

@@ -216,6 +216,7 @@ class MultiprocessDagExecutor(DagExecutor):
         compute_arrays_in_parallel: Optional[bool] = None,
         retry_policy: Optional[RetryPolicy] = None,
         journal=None,
+        cancellation=None,
         **kwargs,
     ) -> None:
         retries = self.retries if retries is None else retries
@@ -290,6 +291,7 @@ class MultiprocessDagExecutor(DagExecutor):
                         on_input_submit=sched.on_submit,
                         on_input_done=sched.on_done,
                         completed_inputs=sched.completed,
+                        cancellation=cancellation,
                     )
                 finally:
                     sched.finish()
@@ -320,6 +322,7 @@ class MultiprocessDagExecutor(DagExecutor):
                         executor_name=self.name,
                         recompute_resolver=resolver,
                         admission=admission,
+                        cancellation=cancellation,
                     )
                     end_generation(generation, callbacks)
             else:
@@ -345,6 +348,7 @@ class MultiprocessDagExecutor(DagExecutor):
                         executor_name=self.name,
                         recompute_resolver=resolver,
                         admission=admission,
+                        cancellation=cancellation,
                     )
                     callbacks_on(
                         callbacks, "on_operation_end",

@@ -65,6 +65,7 @@ class PythonDagExecutor(DagExecutor):
         retries: Optional[int] = None,
         retry_policy: Optional[RetryPolicy] = None,
         journal=None,
+        cancellation=None,
         **kwargs,
     ) -> None:
         retries = self.retries if retries is None else retries
@@ -95,6 +96,10 @@ class PythonDagExecutor(DagExecutor):
             )
             mappable, _ = pending_mappable(name, node, resume, state)
             for m in mappable:
+                if cancellation is not None and cancellation.cancelled:
+                    from ..cancellation import abort as _cancel_abort
+
+                    raise _cancel_abort(cancellation)
                 created = time.time()
                 key = chunk_key(m)
                 failures = 0
@@ -133,6 +138,16 @@ class PythonDagExecutor(DagExecutor):
                             # exhausted task surfaces the actionable form
                             count_resource_failure(metrics, exc)
                         failures += 1
+                        if cls is Classification.CANCELLED:
+                            # the compute was cancelled / hit its
+                            # deadline: abort, never retry, zero budget
+                            if cancellation is not None:
+                                from ..cancellation import (
+                                    abort as _cancel_abort,
+                                )
+
+                                raise _cancel_abort(cancellation) from exc
+                            raise
                         # REQUEUE cannot arise in-process; treat it as RETRY
                         if cls is Classification.FAIL_FAST:
                             metrics.counter("task_failfast").inc()

@@ -110,6 +110,7 @@ def map_unordered(
     on_input_submit: Optional[Callable[[int], None]] = None,
     on_input_done: Optional[Callable[[int], None]] = None,
     completed_inputs: Optional[set] = None,
+    cancellation=None,
     **kwargs,
 ) -> None:
     """Run function over inputs, handling completion order, retries, backups.
@@ -157,6 +158,14 @@ def map_unordered(
     index space (the multiprocess pool rebuild) resumes from where the
     previous attempt died instead of re-running the whole map; their
     dependents' edges count as satisfied.
+
+    ``cancellation`` (a ``runtime.cancellation.CancellationToken``) bounds
+    TIME the way ``admission`` bounds memory: the dispatch loop checks it
+    every iteration — a tripped token (explicit cancel or deadline) stops
+    new submissions, cancels pending futures, and raises the typed
+    ``ComputeCancelledError``/``ComputeDeadlineExceededError``. A
+    CANCELLED-classified task failure (a worker aborted cooperatively)
+    does the same, drawing zero retry budget either way.
     """
     policy = resolve_policy(retry_policy, retries)
     if admission is None:
@@ -178,6 +187,7 @@ def map_unordered(
             on_input_submit=on_input_submit,
             on_input_done=on_input_done,
             completed_inputs=completed_inputs,
+            cancellation=cancellation,
             **kwargs,
         )
     elif array_names is None:
@@ -190,6 +200,7 @@ def map_unordered(
                 executor, function, batch, policy, retry_budget,
                 use_backups, callbacks, array_name, None, executor_name,
                 recompute_resolver, admission,
+                cancellation=cancellation,
                 **kwargs,
             )
     else:
@@ -207,6 +218,7 @@ def map_unordered(
                 executor_name,
                 recompute_resolver,
                 admission,
+                cancellation=cancellation,
                 **kwargs,
             )
 
@@ -228,6 +240,7 @@ def _map_unordered_batch(
     on_input_submit: Optional[Callable[[int], None]] = None,
     on_input_done: Optional[Callable[[int], None]] = None,
     completed_inputs: Optional[set] = None,
+    cancellation=None,
     **kwargs,
 ) -> None:
     metrics = get_registry()
@@ -375,6 +388,15 @@ def _map_unordered_batch(
     try:
         while pending or delayed or repairing or admit_queue or blocked:
             now = time.time()
+            # cooperative cancellation / deadline: the dispatch loop is
+            # the first enforcement point — stop submitting, cancel
+            # pending futures, raise the typed error (counted + recorded
+            # + fleet-broadcast via cancellation.abort)
+            if cancellation is not None and cancellation.cancelled:
+                from ..cancellation import abort as _cancel_abort
+
+                cancel_pending()
+                raise _cancel_abort(cancellation)
             # launch retries whose backoff has elapsed
             while delayed and delayed[0][0] <= now:
                 _, i = heapq.heappop(delayed)
@@ -427,6 +449,14 @@ def _map_unordered_batch(
                     )
                 continue
             timeout = 2.0
+            if cancellation is not None:
+                # notice a cancel/deadline within a fraction of a second,
+                # not a whole wait quantum (the 2s worker-abort bound);
+                # an armed deadline also never oversleeps its own expiry
+                timeout = 0.25
+                rem = cancellation.remaining()
+                if rem is not None:
+                    timeout = max(0.01, min(timeout, rem))
             if delayed:
                 timeout = max(0.01, min(timeout, delayed[0][0] - now))
             if repairing:
@@ -483,6 +513,29 @@ def _map_unordered_batch(
                         if not twins:
                             admit(i)
                         continue
+                    if cls is Classification.CANCELLED:
+                        # the task aborted because the COMPUTE was
+                        # cancelled (worker-side cooperative abort, or
+                        # the deadline fired in the task body): not a
+                        # task failure — abort the whole map with the
+                        # typed error, zero retries, zero budget draw
+                        cancel_pending()
+                        if cancellation is not None:
+                            from ..cancellation import abort as _cancel_abort
+
+                            raise _cancel_abort(cancellation) from exc
+                        from ..cancellation import (
+                            ComputeDeadlineExceededError,
+                        )
+
+                        metrics.counter(
+                            "deadline_aborts"
+                            if isinstance(exc, ComputeDeadlineExceededError)
+                            or getattr(exc, "remote_type", None)
+                            == "ComputeDeadlineExceededError"
+                            else "cancellations"
+                        ).inc()
+                        raise exc
                     attempts[i] += 1
                     if cls is Classification.RESOURCE:
                         # BEFORE twin suppression — memory pressure is
@@ -563,6 +616,15 @@ def _map_unordered_batch(
                             "loudly if the corruption cannot heal", i,
                         )
                     delay = policy.backoff_delay(attempts[i])
+                    if cls is Classification.THROTTLE:
+                        # a store throttle escaped the breaker's in-place
+                        # pacing (or the breaker is off): count it here —
+                        # the failing attempt's scope counters were
+                        # discarded with the attempt — and floor the
+                        # backoff so the retry doesn't hammer a store
+                        # that just said SlowDown
+                        metrics.counter("store_throttled").inc()
+                        delay = max(delay, 0.2)
                     logger.info(
                         "retrying input %s (attempt %d) in %.3fs",
                         i, attempts[i] + 1, delay,
@@ -665,6 +727,7 @@ class AsyncPythonDagExecutor(DagExecutor):
         compute_arrays_in_parallel: Optional[bool] = None,
         retry_policy: Optional[RetryPolicy] = None,
         journal=None,
+        cancellation=None,
         **kwargs,
     ) -> None:
         retries = self.retries if retries is None else retries
@@ -719,6 +782,7 @@ class AsyncPythonDagExecutor(DagExecutor):
                         dependencies=sched.dependencies,
                         on_input_submit=sched.on_submit,
                         on_input_done=sched.on_done,
+                        cancellation=cancellation,
                     )
                 finally:
                     sched.finish()
@@ -733,6 +797,7 @@ class AsyncPythonDagExecutor(DagExecutor):
                     self._run_tasks(
                         pool, merged, pipelines, policy, budget, use_backups,
                         batch_size, callbacks, resolver, admission,
+                        cancellation=cancellation,
                     )
                     end_generation(generation, callbacks)
             else:
@@ -757,6 +822,7 @@ class AsyncPythonDagExecutor(DagExecutor):
                         executor_name=self.name,
                         recompute_resolver=resolver,
                         admission=admission,
+                        cancellation=cancellation,
                         config=pipeline.config,
                     )
                     callbacks_on(

@@ -87,6 +87,12 @@ class FaultInjectedTaskError(FaultInjectedError, RuntimeError):
     """An injected task-body crash — classified transient."""
 
 
+class FaultInjectedThrottleError(FaultInjectedIOError):
+    """An injected store THROTTLE (the 429/503/"SlowDown" shape):
+    classified ``THROTTLE`` by the resilience layer, absorbed by the
+    per-store health breaker's paced in-place retries when it is on."""
+
+
 @dataclass(frozen=True)
 class FaultConfig:
     """What to break, how often. All rates are probabilities in [0, 1]."""
@@ -95,6 +101,11 @@ class FaultConfig:
     #: chunk read/write failure probability (inside task scopes only)
     storage_read_failure_rate: float = 0.0
     storage_write_failure_rate: float = 0.0
+    #: probability a chunk read/write is THROTTLED (429/503/SlowDown
+    #: shape) — the seeded store-brownout knob; decided per occurrence, so
+    #: a paced retry rolls fresh (modelling a store that answers once the
+    #: request rate drops)
+    storage_throttle_rate: float = 0.0
     #: a failed local write first leaves a partial .tmp file behind
     storage_write_leaves_tmp: bool = True
     #: probability a chunk write's bytes are silently corrupted in flight
@@ -197,6 +208,7 @@ class FaultConfig:
         return bool(
             self.storage_read_failure_rate
             or self.storage_write_failure_rate
+            or self.storage_throttle_rate
             or self.storage_corrupt_rate
             or self.task_failure_rate
             or self.straggler_rate
@@ -263,6 +275,27 @@ class FaultInjector:
         if current_scope() is None:
             return False
         return self._hit("storage_write", key, self.config.storage_write_failure_rate)
+
+    def storage_throttle_fault(self, key: str) -> bool:
+        """True -> the caller should raise FaultInjectedThrottleError (a
+        seeded store brownout). Task-scope-only like the other storage
+        sites, and CHUNK files only (digit-dotted names, like the
+        corruption knob): the brownout being modelled is chunk-IO
+        request pressure, and chunk IO is where the breaker's paced
+        in-place retries exist — throttling metadata/manifest IO would
+        measure unpaced side doors, not the breaker. Per-occurrence
+        rolls mean a paced retry usually succeeds — exactly how a real
+        throttling store behaves once the request rate drops."""
+        if self.config.storage_throttle_rate <= 0.0:
+            return False
+        if current_scope() is None:
+            return False
+        name = key.rsplit("/", 1)[-1]
+        if not all(p.lstrip("-").isdigit() for p in name.split(".")):
+            return False
+        return self._hit(
+            "storage_throttle", key, self.config.storage_throttle_rate
+        )
 
     def storage_corrupt_fault(self, key: str, data: bytes) -> Optional[bytes]:
         """Corrupted bytes for this chunk write, or None to write faithfully.

@@ -80,6 +80,17 @@ class Classification(enum.Enum):
     #: only after the admission controller steps concurrency down, and fail
     #: fast with an actionable error if it recurs at concurrency 1
     RESOURCE = "resource"
+    #: the STORE is browning out (HTTP 429/503/"SlowDown"-shaped errors):
+    #: retryable, but retrying harder at full concurrency is what keeps a
+    #: throttled store throttled — the per-store ``StoreHealthBreaker``
+    #: (storage/health.py) paces storage concurrency and absorbs most
+    #: throttles with in-place paced retries; the ones that still surface
+    #: here retry with a floored backoff, each drawing one budget unit
+    THROTTLE = "throttle"
+    #: the compute's cancellation token tripped (explicit cancel or
+    #: deadline, runtime/cancellation.py): not a failure at all — abort
+    #: immediately with the typed error, no retry, ZERO budget draw
+    CANCELLED = "cancelled"
 
 
 class RetryBudgetExceededError(RuntimeError):
@@ -178,10 +189,19 @@ class RetryPolicy:
         # that pure-local executors never need at import time
         from concurrent.futures import BrokenExecutor
 
+        from ..storage.health import is_throttle_error
         from ..storage.integrity import ChunkIntegrityError
+        from .cancellation import ComputeCancelledError
         from .distributed import RemoteTaskError, WorkerLostError
         from .memory import RESOURCE_TYPE_NAMES, MemoryGuardExceededError
 
+        if isinstance(exc, ComputeCancelledError) or getattr(
+            exc, "remote_type", None
+        ) in ("ComputeCancelledError", "ComputeDeadlineExceededError"):
+            # the compute was cancelled (or ran past its deadline): the
+            # abort is an instruction, not a failure — never retried,
+            # never drawing budget, locally or off the fleet wire
+            return Classification.CANCELLED
         if isinstance(exc, (MemoryError, MemoryGuardExceededError)):
             # the task ran out of memory (or the runtime guard caught it
             # about to): retrying at full concurrency recreates the
@@ -223,9 +243,17 @@ class RetryPolicy:
                 "ImportError", "ModuleNotFoundError"
             ):
                 return Classification.FAIL_FAST
+            if is_throttle_error(exc):
+                # a worker-side store throttle crossing the wire (type
+                # name or 429/503/SlowDown-shaped text)
+                return Classification.THROTTLE
             return Classification.RETRY
         if _fail_fast_by_mro(exc):
             return Classification.FAIL_FAST
+        if is_throttle_error(exc):
+            # the store is browning out: retryable, but the breaker (not
+            # blind concurrency) is the cure — see Classification.THROTTLE
+            return Classification.THROTTLE
         # everything else — OSError and friends, TimeoutError,
         # TaskTimeoutError, BrokenProcessPool, plain RuntimeError from user
         # code — is worth another attempt

@@ -53,8 +53,10 @@ already exists, without touching any durability guarantee:
 
 Activation mirrors the integrity/memory-guard layers: the
 ``CUBED_TPU_P2P`` env var (operator override) > ``Spec(peer_transfer=...)``
-> ``DistributedDagExecutor(peer_transfer=...)`` > off. The client's
-resolved config rides every task message (``wire_config`` /
+> ``DistributedDagExecutor(peer_transfer=...)`` > **ON** (the fleet
+default — store-only is the explicit escape hatch, ``CUBED_TPU_P2P=off``
+disabling the data plane fleet-wide including the worker-side server).
+The client's resolved config rides every task message (``wire_config`` /
 ``arm_from_wire``) so pre-started fleets mirror the client per compute.
 
 Chaos knobs (``runtime/faults.py``): seeded ``peer_drop_rate`` /
@@ -156,7 +158,14 @@ def env_disabled() -> bool:
 
 
 def resolve_peer_transfer(spec=None, default: Optional[bool] = None) -> bool:
-    """The effective client-side enablement (env > Spec > executor > off)."""
+    """The effective client-side enablement (env > Spec > executor > ON).
+
+    Peer transfer is the fleet DEFAULT: it is chaos-proven (every defect
+    falls back to the store read, drawing zero retry budget) and saves
+    the overwhelming majority of store read bytes, so store-only is now
+    the escape hatch — ``CUBED_TPU_P2P=off`` (operator-wide),
+    ``Spec(peer_transfer=False)``, or
+    ``DistributedDagExecutor(peer_transfer=False)``."""
     raw = os.environ.get(P2P_ENV_VAR)
     if raw:
         return raw.strip().lower() not in _OFF_VALUES
@@ -165,7 +174,7 @@ def resolve_peer_transfer(spec=None, default: Optional[bool] = None) -> bool:
         return bool(s)
     if default is not None:
         return bool(default)
-    return False
+    return True
 
 
 class client_scoped:

@@ -48,6 +48,7 @@ def execute_with_stats(function, *args, **kwargs):
     under ``memory_guard="enforce"``, failing the task with a picklable
     ``MemoryGuardExceededError`` when it exceeds ``allowed_mem``.
     """
+    from .cancellation import check_current
     from .faults import get_injector
     from .memory import task_guard
 
@@ -55,6 +56,11 @@ def execute_with_stats(function, *args, **kwargs):
     start = None
     try:
         with task_scope() as scope:
+            # cooperative cancellation: a tripped token (deadline or
+            # explicit cancel, mirrored off the task message on fleet
+            # workers) aborts BEFORE the body runs; the storage layer
+            # re-checks between chunk reads/writes inside the body
+            check_current()
             injector = get_injector()
             key = chunk_key(args[0]) if args else ""
             # blockwise mappable items are (out_name, i, j, ...) tuples: the

@@ -68,6 +68,7 @@ class TenantRequestJournal:
 
     def record_accepted(
         self, request_id: str, array, fingerprint: Optional[str] = None,
+        deadline_epoch: Optional[float] = None,
     ) -> bool:
         """Persist the payload, then the fsync'd ``accepted`` record.
 
@@ -101,6 +102,10 @@ class TenantRequestJournal:
             request_id=request_id,
             tenant=self.tenant,
             fingerprint=fingerprint,
+            # the request's end-to-end SLO is part of the durable
+            # contract: a recovered request keeps its ABSOLUTE deadline
+            # (and fails at admission if it passed during the outage)
+            deadline_epoch=deadline_epoch,
             payload=payload,
             journal=os.path.basename(
                 self.compute_journal_path(request_id)
@@ -196,6 +201,7 @@ def load_requests(service_dir: str) -> Dict[str, List[dict]]:
                 "request_id": rid,
                 "tenant": tenant,
                 "fingerprint": rec.get("fingerprint"),
+                "deadline_epoch": rec.get("deadline_epoch"),
                 "payload_path": payload_path,
                 "compute_journal": cj if os.path.isfile(cj) else None,
             })

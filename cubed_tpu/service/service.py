@@ -96,6 +96,13 @@ class RequestCancelledError(RuntimeError):
     """``result()`` was called on a cancelled request."""
 
 
+class _RequeueRequest(Exception):
+    """Internal: a coalesced follower's leader was cancelled — the
+    follower must go back through admission (re-entering inline would
+    run a full compute without holding an admission slot, since a
+    parked follower hands its slot back)."""
+
+
 def _env_bool(var: str) -> Optional[bool]:
     raw = os.environ.get(var)
     if raw is None:
@@ -269,8 +276,12 @@ class RequestHandle:
         return req.value
 
     def cancel(self) -> bool:
-        """Cancel a still-queued request (a running compute is not torn
-        down mid-flight). True when the cancel took effect."""
+        """Cancel the request. A still-QUEUED request completes CANCELLED
+        immediately; a RUNNING one has its cancellation token tripped —
+        the fleet is told (``compute_cancel`` broadcast), workers abort
+        cooperatively at their next chunk boundary, and the request
+        completes CANCELLED (sealed durably) within seconds. False only
+        for requests that already finished."""
         return self._request.service._cancel(self._request)
 
     def __repr__(self) -> str:
@@ -288,7 +299,8 @@ class _Request:
         "value", "error", "submitted_at", "started_at", "ended_at",
         "plan_cache_hit", "result_cache_hit", "recovered",
         "resume_journal", "durable", "compute_id", "coalesced_into",
-        "fingerprint", "canonical", "cost",
+        "fingerprint", "canonical", "cost", "deadline_epoch", "token",
+        "cancel_requested",
     )
 
     def __init__(self, service: "ComputeService", tenant: str, array,
@@ -318,6 +330,16 @@ class _Request:
         #: what this request's execution consumed (``_CostTracker``;
         #: None until it runs — cache hits keep it None = zero cost)
         self.cost: Optional[dict] = None
+        #: end-to-end deadline (absolute epoch; queue wait counts — the
+        #: contract is "an answer by T", not "T seconds of fleet time")
+        self.deadline_epoch: Optional[float] = None
+        #: the per-request CancellationToken, minted when the request
+        #: starts running (RequestHandle.cancel trips it; close() trips
+        #: every running one so shutdown is bounded)
+        self.token = None
+        #: True when the client asked for the cancel (distinguishes a
+        #: CANCELLED outcome from a deadline FAILURE in _run_request)
+        self.cancel_requested = False
 
 
 class _ComputeIdCallback:
@@ -501,10 +523,15 @@ class ComputeService:
     def close(self, timeout: float = 30.0) -> None:
         """Stop admitting; wait for running computes; seal the journals.
 
-        Queued requests complete their handles as CANCELLED so no client
-        blocks forever in ``result()`` — durable ones keep their accepted
+        Shutdown is BOUNDED: a running compute gets the timeout window to
+        finish, after which its cancellation token is tripped (reaching
+        fleet workers via the ``compute_cancel`` broadcast) — a wedged or
+        browned-out compute can no longer block close() forever. Queued
+        requests complete their handles as CANCELLED so no client blocks
+        forever in ``result()`` — durable ones keep their accepted
         journal record (NOT sealed), so a restarted service on the same
-        ``service_dir`` still recovers and runs them."""
+        ``service_dir`` still recovers and runs them; a RUNNING request
+        cancelled by shutdown keeps its record unsealed the same way."""
         self._closed.set()
         with self._work:
             self._work.notify_all()
@@ -514,6 +541,22 @@ class ComputeService:
         deadline = time.monotonic() + timeout
         for t in list(self._threads):
             t.join(timeout=max(0.1, deadline - time.monotonic()))
+        lingering = [t for t in self._threads if t.is_alive()]
+        if lingering:
+            # the timeout is spent and computes still run: route shutdown
+            # through the cancellation tokens so it stays bounded
+            with self._lock:
+                running = list(self._running.values())
+            for r in running:
+                token = r.token
+                if token is not None:
+                    token.cancel("service shutdown")
+            # ONE shared grace window for the whole pass (like the first
+            # join loop): N wedged computes must not serialize into
+            # N x 15s of shutdown
+            grace = time.monotonic() + 15.0
+            for t in lingering:
+                t.join(timeout=max(0.1, grace - time.monotonic()))
         stranded = []
         with self._work:
             for q in self._queues.values():
@@ -541,12 +584,21 @@ class ComputeService:
 
     # -- submission ----------------------------------------------------
 
-    def submit(self, array, tenant: str = "default") -> RequestHandle:
+    def submit(
+        self, array, tenant: str = "default",
+        deadline_s: Optional[float] = None,
+    ) -> RequestHandle:
         """Accept one compute for ``tenant``; returns immediately.
 
         Durable when a service_dir is armed (payload + fsync'd accepted
         record before return). Raises :class:`TenantThrottledError` past
-        the tenant's queued-request bound."""
+        the tenant's queued-request bound.
+
+        ``deadline_s`` is an END-TO-END deadline from this submission
+        (queue wait included): past it the request fails with
+        ``ComputeDeadlineExceededError`` — queued requests fail at
+        admission, running computes abort cooperatively (fleet workers
+        included) within about a task of the deadline."""
         if self._closed.is_set():
             raise RuntimeError("service is closed")
         if not self._started:
@@ -577,6 +629,8 @@ class ComputeService:
                 )
             self._reserved[tenant] = reserved + 1
         req = _Request(self, tenant, array)
+        if deadline_s is not None:
+            req.deadline_epoch = time.time() + float(deadline_s)
         enqueue = True
         try:
             if self.config.service_dir:
@@ -590,7 +644,8 @@ class ComputeService:
                         array.plan.dag
                     )
                 req.durable = journal.record_accepted(
-                    req.request_id, array, fingerprint=req.fingerprint
+                    req.request_id, array, fingerprint=req.fingerprint,
+                    deadline_epoch=req.deadline_epoch,
                 )
         except BaseException:
             enqueue = False  # never hand the queue a request the caller
@@ -648,6 +703,11 @@ class ComputeService:
                 req = _Request(self, tenant, array, request_id=rid)
                 req.durable = True
                 req.recovered = True
+                # the end-to-end SLO survives recovery: the ABSOLUTE
+                # deadline is restored, so a request whose deadline
+                # passed during the outage fails at admission with the
+                # typed error instead of running unbounded
+                req.deadline_epoch = rec.get("deadline_epoch")
                 req.resume_journal = rec["compute_journal"]
                 with self._work:
                     stats = self._ensure_tenant_locked(tenant)
@@ -722,25 +782,78 @@ class ComputeService:
     # -- execution -----------------------------------------------------
 
     def _run_request(self, req: _Request) -> None:
+        from ..runtime.cancellation import (
+            CancellationToken,
+            ComputeCancelledError,
+            ComputeDeadlineExceededError,
+        )
+
         reg = get_registry()
+        # the request's time bound becomes a real CancellationToken the
+        # moment it runs: Plan.execute threads it through the dispatch
+        # loop, the fleet wire, and the chunk-IO checks — so cancel()
+        # reaches RUNNING computes and the deadline is enforced end to end
+        # compute_id left unset: Plan.execute registers the token under
+        # the compute id it mints, which is the id the fleet wire and the
+        # worker-side lookups key on
+        req.token = CancellationToken(deadline_epoch=req.deadline_epoch)
+        if req.cancel_requested:
+            req.token.cancel("client cancel")
         try:
+            req.token.check()  # expired while queued: fail at admission
             value = self._execute(req)
-            with self._lock:
-                stats = self._ensure_tenant_locked(req.tenant)
-                stats.completed += 1
-                if req.plan_cache_hit:
-                    stats.plan_cache_hits += 1
-                if req.result_cache_hit:
-                    stats.result_cache_hits += 1
-            reg.counter("service_requests_completed").inc()
-            if not req.result_cache_hit:
-                # only a request that actually EXECUTED is evidence the
-                # fleet can take more load: cache hits and coalesced
-                # followers never touched it, and letting them advance
-                # the AIMD restore streak would re-trigger the pressure
-                # the step-down just relieved
-                self.admission.on_success()
-            self._finish(req, DONE, value=value)
+        except _RequeueRequest:
+            # a coalesced follower whose leader was cancelled: back onto
+            # the tenant queue for a fresh admission slot (the handle
+            # stays live — nothing is finished here)
+            with self._work:
+                if not self._closed.is_set():
+                    req.state = QUEUED
+                    req.started_at = None
+                    self._queues.setdefault(req.tenant, deque()).append(req)
+                    self._work.notify_all()
+                    requeued = True
+                else:
+                    requeued = False
+            if not requeued:
+                # shutdown raced the requeue: complete the handle so no
+                # client blocks forever; durable records stay unsealed
+                with self._lock:
+                    self._ensure_tenant_locked(req.tenant).cancelled += 1
+                self._finish(req, CANCELLED, seal=False)
+            return
+        except ComputeCancelledError as e:
+            if isinstance(e, ComputeDeadlineExceededError) and not (
+                req.cancel_requested
+            ):
+                # the SLO fired, the client didn't ask: that is a FAILED
+                # request carrying the typed error (result() raises it)
+                with self._lock:
+                    self._ensure_tenant_locked(req.tenant).failed += 1
+                reg.counter("service_requests_failed").inc()
+                record_decision(
+                    "service_request_failed", tenant=req.tenant,
+                    request=req.request_id, error=type(e).__name__,
+                )
+                self._finish(req, FAILED, error=e)
+            else:
+                # a client cancel (or shutdown) that reached a RUNNING
+                # compute: CANCELLED, sealed durably so recovery never
+                # resurrects it
+                with self._lock:
+                    self._ensure_tenant_locked(req.tenant).cancelled += 1
+                reg.counter("service_requests_cancelled").inc()
+                record_decision(
+                    "service_cancelled", tenant=req.tenant,
+                    request=req.request_id, running=True,
+                )
+                # a CLIENT cancel is sealed durably (recovery must not
+                # resurrect it); a shutdown cancel leaves the durable
+                # accepted record unsealed so the next service on this
+                # service_dir recovers and finishes the work — resuming
+                # from the journal ∩ integrity frontier, so everything
+                # completed before the abort is kept
+                self._finish(req, CANCELLED, seal=req.cancel_requested)
         except BaseException as e:  # noqa: BLE001 — reported to the handle
             with self._lock:
                 self._ensure_tenant_locked(req.tenant).failed += 1
@@ -757,6 +870,23 @@ class ComputeService:
                 request=req.request_id, error=type(e).__name__,
             )
             self._finish(req, FAILED, error=e)
+        else:
+            with self._lock:
+                stats = self._ensure_tenant_locked(req.tenant)
+                stats.completed += 1
+                if req.plan_cache_hit:
+                    stats.plan_cache_hits += 1
+                if req.result_cache_hit:
+                    stats.result_cache_hits += 1
+            reg.counter("service_requests_completed").inc()
+            if not req.result_cache_hit:
+                # only a request that actually EXECUTED is evidence the
+                # fleet can take more load: cache hits and coalesced
+                # followers never touched it, and letting them advance
+                # the AIMD restore streak would re-trigger the pressure
+                # the step-down just relieved
+                self.admission.on_success()
+            self._finish(req, DONE, value=value)
         finally:
             with self._work:
                 self._running.pop(req.request_id, None)
@@ -817,9 +947,41 @@ class ComputeService:
                     # it waits on the leader
                     self._running.pop(req.request_id, None)
                     self._work.notify_all()
-                leader.event.wait()
+                # a parked follower is still cancellable (and still has a
+                # deadline): poll its own token while waiting — the
+                # leader's execution is untouched either way
+                while not leader.event.wait(timeout=0.2):
+                    if req.token is not None:
+                        req.token.check()
                 if leader.error is not None:
+                    from ..runtime.cancellation import (
+                        ComputeCancelledError as _Cancelled,
+                    )
+
+                    if isinstance(leader.error, _Cancelled) and not (
+                        self._closed.is_set()
+                    ):
+                        # the leader's own deadline/cancel is the
+                        # LEADER's time bound, not this follower's:
+                        # go back through admission and run under our
+                        # own token (unless the service is shutting
+                        # down — then the cancel is ours too)
+                        req.coalesced_into = None
+                        raise _RequeueRequest()
                     raise leader.error
+                if leader.state != DONE:
+                    if self._closed.is_set() and req.token is not None:
+                        req.token.cancel("service shutdown")
+                        req.token.check()
+                    # the LEADER was cancelled (its CANCELLED completion
+                    # carries no error and no value): this follower never
+                    # asked to be cancelled, so it must not inherit the
+                    # abort — and certainly not the leader's None value.
+                    # Back through admission (the parked follower handed
+                    # its slot away; re-entering inline would exceed the
+                    # service's concurrency bound)
+                    req.coalesced_into = None
+                    raise _RequeueRequest()
                 req.result_cache_hit = True
                 return np.array(leader.value, copy=True)
         try:
@@ -915,6 +1077,8 @@ class ComputeService:
             # accepted before the crash but never journaled a task:
             # integrity-verified chunks (if any) still skip
             kwargs["resume"] = True
+        if req.token is not None:
+            kwargs["cancellation"] = req.token
         try:
             plan.execute(
                 executor=self.executor,
@@ -978,16 +1142,31 @@ class ComputeService:
 
     def _cancel(self, req: _Request) -> bool:
         with self._work:
+            if req.event.is_set():
+                return False  # already finished: nothing to cancel
             q = self._queues.get(req.tenant)
-            if req.state != QUEUED or q is None or req not in q:
-                return False
-            q.remove(req)
-            self._ensure_tenant_locked(req.tenant).cancelled += 1
-        get_registry().counter("service_requests_cancelled").inc()
-        record_decision(
-            "service_cancelled", tenant=req.tenant, request=req.request_id,
-        )
-        self._finish(req, CANCELLED)
+            if req.state == QUEUED and q is not None and req in q:
+                q.remove(req)
+                self._ensure_tenant_locked(req.tenant).cancelled += 1
+                queued = True
+            else:
+                # RUNNING (or racing dispatch): trip the token — the
+                # compute aborts cooperatively (dispatch loop + fleet
+                # broadcast + worker chunk-IO checks) and _run_request
+                # completes the handle CANCELLED, sealing it durably
+                req.cancel_requested = True
+                token = req.token
+                queued = False
+        if queued:
+            get_registry().counter("service_requests_cancelled").inc()
+            record_decision(
+                "service_cancelled", tenant=req.tenant,
+                request=req.request_id,
+            )
+            self._finish(req, CANCELLED)
+            return True
+        if token is not None:
+            token.cancel("client cancel")
         return True
 
     # -- helpers -------------------------------------------------------

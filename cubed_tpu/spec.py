@@ -215,7 +215,8 @@ class Spec:
         peer death, or checksum mismatch — the store stays write-through
         and remains the sole durable tier, so resume/journal/integrity
         guarantees are untouched. ``None`` defers to the ``CUBED_TPU_P2P``
-        env var (operator override, wins) or the store-only default
+        env var (operator override, wins) or the ON default — ``False``
+        (or ``CUBED_TPU_P2P=off``) is the store-only escape hatch
         (runtime/transfer.py)."""
         return self._peer_transfer
 

@@ -19,6 +19,7 @@ Reference parity: the role of the zarr-python dependency in cubed
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -38,12 +39,17 @@ from ..observability.accounting import (
     scope_span,
 )
 from ..observability.metrics import get_registry
+from ..runtime import cancellation
 from ..runtime import transfer as p2p
-from ..runtime.faults import FaultInjectedIOError, get_injector
+from ..runtime.faults import (
+    FaultInjectedIOError,
+    FaultInjectedThrottleError,
+    get_injector,
+)
 from ..runtime.shuffle import byte_ranges, chunk_key_str
 from ..runtime.resilience import RetryPolicy
 from ..utils import join_path
-from . import integrity
+from . import health, integrity
 from .integrity import ChunkIntegrityError
 
 logger = logging.getLogger(__name__)
@@ -99,10 +105,13 @@ class _LocalIO:
 
     def read_bytes(self, name: str) -> bytes:
         injector = get_injector()
-        if injector is not None and injector.storage_read_fault(
-            _fault_key(self.root, name)
-        ):
-            raise FaultInjectedIOError(f"injected read failure: {name}")
+        if injector is not None:
+            if injector.storage_throttle_fault(_fault_key(self.root, name)):
+                raise FaultInjectedThrottleError(
+                    f"injected store throttle (503 SlowDown): {name}"
+                )
+            if injector.storage_read_fault(_fault_key(self.root, name)):
+                raise FaultInjectedIOError(f"injected read failure: {name}")
         with open(os.path.join(self.root, name), "rb") as f:
             return f.read()
 
@@ -110,6 +119,13 @@ class _LocalIO:
         path = os.path.join(self.root, name)
         tmp = path + f".{uuid.uuid4().hex[:8]}.tmp"
         injector = get_injector() if inject else None
+        if injector is not None and injector.storage_throttle_fault(
+            _fault_key(self.root, name)
+        ):
+            # a throttled PUT touches nothing: the request was refused
+            raise FaultInjectedThrottleError(
+                f"injected store throttle (503 SlowDown): {name}"
+            )
         if injector is not None and injector.storage_write_fault(
             _fault_key(self.root, name)
         ):
@@ -220,15 +236,24 @@ class _FsspecIO:
 
     def read_bytes(self, name: str) -> bytes:
         injector = get_injector()
-        if injector is not None and injector.storage_read_fault(
-            _fault_key(self.root, name)
-        ):
-            raise FaultInjectedIOError(f"injected read failure: {name}")
+        if injector is not None:
+            if injector.storage_throttle_fault(_fault_key(self.root, name)):
+                raise FaultInjectedThrottleError(
+                    f"injected store throttle (503 SlowDown): {name}"
+                )
+            if injector.storage_read_fault(_fault_key(self.root, name)):
+                raise FaultInjectedIOError(f"injected read failure: {name}")
         with self.fs.open(f"{self.root}/{name}", "rb") as f:
             return f.read()
 
     def write_bytes_atomic(self, name: str, data: bytes, inject: bool = True) -> None:
         injector = get_injector() if inject else None
+        if injector is not None and injector.storage_throttle_fault(
+            _fault_key(self.root, name)
+        ):
+            raise FaultInjectedThrottleError(
+                f"injected store throttle (503 SlowDown): {name}"
+            )
         if injector is not None and injector.storage_write_fault(
             _fault_key(self.root, name)
         ):
@@ -257,6 +282,50 @@ class _FsspecIO:
         """Object-store writes are whole-object PUTs — no temp files to
         sweep (a crashed PUT leaves nothing)."""
         return 0
+
+
+def _active_breaker(store: str):
+    """The store's health breaker, or None when the breaker is disabled
+    (``CUBED_TPU_STORE_BREAKER=off``)."""
+    return health.store_breaker(store) if health.breaker_enabled() else None
+
+
+@contextlib.contextmanager
+def _breaker_slot(breaker, key: str):
+    """Take (and release) the breaker's IO slot around ONE IO attempt —
+    callers keep retry sleeps OUTSIDE the slot so a paced holder never
+    idles the store's whole concurrency allowance. While the breaker is
+    degraded, the wait for a slot — the whole point of AIMD pacing — is
+    recorded as a ``throttle_wait`` span so ``analyze()`` attributes
+    brownout time honestly. ``breaker=None`` (disabled) is a no-op."""
+    if breaker is None:
+        yield
+        return
+    if breaker.state == "closed":
+        breaker.acquire()  # counter bump, no wait possible
+    else:
+        with scope_span(
+            "throttle_wait", cat="throttle", site="breaker_slot", key=key
+        ):
+            # poll the cancellation token between wait quanta: a
+            # cancelled/deadlined compute escapes a degraded store's
+            # slot queue immediately instead of serving out the wait
+            breaker.acquire(poll=cancellation.check_current)
+    try:
+        yield
+    finally:
+        breaker.release()
+
+
+def _note_throttle(store: str, breaker) -> float:
+    """Shared throttle accounting: counts ``store_throttled`` (a scoped
+    counter, so fleet-worker throttles ride task stats back to the client
+    registry) and steps the breaker down, returning its paced retry
+    delay (a deterministic floor when the breaker is off)."""
+    record_scoped_counter("store_throttled")
+    if breaker is not None:
+        return breaker.on_throttle()
+    return 0.0
 
 
 def _fault_key(root: str, name: str) -> str:
@@ -461,6 +530,9 @@ class ZarrV2Array:
         peer for this chunk, so one logical read never draws the fault
         injector or counts a miss twice."""
         key = self._chunk_key(idx)
+        # cooperative cancellation: between chunk reads is a safe abort
+        # boundary — nothing half-written, resume is bitwise-correct
+        cancellation.check_current()
         verify = integrity.verify_reads_active()
         if allow_peer and p2p.task_fetch_active():
             # peer-fetch fast path (fleet workers, Spec/executor-armed):
@@ -641,11 +713,50 @@ class ZarrV2Array:
         compute with wrong results.
         """
         policy = _read_retry_policy()
+        breaker = _active_breaker(self.store)
         failures = 0
+        throttles = 0
         while True:
             try:
-                return self._io.read_bytes(key)
+                # the breaker slot covers only the IO attempt itself —
+                # retry sleeps below run with the slot RELEASED, so a
+                # paced holder never idles the store's whole allowance
+                with _breaker_slot(breaker, key):
+                    data = self._io.read_bytes(key)
+                if breaker is not None:
+                    breaker.on_success()
+                return data
             except OSError as exc:
+                if health.is_throttle_error(exc):
+                    # the store is browning out (429/503/SlowDown):
+                    # retry IN PLACE with breaker pacing — slowing
+                    # down is the cure, and an absorbed throttle
+                    # draws nothing from the task-retry budget. With
+                    # the breaker off (or pacing exhausted) the
+                    # throttle surfaces to the task level, classified
+                    # THROTTLE
+                    throttles += 1
+                    delay = _note_throttle(self.store, breaker)
+                    if (
+                        breaker is None
+                        or throttles > health.THROTTLE_IO_RETRIES
+                    ):
+                        raise
+                    logger.info(
+                        "store %s throttled read %s (throttle %d); "
+                        "paced in-place retry in %.3fs",
+                        self.store, key, throttles, delay,
+                    )
+                    if delay > 0:
+                        with scope_span(
+                            "throttle_wait", cat="throttle",
+                            site="storage_read", key=key,
+                        ):
+                            time.sleep(delay)
+                    # a cancel/deadline that landed during the paced
+                    # sleep aborts here instead of retrying the store
+                    cancellation.check_current()
+                    continue
                 failures += 1
                 if failures > policy.retries:
                     raise
@@ -663,6 +774,10 @@ class ZarrV2Array:
                         time.sleep(delay)
 
     def _write_chunk(self, idx: tuple[int, ...], arr: np.ndarray) -> None:
+        # cooperative cancellation: checked BEFORE the write starts — an
+        # abort never interrupts an atomic chunk write mid-flight, so the
+        # store/manifest/journal stay consistent for resume
+        cancellation.check_current()
         arr = np.ascontiguousarray(arr, dtype=self.dtype)
         data = arr.tobytes()
         if self._codec is not None:
@@ -671,7 +786,7 @@ class ZarrV2Array:
         with scope_span(
             "storage_write", cat="storage", key=key, bytes=len(data)
         ):
-            self._io.write_bytes_atomic(key, data)
+            self._write_bytes_throttle_paced(key, data)
             if integrity.current_mode() != "off":
                 # recorded AFTER the chunk write succeeds: a crash between
                 # the two leaves a chunk without an entry, which resume
@@ -692,6 +807,47 @@ class ZarrV2Array:
                 # they cannot verify against the manifest
                 p2p.note_chunk_written(self.store, key, data)
         record_bytes_written(self.store, len(data))
+
+    def _write_bytes_throttle_paced(self, key: str, data: bytes) -> None:
+        """Atomic chunk write with breaker-paced in-place retries for
+        THROTTLE-shaped failures only (whole-chunk writes are idempotent,
+        so an in-place retry after a refused PUT is always safe). Plain
+        transient write failures keep their historical behavior: raise to
+        the task level, where the retry machinery re-runs the task."""
+        breaker = _active_breaker(self.store)
+        throttles = 0
+        while True:
+            try:
+                with _breaker_slot(breaker, key):
+                    self._io.write_bytes_atomic(key, data)
+                if breaker is not None:
+                    breaker.on_success()
+                return
+            except OSError as exc:
+                if not health.is_throttle_error(exc):
+                    raise
+                throttles += 1
+                delay = _note_throttle(self.store, breaker)
+                if (
+                    breaker is None
+                    or throttles > health.THROTTLE_IO_RETRIES
+                ):
+                    raise
+                logger.info(
+                    "store %s throttled write %s (throttle %d); "
+                    "paced in-place retry in %.3fs",
+                    self.store, key, throttles, delay,
+                )
+                if delay > 0:
+                    with scope_span(
+                        "throttle_wait", cat="throttle",
+                        site="storage_write", key=key,
+                    ):
+                        time.sleep(delay)
+                # a cancel/deadline that landed during the paced sleep
+                # aborts here (the chunk write never started: atomic
+                # writes are all-or-nothing, so state stays consistent)
+                cancellation.check_current()
 
     def _empty_chunk(self) -> np.ndarray:
         fill = self.fill_value if self.fill_value is not None else 0

@@ -108,6 +108,18 @@ def render(snapshot: dict, width: int = 100) -> str:
     )
     out.append("=" * width)
 
+    # -- deadlines / cancellation / store health -----------------------
+    breaker = {0: "closed", 1: "half-open", 2: "OPEN"}.get(
+        metrics.get("store_breaker_state"), "closed"
+    )
+    out.append(
+        f"TIME & STORE  cancellations {metrics.get('cancellations', 0)}  "
+        f"deadline_aborts {metrics.get('deadline_aborts', 0)}  "
+        f"store_throttled {metrics.get('store_throttled', 0)}  "
+        f"breaker {breaker}"
+    )
+    out.append("")
+
     # -- fleet table ---------------------------------------------------
     workers = (fleet.get("workers") or {})
     out.append(

@@ -102,8 +102,11 @@ def test_explain_reports_scheduler_and_rechunk_chunked(tmp_path):
     assert d["barriers"]["ops"] == []
     assert sum(r["shuffle_bytes"] for r in rechunk_rows) > 0
     assert d["totals"]["predicted_shuffle_bytes"] > 0
-    # without the peer plane armed the prediction reads zero (store path)
-    store_only = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+    # with the peer plane explicitly disabled (store-only is the escape
+    # hatch now that p2p defaults on) the prediction reads zero
+    store_only = ct.Spec(
+        work_dir=str(tmp_path), allowed_mem="500MB", peer_transfer=False
+    )
     off = r.explain(spec=store_only, optimize_graph=False).to_dict()
     assert off["totals"]["predicted_shuffle_bytes"] == 0
 

@@ -135,7 +135,10 @@ def test_trace_and_stats_distributed_executor(spec, tmp_path):
     target, expected = _two_op_pipeline(spec)
     trace_path = str(tmp_path / "trace.json")
     cb = TracingCallback(trace_path=trace_path)
-    with DistributedDagExecutor(n_local_workers=2) as ex:
+    # store-only: this test asserts STORE byte counters, and with the
+    # default-on peer data plane the second op's reads are served from
+    # the producing worker's cache (zero store reads — the flip working)
+    with DistributedDagExecutor(n_local_workers=2, peer_transfer=False) as ex:
         result = target.compute(
             callbacks=[cb], executor=ex, optimize_graph=False
         )

@@ -1,0 +1,333 @@
+"""Deadlines & cooperative cancellation: the typed abort reaches every
+executor within its grace, cancelled fleets stop within seconds, and a
+cancelled journal resumes bitwise-correct.
+
+Seeded stragglers make the computes slow enough to abort mid-flight;
+marked ``chaos`` (tier-1, deterministic)."""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime import cancellation as cancel_mod
+from cubed_tpu.runtime.cancellation import (
+    CancellationToken,
+    ComputeCancelledError,
+    ComputeDeadlineExceededError,
+)
+from cubed_tpu.runtime.executors.python import PythonDagExecutor
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+from cubed_tpu.runtime.resilience import Classification, RetryPolicy
+
+pytestmark = pytest.mark.chaos
+
+#: every task sleeps this long: slow enough to cancel mid-compute, fast
+#: enough that "deadline + one task grace" stays a tight test bound
+SLOW = dict(seed=5, straggler_rate=1.0, straggler_delay_s=0.3)
+
+
+def _slow_spec(tmp_path, **overrides):
+    cfg = dict(SLOW)
+    cfg.update(overrides)
+    return ct.Spec(
+        work_dir=str(tmp_path), allowed_mem="500MB", fault_injection=cfg
+    )
+
+
+class _StatsCapture:
+    stats: dict = {}
+
+    def on_compute_end(self, event):
+        self.stats = event.executor_stats or {}
+
+
+# -- token units ---------------------------------------------------------
+
+
+def test_token_deadline_expiry_and_remaining():
+    tok = CancellationToken(deadline_s=0.15)
+    assert not tok.cancelled
+    assert 0 < tok.remaining() <= 0.15
+    time.sleep(0.2)
+    assert tok.expired and tok.cancelled
+    with pytest.raises(ComputeDeadlineExceededError):
+        tok.check()
+
+
+def test_token_tightens_never_loosens_deadline():
+    tok = CancellationToken(deadline_s=100.0)
+    tok.set_deadline(0.05)
+    assert tok.remaining() <= 0.05
+    tok.set_deadline(500.0)  # later deadline must not loosen the armed one
+    assert tok.remaining() <= 0.06
+
+
+def test_token_explicit_cancel_fires_callbacks_once():
+    tok = CancellationToken()
+    fired = []
+    tok.on_abort(lambda: fired.append(1))
+    tok.cancel("test")
+    tok.cancel("again")
+    tok.notify_abort()
+    assert fired == [1]
+    with pytest.raises(ComputeCancelledError) as ei:
+        tok.check()
+    assert not isinstance(ei.value, ComputeDeadlineExceededError)
+    # a late-registered callback on a tripped token fires immediately
+    tok.on_abort(lambda: fired.append(2))
+    assert fired == [1, 2]
+
+
+def test_explicit_cancel_wins_over_later_expiry():
+    # cancel() lands BEFORE the deadline passes; the dispatch loop only
+    # observes after expiry — the error must still say "cancelled", not
+    # report a phantom SLO violation
+    tok = CancellationToken(deadline_s=0.1)
+    tok.cancel("operator asked")
+    time.sleep(0.15)  # now ALSO expired
+    err = tok.error()
+    assert isinstance(err, ComputeCancelledError)
+    assert not isinstance(err, ComputeDeadlineExceededError)
+
+
+def test_check_current_ignores_env_compute_id(monkeypatch):
+    # the env export is last-writer-wins across concurrent computes: a
+    # pool task thread (no contextvar) must NOT resolve another
+    # compute's token through it and abort the wrong compute
+    from cubed_tpu.observability import logs as obs_logs
+
+    tok = CancellationToken()
+    cancel_mod.register_compute("c-env-leak", tok)
+    try:
+        tok.cancel("other tenant's cancel")
+        monkeypatch.setenv(obs_logs.COMPUTE_ID_ENV_VAR, "c-env-leak")
+        assert obs_logs.compute_id_var.get() is None
+        cancel_mod.check_current()  # must not raise
+        # with the contextvar actually bound, the check applies
+        token_ctx = obs_logs.compute_id_var.set("c-env-leak")
+        try:
+            with pytest.raises(ComputeCancelledError):
+                cancel_mod.check_current()
+        finally:
+            obs_logs.compute_id_var.reset(token_ctx)
+    finally:
+        cancel_mod.unregister_compute("c-env-leak")
+
+
+def test_errors_picklable_and_typed():
+    for cls in (ComputeCancelledError, ComputeDeadlineExceededError):
+        e = pickle.loads(pickle.dumps(cls("m", compute_id="c9", reason="r")))
+        assert isinstance(e, cls)
+        assert e.compute_id == "c9" and e.reason == "r"
+    assert issubclass(ComputeDeadlineExceededError, ComputeCancelledError)
+
+
+def test_classification_cancelled_locally_and_across_the_wire():
+    from cubed_tpu.runtime.distributed import RemoteTaskError
+
+    policy = RetryPolicy()
+    assert policy.classify(ComputeCancelledError("x")) is (
+        Classification.CANCELLED
+    )
+    assert policy.classify(ComputeDeadlineExceededError("x")) is (
+        Classification.CANCELLED
+    )
+    remote = RemoteTaskError(
+        "task failed remotely", remote_type="ComputeDeadlineExceededError"
+    )
+    assert policy.classify(remote) is Classification.CANCELLED
+
+
+def test_wire_roundtrip_and_cancel_frame_race():
+    # a compute_cancel frame arriving BEFORE the compute's first task
+    # message must still stick when the token is armed afterwards
+    cancel_mod.cancel_compute("c-race", reason="early frame")
+    tok = cancel_mod.arm_from_wire(
+        {"compute": "c-race", "deadline": None, "cancelled": False}
+    )
+    assert tok is not None and tok.cancelled
+    # and the normal order: arm, then cancel by id
+    tok2 = cancel_mod.arm_from_wire(
+        {"compute": "c-order", "deadline": time.time() + 60, "cancelled": False}
+    )
+    assert not tok2.cancelled and tok2.remaining() > 0
+    cancel_mod.cancel_compute("c-order")
+    assert tok2.cancelled
+    # a tripped client token serializes its cancelled flag
+    tok3 = CancellationToken(compute_id="c-wire")
+    tok3.cancel("bye")
+    wire = tok3.wire()
+    assert wire["cancelled"] and wire["compute"] == "c-wire"
+
+
+# -- deadline aborts per executor ---------------------------------------
+
+
+def _deadline_case(tmp_path, executor, deadline_s, grace_s, nchunks=(8, 8)):
+    spec = _slow_spec(tmp_path)
+    a = xp.ones((16, 16), chunks=nchunks, spec=spec)
+    b = a + 1
+    before = get_registry().snapshot()
+    t0 = time.monotonic()
+    with pytest.raises(ComputeDeadlineExceededError):
+        b.compute(executor=executor, deadline_s=deadline_s)
+    elapsed = time.monotonic() - t0
+    assert elapsed < deadline_s + grace_s, (
+        f"abort took {elapsed:.2f}s, bound {deadline_s + grace_s:.2f}s"
+    )
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("deadline_aborts", 0) >= 1, delta
+
+
+def test_deadline_threaded(tmp_path):
+    # 16 chunks x 0.3s on 4 threads ≈ 1.2s of work against a 0.5s deadline;
+    # grace = one straggling task + dispatch-loop wakeup
+    _deadline_case(
+        tmp_path, AsyncPythonDagExecutor(max_workers=4),
+        deadline_s=0.5, grace_s=3.0, nchunks=(4, 4),
+    )
+
+
+def test_deadline_sequential(tmp_path):
+    # the oracle enforces between tasks (and inside execute_with_stats,
+    # which runs on the same thread as the compute scope)
+    _deadline_case(
+        tmp_path, PythonDagExecutor(), deadline_s=0.5, grace_s=3.0,
+        nchunks=(4, 4),
+    )
+
+
+def test_deadline_multiprocess(tmp_path):
+    from cubed_tpu.runtime.executors.multiprocess import (
+        MultiprocessDagExecutor,
+    )
+
+    # generous grace: spawn-context pool startup happens inside the
+    # deadline window on this 2-core container
+    _deadline_case(
+        tmp_path, MultiprocessDagExecutor(max_workers=2),
+        deadline_s=1.0, grace_s=14.0, nchunks=(8, 4),
+    )
+
+
+def test_deadline_distributed(tmp_path):
+    from cubed_tpu.runtime.executors.distributed import (
+        DistributedDagExecutor,
+    )
+
+    with DistributedDagExecutor(n_local_workers=2) as ex:
+        _deadline_case(
+            tmp_path, ex, deadline_s=1.0, grace_s=8.0, nchunks=(8, 4),
+        )
+
+
+# -- explicit cancel -----------------------------------------------------
+
+
+def test_cancel_threaded_zero_retry_draw(tmp_path):
+    spec = _slow_spec(tmp_path)
+    a = xp.ones((16, 16), chunks=(4, 4), spec=spec)
+    b = a * 3
+    tok = CancellationToken()
+    threading.Timer(0.5, tok.cancel, args=("client asked",)).start()
+    before = get_registry().snapshot()
+    t0 = time.monotonic()
+    with pytest.raises(ComputeCancelledError) as ei:
+        b.compute(
+            executor=AsyncPythonDagExecutor(max_workers=4), cancellation=tok
+        )
+    assert not isinstance(ei.value, ComputeDeadlineExceededError)
+    assert time.monotonic() - t0 < 3.5
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("cancellations", 0) >= 1
+    # cancellation is an instruction, not a failure: no retries, no budget
+    assert delta.get("task_retries", 0) == 0, delta
+
+
+def test_cancelled_compute_resumes_bitwise_threaded(tmp_path):
+    # cancel mid-compute, then resume=True: only the remainder re-runs,
+    # and the result is bitwise-identical to an uninterrupted run
+    an = np.arange(256, dtype=np.float64).reshape(16, 16)
+    spec = _slow_spec(tmp_path, straggler_delay_s=0.15)
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    b = a + 7
+    tok = CancellationToken()
+    threading.Timer(0.4, tok.cancel).start()
+    with pytest.raises(ComputeCancelledError):
+        b.compute(
+            executor=AsyncPythonDagExecutor(max_workers=2), cancellation=tok
+        )
+    before = get_registry().snapshot()
+    result = b.compute(
+        executor=AsyncPythonDagExecutor(max_workers=2), resume=True
+    )
+    np.testing.assert_array_equal(result, an + 7)
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("tasks_skipped_resume", 0) > 0, (
+        "the cancelled run's completed chunks should have been kept"
+    )
+
+
+def test_cancel_running_fleet_request_journal_resumes_bitwise(tmp_path):
+    """The acceptance proof: a RUNNING fleet compute is cancelled — the
+    coordinator broadcasts compute_cancel, workers abort within ~2s —
+    and resuming the cancelled journal is bitwise-correct with strictly
+    fewer tasks re-run."""
+    from cubed_tpu.runtime.executors.distributed import (
+        DistributedDagExecutor,
+    )
+
+    journal = str(tmp_path / "compute.journal")
+    an = np.arange(256, dtype=np.float64).reshape(16, 16)
+    spec = ct.Spec(
+        work_dir=str(tmp_path), allowed_mem="500MB",
+        fault_injection=dict(seed=5, straggler_rate=1.0,
+                             straggler_delay_s=0.25),
+        journal=journal,
+    )
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    b = a * 2 + 1
+    tok = CancellationToken()
+    cancelled_at = {}
+
+    class _CancelAfter:
+        """Trip the token after a few real completions, so the cancel
+        lands genuinely mid-compute."""
+
+        def __init__(self, n=3):
+            self.n = n
+            self.seen = 0
+
+        def on_task_end(self, event):
+            self.seen += 1
+            if self.seen == self.n and not tok.cancelled:
+                cancelled_at["t"] = time.monotonic()
+                tok.cancel("client cancel")
+
+    with DistributedDagExecutor(n_local_workers=2) as ex:
+        with pytest.raises(ComputeCancelledError):
+            b.compute(
+                executor=ex, cancellation=tok, callbacks=[_CancelAfter()]
+            )
+        aborted = time.monotonic()
+        assert "t" in cancelled_at
+        assert aborted - cancelled_at["t"] < 2.0, (
+            "fleet abort took longer than the 2s bound"
+        )
+        # the broadcast actually went out to the fleet
+        assert ex.stats.get("compute_cancels_sent", 0) >= 1
+
+        # resume of the cancelled journal: bitwise, strictly fewer tasks
+        before = get_registry().snapshot()
+        result = ex.resume_compute(b, journal)
+        np.testing.assert_array_equal(result, an * 2 + 1)
+        delta = get_registry().snapshot_delta(before)
+        assert delta.get("tasks_skipped_resume", 0) > 0, delta

@@ -282,8 +282,11 @@ def test_chaos_recompute_repairs_corrupt_chunk_distributed(tmp_path):
     coordinator-side policy classifies RECOMPUTE and repairs."""
     from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
 
+    # store-only: the corruptor rots the STORE copy, and the default-on
+    # peer data plane would legitimately serve the producer's verified
+    # cached bytes instead — correct data, but no detection to test
     with DistributedDagExecutor(
-        n_local_workers=2,
+        n_local_workers=2, peer_transfer=False,
         retry_policy=RetryPolicy(retries=3, backoff_base=0.01, seed=0),
     ) as ex:
         _recompute_repairs_mid_compute(tmp_path, ex)

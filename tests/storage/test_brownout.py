@@ -1,0 +1,263 @@
+"""Store-brownout tolerance: THROTTLE classification, the per-store
+health breaker's AIMD pacing + half-open recovery, and the chaos proof —
+a seeded brownout completes bitwise with the breaker engaged and a
+strictly lower retry-budget draw than breaker-off.
+
+Marked ``chaos`` (seeded, deterministic, tier-1)."""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+import cubed_tpu.array_api as xp
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+from cubed_tpu.runtime.faults import FaultInjectedThrottleError
+from cubed_tpu.runtime.resilience import Classification, RetryPolicy
+from cubed_tpu.storage import health
+
+pytestmark = pytest.mark.chaos
+
+BROWNOUT = dict(seed=23, storage_throttle_rate=0.25)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_breakers():
+    health.reset_breakers()
+    yield
+    health.reset_breakers()
+
+
+class _StatsCapture:
+    stats: dict = {}
+
+    def on_compute_end(self, event):
+        self.stats = event.executor_stats or {}
+
+
+# -- classification ------------------------------------------------------
+
+
+def test_is_throttle_error_shapes():
+    assert health.is_throttle_error(OSError("503 SlowDown"))
+    assert health.is_throttle_error(OSError("HTTP 429 Too Many Requests"))
+    assert health.is_throttle_error(
+        ConnectionError("rate limit exceeded, retry later")
+    )
+    assert health.is_throttle_error(
+        FaultInjectedThrottleError("injected store throttle (503 SlowDown)")
+    )
+    assert not health.is_throttle_error(OSError("connection reset by peer"))
+    assert not health.is_throttle_error(ValueError("503"))  # not IO-shaped
+    # status codes match word-bounded only: digits embedded in paths or
+    # shape tuples are not brownouts
+    assert not health.is_throttle_error(
+        OSError("/tmp/tmp429ab/chunk 0.0 missing")
+    )
+    assert health.is_throttle_error(OSError("HTTP 503: Service Unavailable"))
+
+
+def test_is_throttle_error_remote_non_io_types_never_match():
+    from cubed_tpu.runtime.distributed import RemoteTaskError
+
+    # a remote ValueError whose message mentions 503 (a broadcast-shape
+    # complaint) must never classify as a brownout
+    remote = RemoteTaskError(
+        "operands could not be broadcast together with shapes (503,) (502,)",
+        remote_type="ValueError",
+    )
+    assert not health.is_throttle_error(remote)
+    remote_io = RemoteTaskError(
+        "OSError: 503 SlowDown", remote_type="OSError"
+    )
+    assert health.is_throttle_error(remote_io)
+
+
+def test_throttle_classification_local_and_remote():
+    from cubed_tpu.runtime.distributed import RemoteTaskError
+
+    policy = RetryPolicy()
+    assert policy.classify(OSError("SlowDown")) is Classification.THROTTLE
+    assert policy.classify(
+        FaultInjectedThrottleError("injected store throttle")
+    ) is Classification.THROTTLE
+    remote = RemoteTaskError(
+        "boom", remote_type="FaultInjectedThrottleError"
+    )
+    assert policy.classify(remote) is Classification.THROTTLE
+    # ordinary transient errors keep their RETRY classification
+    assert policy.classify(OSError("connection reset")) is (
+        Classification.RETRY
+    )
+
+
+def test_throttle_wait_has_its_own_analyze_bucket():
+    from cubed_tpu.observability.analytics import BUCKETS, SPAN_BUCKETS
+
+    assert SPAN_BUCKETS.get("throttle_wait") == "throttle_wait"
+    assert "throttle_wait" in BUCKETS
+
+
+# -- breaker units -------------------------------------------------------
+
+
+def test_breaker_halves_and_restores_to_unbounded():
+    b = health.StoreHealthBreaker("s3://unit")
+    b.PROBE_IDLE_S = 0.05  # fast recovery probing for the unit test
+    b.STEP_COOLDOWN_S = 0.0
+    # simulate 8 concurrent IOs, then a throttle salvo
+    for _ in range(8):
+        b.acquire()
+    assert b.state == "closed"
+    delay = b.on_throttle()
+    assert 0 < delay <= 1.0
+    assert b.state == "open" and b._limit == 4
+    b.on_throttle()
+    assert b._limit == 2
+    for _ in range(8):
+        b.release()
+    time.sleep(0.06)  # past the probe window: half-open
+    assert b.state == "half_open"
+    # a success streak doubles back to unbounded
+    for _ in range(64):
+        b.on_success()
+    assert b.state == "closed" and b._limit is None
+
+
+def test_breaker_acquire_blocks_until_release():
+    b = health.StoreHealthBreaker("s3://block")
+    b.STEP_COOLDOWN_S = 0.0
+    b.acquire()
+    b.on_throttle()  # limit -> 1 while one IO is in flight
+    assert b._limit == 1
+    acquired = threading.Event()
+
+    def second():
+        b.acquire()
+        acquired.set()
+        b.release()
+
+    t = threading.Thread(target=second, daemon=True)
+    t.start()
+    assert not acquired.wait(0.2), "second IO ran past a limit of 1"
+    b.release()
+    assert acquired.wait(2.0), "releasing the slot should unblock the wait"
+    t.join(timeout=2.0)
+
+
+def test_breaker_env_off_disables_pacing(monkeypatch):
+    monkeypatch.setenv(health.BREAKER_ENV_VAR, "off")
+    assert not health.breaker_enabled()
+    monkeypatch.setenv(health.BREAKER_ENV_VAR, "")
+    assert health.breaker_enabled()
+
+
+# -- chaos proofs --------------------------------------------------------
+
+
+@contextlib.contextmanager
+def _pinned_plan_names(base: int):
+    """Injector decisions hash the gensym'd array names in chunk keys;
+    pin the process-global counter so the breaker-on and breaker-off
+    runs (and any suite ordering) roll identical decisions, then resume
+    it where natural flow would have landed."""
+    from cubed_tpu import utils as ct_utils
+
+    resume_at = next(ct_utils.sym_counter)
+    ct_utils.sym_counter = itertools.count(base)
+    try:
+        yield
+    finally:
+        used = next(ct_utils.sym_counter) - base
+        ct_utils.sym_counter = itertools.count(resume_at + used)
+
+
+def _brownout_run(tmp_path, name: str, base: int):
+    """One seeded brownout compute; returns (result, metrics delta)."""
+    an = np.arange(400, dtype=np.float64).reshape(20, 20)
+    with _pinned_plan_names(base):
+        spec = ct.Spec(
+            work_dir=str(tmp_path / name), allowed_mem="500MB",
+            fault_injection=BROWNOUT,
+        )
+        a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 100 chunks
+        b = a * 2.0
+        before = get_registry().snapshot()
+        result = b.compute(
+            executor=AsyncPythonDagExecutor(
+                max_workers=4,
+                retry_policy=RetryPolicy(
+                    retries=6, backoff_base=0.01, seed=0
+                ),
+            ),
+        )
+    return result, get_registry().snapshot_delta(before)
+
+
+def test_chaos_brownout_completes_bitwise_with_breaker_engaged(tmp_path):
+    an = np.arange(400, dtype=np.float64).reshape(20, 20)
+    result, delta = _brownout_run(tmp_path, "on", base=41_000)
+    np.testing.assert_array_equal(result, an * 2.0)
+    assert delta.get("store_throttled", 0) > 0, delta
+    assert delta.get("store_breaker_trips", 0) > 0, delta
+
+
+def test_chaos_breaker_draws_strictly_less_budget_than_off(
+    tmp_path, monkeypatch
+):
+    """The acceptance differential: same seed, same plan names — with
+    the breaker the brownout is absorbed by paced in-place retries
+    (near-zero task-retry draw); without it every surfaced throttle
+    burns a task retry from the shared budget."""
+    an = np.arange(400, dtype=np.float64).reshape(20, 20)
+
+    monkeypatch.setenv(health.BREAKER_ENV_VAR, "off")
+    result_off, delta_off = _brownout_run(tmp_path, "off", base=42_000)
+    np.testing.assert_array_equal(result_off, an * 2.0)
+    draw_off = delta_off.get("task_retries", 0)
+
+    health.reset_breakers()
+    monkeypatch.delenv(health.BREAKER_ENV_VAR, raising=False)
+    result_on, delta_on = _brownout_run(tmp_path, "on", base=42_000)
+    np.testing.assert_array_equal(result_on, an * 2.0)
+    draw_on = delta_on.get("task_retries", 0)
+
+    assert draw_off > 0, (
+        f"breaker-off baseline drew no retries ({delta_off}) — the seeded "
+        "brownout is not surfacing"
+    )
+    assert draw_on < draw_off, (
+        f"breaker drew {draw_on} task retries vs {draw_off} without it"
+    )
+    assert delta_on.get("store_throttled", 0) > 0
+
+
+def test_chaos_distributed_brownout_bitwise(tmp_path):
+    from cubed_tpu.runtime.executors.distributed import (
+        DistributedDagExecutor,
+    )
+
+    an = np.arange(256, dtype=np.float64).reshape(16, 16)
+    spec = ct.Spec(
+        work_dir=str(tmp_path), allowed_mem="500MB",
+        fault_injection=dict(seed=31, storage_throttle_rate=0.2),
+    )
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    b = a + 0.5
+    cap = _StatsCapture()
+    with DistributedDagExecutor(n_local_workers=2) as ex:
+        result = b.compute(
+            executor=ex, callbacks=[cap],
+            retry_policy=RetryPolicy(retries=6, backoff_base=0.01, seed=0),
+        )
+    np.testing.assert_array_equal(result, an + 0.5)
+    # worker-side throttles ride the task-stats scoped-counter channel
+    # back into the client's per-compute stats
+    assert cap.stats.get("store_throttled", 0) > 0, cap.stats
