@@ -223,10 +223,11 @@ FLEET_TASKS = 128
 FLEET_TASK_DELAY_S = 0.05
 
 FLEET_SCALING = r"""
-import json, sys, tempfile, time
+import json, sys, tempfile, threading, time
 sys.path.insert(0, {repo!r})
 import numpy as np
 import cubed_tpu as ct
+from cubed_tpu.observability.metrics import get_registry
 from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
 
 
@@ -241,21 +242,53 @@ class SleepAdd:
 
 an = np.arange({tasks!r} * 4, dtype=np.float64).reshape(-1, 4)
 out = {{}}
+reg = get_registry()
 for n in {sizes!r}:
     spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="2GB")
     a = ct.from_array(an, chunks=(1, 4), spec=spec)  # one row per task
     r = ct.map_blocks(SleepAdd({delay!r}), a, dtype=np.float64)
     ex = DistributedDagExecutor(n_local_workers=n)
+    # the dispatch_utilization gauge is live only while the dispatch loop
+    # runs (the loop zeroes it on exit), so sample it from the side during
+    # the compute; overhead/frame numbers are counter deltas (full
+    # snapshot(), not snapshot_delta — gauges never survive the delta)
+    before = reg.snapshot()
+    util_samples = []
+    stop = threading.Event()
+
+    def sample(samples=util_samples, ev=stop):
+        while not ev.wait(0.2):
+            u = reg.snapshot().get("dispatch_utilization")
+            if u:
+                samples.append(u)
+
     try:
         ex._ensure_fleet()  # boot outside the timed window
+        threading.Thread(target=sample, daemon=True).start()
         t0 = time.perf_counter()
         val = np.asarray(r.compute(executor=ex))
         elapsed = time.perf_counter() - t0
     finally:
+        stop.set()
         ex.close()
     assert (val == an + 1.0).all()
-    out[str(n)] = {tasks!r} / elapsed
-    print("fleet", n, "workers:", round(out[str(n)], 1), "tasks/s",
+    after = reg.snapshot()
+    delta = lambda k: (after.get(k) or 0) - (before.get(k) or 0)
+    out[str(n)] = {{
+        "tasks_per_s": {tasks!r} / elapsed,
+        # peak windowed utilization: the saturation signal ("pegged at
+        # ~1.0 while queue_depth grows" is what the alert fires on)
+        "dispatch_utilization": (
+            max(util_samples) if util_samples else None
+        ),
+        "dispatch_overhead_ms": delta("dispatch_submit_s")
+        / {tasks!r} * 1000.0,
+        "coord_frames_sent": delta("coord_frames_sent"),
+    }}
+    print("fleet", n, "workers:",
+          round(out[str(n)]["tasks_per_s"], 1), "tasks/s,",
+          "dispatch", round(out[str(n)]["dispatch_overhead_ms"], 3),
+          "ms/task, util", out[str(n)]["dispatch_utilization"],
           file=sys.stderr, flush=True)
 print(json.dumps(out), flush=True)
 """
@@ -365,8 +398,12 @@ def measure_fleet_scaling(timeout: float):
     boots a fresh fleet, runs a sleep-bound ``FLEET_TASKS``-task compute,
     and reports tasks/sec. The parent derives per-size scaling efficiency
     (``tps(n) / (n * tps(1))``) so fleet-dispatch regressions become a
-    tracked number instead of an anecdote. Returns ``None`` on failure —
-    the scaling record is additive, never the reason a bench run dies."""
+    tracked number instead of an anecdote — and, per size, the
+    control-plane story behind the curve: peak ``dispatch_utilization``,
+    mean per-task ``dispatch_overhead_ms`` and coordinator frames sent,
+    so "the coordinator saturates" is a recorded trajectory, not a
+    profiling session. Returns ``None`` on failure — the scaling record
+    is additive, never the reason a bench run dies."""
     script = FLEET_SCALING.format(
         repo=REPO, sizes=list(FLEET_SIZES), tasks=FLEET_TASKS,
         delay=FLEET_TASK_DELAY_S,
@@ -384,17 +421,30 @@ def measure_fleet_scaling(timeout: float):
                 f"fleet scaling failed (rc={out.returncode}): "
                 f"{out.stderr[-2000:]}"
             )
-        tps = json.loads(out.stdout.strip().splitlines()[-1])
+        rows = json.loads(out.stdout.strip().splitlines()[-1])
     except Exception as e:
         print(f"fleet scaling sweep skipped: {e}", file=sys.stderr)
         return None
+    tps = {size: row["tasks_per_s"] for size, row in rows.items()}
+    dispatch = {
+        size: {
+            k: row.get(k)
+            for k in (
+                "dispatch_utilization", "dispatch_overhead_ms",
+                "coord_frames_sent",
+            )
+        }
+        for size, row in rows.items()
+    }
     base = tps.get("1")
     efficiency = {
         size: tp / (int(size) * base)
         for size, tp in tps.items()
         if base and int(size) > 1
     }
-    return {"tasks_per_s": tps, "efficiency": efficiency}
+    return {
+        "tasks_per_s": tps, "efficiency": efficiency, "dispatch": dispatch,
+    }
 
 
 #: coordinator-recovery workload: enough sleep-bound tasks that the kill
@@ -943,6 +993,108 @@ def measure_telemetry_overhead(timeout: float):
         return res
     except Exception as e:
         print(f"telemetry overhead sweep skipped: {e}", file=sys.stderr)
+        return None
+
+
+#: dispatch-profiler-overhead config: the same deep chain run twice, the
+#: coordinator self-profiler (~75 Hz sys._current_frames sampler) off vs
+#: armed via the production env-var path — the issue's acceptance bar is
+#: that arming costs <5% wall, and the armed elapsed riding the generic
+#: perf gate keeps that from rotting
+DISPATCH_PROFILE_OVERHEAD = r"""
+import json, os, sys, tempfile, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import cubed_tpu as ct
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+
+DEPTH, N, CHUNK = {depth!r}, {n!r}, {chunk!r}
+
+# the OFF mode must be the true default (a leaked operator env var would
+# arm both halves and hide the tax); the ON mode sets the var explicitly
+# below so Plan.execute takes the REAL arming path — profile_enabled() ->
+# profile_scoped() -> a sampler thread per compute
+os.environ.pop("CUBED_TPU_DISPATCH_PROFILE", None)
+
+
+def bump(x):
+    return x + 1.0
+
+
+an = np.arange(N * N, dtype=np.float64).reshape(N, N)
+
+
+def run_chain():
+    spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="2GB")
+    a = ct.from_array(an, chunks=(CHUNK, CHUNK), spec=spec)
+    r = a
+    for _ in range(DEPTH):
+        r = ct.map_blocks(bump, r, dtype=np.float64)
+    t0 = time.perf_counter()
+    val = np.asarray(r.compute(executor=AsyncPythonDagExecutor(),
+                               optimize_graph=False))
+    elapsed = time.perf_counter() - t0
+    assert (val == an + DEPTH).all()
+    return elapsed
+
+
+run_chain()  # warm-up outside both timed windows (imports, tracing, IO)
+out = {{}}
+for mode in ("off", "on"):
+    if mode == "on":
+        os.environ["CUBED_TPU_DISPATCH_PROFILE"] = "1"
+    # best-of-3 per mode: the chain is sub-second and container
+    # scheduling noise would otherwise drown a <5% tax
+    elapsed = min(run_chain() for _ in range(3))
+    out[mode] = {{"elapsed": elapsed}}
+    print("dispatch profile", mode, round(elapsed, 3), "s",
+          file=sys.stderr, flush=True)
+off_s = max(out["off"]["elapsed"], 1e-9)
+out["overhead_pct"] = (out["on"]["elapsed"] - off_s) / off_s * 100.0
+# the generic perf gate reads this key: the ARMED wall clock is the one
+# that must not regress (it contains the off cost plus the sampler tax)
+out["elapsed"] = out["on"]["elapsed"]
+print(json.dumps(out), flush=True)
+"""
+
+
+def measure_dispatch_profile_overhead(timeout: float):
+    """Deep-chain wall clock, coordinator self-profiler armed vs off.
+
+    Records ``{"off": {...}, "on": {...}, "overhead_pct": x, "elapsed":
+    on_wall}`` into BENCH_METRICS.json as ``dispatch_profile_overhead``;
+    the top-level ``elapsed`` rides the generic >20% perf gate, so the
+    armed sampler must stay within wall-clock noise of unprofiled runs
+    forever (the issue's <5% bar, with gate headroom for container
+    noise). Returns None on failure — additive, never the reason a
+    bench run dies."""
+    script = DISPATCH_PROFILE_OVERHEAD.format(
+        repo=REPO, depth=SCHED_DEPTH, n=SCHED_N, chunk=SCHED_CHUNK,
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            env=_scrubbed_cpu_env(),
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"dispatch profile overhead failed (rc={out.returncode}): "
+                f"{out.stderr[-2000:]}"
+            )
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        print(
+            f"dispatch profile overhead: {res['overhead_pct']:+.1f}% "
+            f"({res['off']['elapsed']:.2f}s off -> "
+            f"{res['on']['elapsed']:.2f}s armed)",
+            file=sys.stderr, flush=True,
+        )
+        return res
+    except Exception as e:
+        print(f"dispatch profile overhead sweep skipped: {e}",
+              file=sys.stderr)
         return None
 
 
@@ -1713,6 +1865,17 @@ def main() -> None:
         print("telemetry overhead sweep skipped: out of budget",
               file=sys.stderr)
 
+    # dispatch-profiler overhead: the deep chain with the coordinator
+    # self-profiler armed (~75 Hz sys._current_frames sampler) vs off —
+    # the armed wall clock rides the generic >20% perf gate
+    if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 45:
+        dpo = measure_dispatch_profile_overhead(_remaining(90))
+        if dpo is not None:
+            metrics_record["dispatch_profile_overhead"] = dpo
+    else:
+        print("dispatch profile overhead sweep skipped: out of budget",
+              file=sys.stderr)
+
     # analytics overhead: the deep chain with a TraceCollector attached +
     # a post-compute analyze() pass vs unobserved — the armed total rides
     # the generic >20% perf gate
@@ -1784,7 +1947,8 @@ def _append_history(record: dict) -> None:
         slim = {
             k: v for k, v in cfg.items()
             if isinstance(v, (int, float, str)) or k in (
-                "tasks_per_s", "efficiency", "oplevel", "dataflow",
+                "tasks_per_s", "efficiency", "dispatch", "oplevel",
+                "dataflow",
             )
         }
         slim.pop("executor_stats", None)
@@ -1869,6 +2033,32 @@ def _print_scaling_deltas(cur: dict, old: dict, label: str) -> None:
         for n, tp in sorted(tps.items(), key=lambda kv: int(kv[0]))
     )
     print(f"trajectory fleet_scaling: {line}", file=sys.stderr)
+    # the control-plane story behind the efficiency curve: per-size
+    # dispatch overhead and peak utilization — the ISSUE-16 measurement
+    # substrate the sharded-dispatch refactor will be judged against
+    disp = cur.get("dispatch") or {}
+    if disp:
+        dline = ", ".join(
+            f"{n}w "
+            + (
+                f"{row.get('dispatch_overhead_ms'):.2f}ms/task"
+                if isinstance(
+                    row.get("dispatch_overhead_ms"), (int, float)
+                )
+                else "?ms/task"
+            )
+            + (
+                f" util {row.get('dispatch_utilization'):.2f}"
+                if isinstance(
+                    row.get("dispatch_utilization"), (int, float)
+                )
+                else ""
+            )
+            for n, row in sorted(disp.items(), key=lambda kv: int(kv[0]))
+            if isinstance(row, dict)
+        )
+        print(f"trajectory fleet_scaling dispatch: {dline}",
+              file=sys.stderr)
     old_tps = old.get("tasks_per_s") or {}
     old_eff = old.get("efficiency") or {}
     if not old_tps:
@@ -1932,6 +2122,30 @@ def perf_regressions(prev: dict, cur: dict) -> list:
                     out.append(
                         f"fleet_scaling {size}w throughput {tp:.1f} vs "
                         f"{old_tps[size]:.1f} tasks/s ({pct:+.1f}%)"
+                    )
+            # per-task dispatch overhead growing >20% is a control-plane
+            # regression even when throughput survives (sleep-bound tasks
+            # can hide it); sub-0.05ms values are sampling noise, not a
+            # trend, so they never gate
+            old_disp = old.get("dispatch") or {}
+            for size, row in (cfg.get("dispatch") or {}).items():
+                if not isinstance(row, dict):
+                    continue
+                ov = row.get("dispatch_overhead_ms")
+                old_ov = (old_disp.get(size) or {}).get(
+                    "dispatch_overhead_ms"
+                )
+                pct = _delta_pct(ov, old_ov)
+                if (
+                    pct is not None
+                    and pct >= PERF_GATE_THRESHOLD_PCT
+                    and isinstance(ov, (int, float))
+                    and ov > 0.05
+                ):
+                    out.append(
+                        f"fleet_scaling {size}w dispatch overhead "
+                        f"{ov:.3f}ms/task vs {old_ov:.3f}ms/task "
+                        f"({pct:+.1f}%)"
                     )
             continue
         if name == "scheduler_deepchain":

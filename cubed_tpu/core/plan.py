@@ -341,7 +341,7 @@ class Plan:
             # inherit it); a None config makes this a no-op. Arming is
             # process-global while active — same caveat as the metrics
             # registry below: concurrent computes in one process share it
-            from ..observability import accounting
+            from ..observability import accounting, dispatchprofile
             from ..runtime import faults, memory
             from ..storage import integrity
 
@@ -376,6 +376,11 @@ class Plan:
                 getattr(spec, "memory_guard", None),
                 allowed_mem=getattr(spec, "allowed_mem", None),
                 export_env=True,
+            ), dispatchprofile.profile_scoped(
+                # coordinator self-profiling (env > Spec > off): a true
+                # no-op unless armed; the finished profile registers under
+                # the compute id for bundles/diagnose/the trace lane
+                spec, compute_id,
             ):
                 executor.execute_dag(
                     dag,

@@ -247,6 +247,28 @@ def render_report(bundle: dict, timeline_limit: int = 20) -> str:
             )
             out.append(f"  +{(d.get('ts', 0) - t0):8.3f}s {d.get('kind'):<20} {extra}")
 
+    prof = m.get("dispatch_profile")
+    if prof:
+        out.append(_section(
+            f"dispatch (coordinator self-profile, {prof.get('samples', 0)} "
+            f"samples @ {prof.get('hz', '?')}Hz)"
+        ))
+        for s in (prof.get("top_stacks") or [])[:8]:
+            frac = s.get("fraction")
+            frac_s = f"{frac:.0%}" if isinstance(frac, (int, float)) else "-"
+            out.append(
+                f"  {frac_s:>5} {s.get('thread')}: {s.get('leaf')}"
+            )
+        if prof.get("overflow"):
+            out.append(
+                f"  NOTE: {prof['overflow']} sample(s) beyond the "
+                "folded-stack cap were counted but not retained"
+            )
+        out.append(
+            f"  full collapsed stacks: profile-{m.get('compute_id')}.folded "
+            "(feed to flamegraph.pl / speedscope)"
+        )
+
     offsets = m.get("clock_offsets") or {}
     skewed = {k: v for k, v in offsets.items() if k != "client"}
     if skewed:

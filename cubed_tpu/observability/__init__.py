@@ -30,6 +30,15 @@ in ``runtime/types.py``); this package turns that stream into
   ``CUBED_TPU_TELEMETRY_PORT`` (``export``), watched by an
   :class:`AlertEngine` (``alerts``) and rendered live by
   ``python -m cubed_tpu.top``;
+- **control-plane observability**: a per-task dispatch ledger (stamps +
+  coordinator-side costs riding the task-stats channel, split into
+  ``ready_wait`` vs ``dispatch_overhead`` by :func:`analyze`), the
+  :class:`DispatchProfiler` — a bounded ``sys._current_frames()``
+  sampling profiler over the coordinator threads armed via
+  ``Spec(dispatch_profile=True)`` / ``CUBED_TPU_DISPATCH_PROFILE`` —
+  and the dispatch-saturation flight deck (``dispatch_utilization`` /
+  ``dispatch_capacity_estimate`` gauges, the ``dispatch_saturation``
+  alert, the ``top`` DISPATCH panel) (``dispatchprofile``);
 - **compute analytics**: :func:`explain` / ``plan.explain()`` renders the
   finalized plan's predictions pre-execution (task counts, projected vs
   allowed memory, predicted IO, fusion + scheduler/barrier decisions;
@@ -65,9 +74,15 @@ from .alerts import (  # noqa: F401
     AlertEngine,
     AlertRule,
     BurnRateRule,
+    DispatchSaturationRule,
     StallRule,
     ThresholdRule,
     default_rules,
+)
+from .dispatchprofile import (  # noqa: F401
+    DispatchProfiler,
+    profile_enabled,
+    profile_for,
 )
 from .events import EventLogCallback, PlanRow  # noqa: F401
 from .export import (  # noqa: F401

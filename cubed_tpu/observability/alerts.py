@@ -250,6 +250,50 @@ class TenantStarvationRule(AlertRule):
         }
 
 
+class DispatchSaturationRule(AlertRule):
+    """Fire when the dispatch loop is pegged while the queue deepens:
+    ``dispatch_utilization`` at or above ``threshold`` across the ENTIRE
+    window while ``queue_depth`` stays positive and does not shrink — the
+    coordinator-saturation shape the fleet-scaling curve collapses on
+    (efficiency 0.21/0.05 at 16/32 workers, ROADMAP item 1). Distinct
+    from :class:`StallRule`: tasks ARE completing, the host just can't
+    dispatch them any faster — adding workers past this point buys
+    nothing (see docs/operations.md for the first moves)."""
+
+    def __init__(
+        self, name: str = "dispatch_saturation", threshold: float = 0.9,
+        window_s: float = 20.0, description: str = "",
+        severity: str = "critical",
+    ):
+        super().__init__(name, description, severity)
+        self.threshold = float(threshold)
+        self.window_s = float(window_s)
+
+    def evaluate(self, store, now: float) -> Optional[dict]:
+        util_pts = store.window(
+            "dispatch_utilization", self.window_s, now=now
+        )
+        # utilization pegged for the WHOLE window (same full-coverage
+        # discipline as StallRule: a briefly-busy loop is working, not
+        # saturated)
+        if len(util_pts) < 2 or util_pts[0][0] > now - self.window_s * 0.8:
+            return None
+        if any(v < self.threshold for _, v in util_pts):
+            return None
+        depth_pts = store.window("queue_depth", self.window_s, now=now)
+        if len(depth_pts) < 2 or any(v <= 0 for _, v in depth_pts):
+            return None
+        if depth_pts[-1][1] < depth_pts[0][1]:
+            return None  # the backlog is draining: saturated but coping
+        return {
+            "metric": "dispatch_utilization",
+            "value": round(float(util_pts[-1][1]), 6),
+            "threshold": self.threshold,
+            "queue_depth": depth_pts[-1][1],
+            "window_s": self.window_s,
+        }
+
+
 def default_rules(retry_budget_hint: float = 50.0) -> list:
     """The standing rule set, covering the runtime's known failure shapes.
 
@@ -296,6 +340,13 @@ def default_rules(retry_budget_hint: float = 50.0) -> list:
             "window with zero completions: check the service dispatcher, "
             "the tenant's quota weight, and whether another tenant's "
             "long computes hold every admission slot",
+        ),
+        DispatchSaturationRule(
+            description="the dispatch loop ran >=90% busy for a whole "
+            "window while the ready queue kept growing: the coordinator "
+            "is the bottleneck, not the fleet — check the top DISPATCH "
+            "panel, pull the folded dispatch profile, reduce fleet size "
+            "or batch dispatch",
         ),
         ThresholdRule(
             "store_brownout", metric="store_throttled", rate=True,

@@ -26,11 +26,18 @@ questions an operator actually asks:
   attribution buckets::
 
       kernel | storage_read | storage_write | peer_fetch | shuffle
-      | retry | queue_wait | straggler_excess | uninstrumented | other
+      | retry | ready_wait | dispatch_overhead | queue_wait
+      | straggler_excess | uninstrumented | other
 
   The decomposition is exact by construction (segments tile the
   ``[compute start, compute end]`` interval), so the buckets always sum to
-  the measured wall clock. The report also flags the top-k bottleneck
+  the measured wall clock. When a task carries a dispatch ledger (PR 16:
+  per-task control-plane stamps on the task-stats channel), the
+  pre-start gap splits into ``ready_wait`` (no worker capacity — real
+  fleet backpressure) vs ``dispatch_overhead`` (the coordinator itself was
+  busy serializing/sending — the scaling cliff); tasks without a ledger
+  keep the whole gap in the legacy ``queue_wait`` bucket, so old traces
+  analyze unchanged. The report also flags the top-k bottleneck
   tasks on the path and projected-vs-measured divergences (memory
   projections exceeded, wall-clock concentration far above an op's task
   share).
@@ -77,12 +84,23 @@ SPAN_BUCKETS = {
     "throttle_wait": "throttle_wait",
 }
 
-#: every attribution bucket, in render order
+#: every attribution bucket, in render order. ``ready_wait`` /
+#: ``dispatch_overhead`` are the ledger-informed split of a task's
+#: pre-start gap; ``queue_wait`` remains the undifferentiated gap for
+#: tasks that shipped no dispatch ledger (old traces, local executors
+#: without stamps)
 BUCKETS = (
     "kernel", "storage_read", "storage_write", "peer_fetch", "shuffle",
-    "retry", "throttle_wait", "queue_wait", "straggler_excess",
-    "uninstrumented", "other",
+    "retry", "throttle_wait", "ready_wait", "dispatch_overhead",
+    "queue_wait", "straggler_excess", "uninstrumented", "other",
 )
+
+#: tasks at or below this duration are resume/cache-satisfied zero-width
+#: intervals (chunk-granular resume marks them done without running
+#: anything): excluded from op medians and per-op busy statistics, where
+#: a flood of zeros would drag the median to ~0 and flag every REAL task
+#: a straggler (see tests/observability/test_analytics.py)
+_ZERO_WIDTH_S = 1e-6
 
 #: straggler thresholds (match TraceCollector's live-watch defaults)
 STRAGGLER_FACTOR = 3.0
@@ -457,6 +475,9 @@ def _trace_tables(trace: dict) -> tuple:
                 "tid": e.get("tid"),
                 "attempt": args.get("attempt") or 0,
                 "error": bool(args.get("error")),
+                # the control-plane dispatch ledger, when one rode the
+                # task event (collect.merged_tracer attaches it)
+                "dispatch": args.get("dispatch"),
             })
         elif cat in (
             "storage", "kernel", "integrity", "retry", "transfer",
@@ -501,6 +522,11 @@ def _attach_spans(tasks: List[dict], spans: List[dict]) -> None:
 def _op_medians(tasks: List[dict]) -> Dict[str, float]:
     by_op: Dict[str, List[float]] = {}
     for t in tasks:
+        if t["dur"] <= _ZERO_WIDTH_S:
+            # resume/cache-satisfied zero-width interval: not a real
+            # execution — letting it into the median would drag an op's
+            # baseline toward zero and mark every genuine task a straggler
+            continue
         by_op.setdefault(t["op"], []).append(t["dur"])
     return {
         op: statistics.median(durs) for op, durs in by_op.items() if durs
@@ -611,6 +637,32 @@ def _decompose(
     cursor = t_start
     for t in chain:
         queue_wait = max(0.0, t["start"] - cursor)
+        # ledger-informed split of the pre-start gap: the coordinator's
+        # measured per-task cost (submit_cost_s wraps the whole inline
+        # Coordinator.submit; serialize/send/lock-wait are its pieces) is
+        # dispatch_overhead, the remainder is ready_wait — genuine fleet
+        # backpressure. No ledger -> the whole gap stays queue_wait.
+        disp = t.get("dispatch") or None
+        dispatch_cost = None
+        if disp:
+            dispatch_cost = disp.get("submit_cost_s")
+            if dispatch_cost is None:
+                parts = [
+                    disp.get(k)
+                    for k in ("serialize_s", "send_s", "lock_wait_s")
+                ]
+                parts = [
+                    p for p in parts if isinstance(p, (int, float))
+                ]
+                dispatch_cost = sum(parts) if parts else None
+        if dispatch_cost is not None:
+            dispatch_overhead = min(queue_wait, max(0.0, dispatch_cost))
+            ready_wait = queue_wait - dispatch_overhead
+            attribution["dispatch_overhead"] += dispatch_overhead
+            attribution["ready_wait"] += ready_wait
+        else:
+            dispatch_overhead = ready_wait = None
+            attribution["queue_wait"] += queue_wait
         eff_start = max(t["start"], cursor)
         counted = max(0.0, min(t["end"], t_end) - eff_start)
         scale = (counted / t["dur"]) if t["dur"] > 0 else 0.0
@@ -637,20 +689,25 @@ def _decompose(
                 if remaining <= 1e-12:
                     break
             buckets["straggler_excess"] = excess - remaining
-        attribution["queue_wait"] += queue_wait
         for k, v in buckets.items():
             attribution[k] = attribution.get(k, 0.0) + v
-        rows.append({
+        row = {
             "op": t["op"],
             "chunk": t["chunk"],
             "worker": t.get("worker"),
             "start_s": round(t["start"] - t_start, 6),
             "duration_s": round(t["dur"], 6),
+            # queue_wait_s is always the FULL pre-start gap (bottleneck
+            # ranking keys on it regardless of whether a ledger split it)
             "queue_wait_s": round(queue_wait, 6),
             "straggler": straggler,
             "straggler_excess_s": round(excess, 6) if straggler else 0.0,
             "buckets": {k: round(v, 6) for k, v in buckets.items() if v},
-        })
+        }
+        if dispatch_overhead is not None:
+            row["dispatch_overhead_s"] = round(dispatch_overhead, 6)
+            row["ready_wait_s"] = round(ready_wait, 6)
+        rows.append(row)
         cursor = max(cursor, t["end"])
     attribution["other"] += max(0.0, t_end - cursor)
     return {k: round(v, 6) for k, v in attribution.items()}, rows
@@ -664,7 +721,9 @@ def _per_op_rows(
     per_op: Dict[str, dict] = {}
     op_wall = manifest.get("op_wall_clock") or {}
     for t in tasks:
-        if t["error"]:
+        if t["error"] or t["dur"] <= _ZERO_WIDTH_S:
+            # zero-width (resume-satisfied) intervals carry no busy time
+            # and no spans: keep them out of the bucket statistics
             continue
         row = per_op.setdefault(t["op"], {
             "tasks": 0, "busy_s": 0.0, "stragglers": 0,

@@ -395,6 +395,10 @@ class TraceCollector(EventLogCallback):
             "worker": getattr(event, "worker", None),
             "spans": getattr(event, "spans", None) or [],
             "spans_dropped": dropped or 0,
+            # the task's control-plane dispatch ledger (runtime/types.py):
+            # analytics splits queue_wait into ready_wait vs
+            # dispatch_overhead from these stamps
+            "dispatch": getattr(event, "dispatch", None),
         }
         with self._lock:
             if len(self._records) >= self.max_task_records:
@@ -588,10 +592,16 @@ class TraceCollector(EventLogCallback):
         for rec in records:
             off = offsets.get(self._offset_key(rec), 0.0)
             lane = lane_of(rec)
+            extra = {}
+            if rec.get("dispatch"):
+                # the ledger rides the task event so analyze() on a LOADED
+                # trace can still split ready_wait vs dispatch_overhead
+                extra["dispatch"] = rec["dispatch"]
             tr.add_complete(
                 rec["op"], rec["start"] + off, rec["end"] + off,
                 lane=lane, cat="task", chunk=rec["chunk"],
                 attempt=rec["attempt"], executor=rec["executor"],
+                **extra,
             )
             add_sub_spans(rec, lane, off)
         for rec in oob_tasks_since(self._t0):
@@ -612,6 +622,19 @@ class TraceCollector(EventLogCallback):
         for d in decisions_since(self._t0):
             attrs = {k: v for k, v in d.items() if k not in ("ts", "kind")}
             tr.instant(d["kind"], lane="scheduler", ts=d["ts"], **attrs)
+        prof = None
+        try:
+            from .dispatchprofile import profile_for
+
+            prof = profile_for(self.compute_id)
+        except Exception:
+            pass
+        if prof is not None:
+            # the coordinator self-profiler's leaf reservoir as instants:
+            # a "dispatch profile" lane showing where the control plane's
+            # threads were, aligned with the task lanes it dispatched
+            for ts, leaf in prof.lane_samples():
+                tr.instant(leaf, lane="dispatch profile", ts=ts)
         for s in samples_since(self._t0):
             # fleet-worker heartbeat samples carry the worker name and get
             # their own memory lane; sampler readings land on "memory"

@@ -44,6 +44,7 @@ from .timeseries import (
     TelemetrySampler,
     TimeSeriesStore,
     compute_progress,
+    dispatch_view,
     fleet_view,
     service_view,
 )
@@ -357,6 +358,7 @@ class TelemetryRuntime:
             "metrics": get_registry().snapshot(),
             "fleet": fleet_view(),
             "service": service_view(),
+            "dispatch": dispatch_view(),
             "computes": compute_progress(),
             "alerts": self.alert_engine.recent(),
             "alerts_active": self.alert_engine.active(),

@@ -16,6 +16,9 @@ directory:
                         # stragglers, per-worker clock offsets
         trace.json      # the merged Perfetto trace (open in ui.perfetto.dev)
         logs.jsonl      # last-N correlated structured log records
+        profile-<compute_id>.folded
+                        # collapsed coordinator stacks when the dispatch
+                        # profiler was armed (flamegraph.pl/speedscope-ready)
 
 Read it with ``python -m cubed_tpu.diagnose <bundle>`` — slowest ops, top
 stragglers, retry/quarantine/guard timelines, per-worker skew — or any JSON
@@ -190,7 +193,18 @@ class FlightRecorder(TraceCollector):
             "chunk_graph": self.chunk_graph(),
             "task_records": len(self._records),
             "task_records_dropped": self.records_dropped,
+            # the coordinator self-profiler's summary (top folded stacks,
+            # sample/overflow counts) when the dispatch profiler was armed
+            # for this compute — the collapsed stacks themselves land as
+            # profile-<compute_id>.folded beside the trace
+            "dispatch_profile": self._dispatch_profile_summary(),
         }
+
+    def _dispatch_profile_summary(self) -> Optional[dict]:
+        from .dispatchprofile import profile_for
+
+        prof = profile_for(self.compute_id)
+        return prof.summary() if prof is not None else None
 
     def dump(self, path: Optional[str] = None) -> str:
         """Write the bundle directory now; returns its path."""
@@ -198,6 +212,17 @@ class FlightRecorder(TraceCollector):
             path = os.path.join(self.bundle_dir, f"bundle-{self.compute_id}")
         os.makedirs(path, exist_ok=True)
         self.export(os.path.join(path, BUNDLE_TRACE))
+        from .dispatchprofile import profile_for
+
+        prof = profile_for(self.compute_id)
+        if prof is not None:
+            # flamegraph-ready collapsed stacks: feed straight to
+            # flamegraph.pl / speedscope / inferno
+            folded = os.path.join(
+                path, f"profile-{self.compute_id}.folded"
+            )
+            with open(folded, "w") as f:
+                f.write("\n".join(prof.folded_lines()) + "\n")
         with open(os.path.join(path, BUNDLE_LOGS), "w") as f:
             for rec in logs.recent_records(self.max_log_records):
                 f.write(json.dumps(rec, default=str) + "\n")

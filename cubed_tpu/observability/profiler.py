@@ -1,4 +1,5 @@
-"""Device-side profiling, folded into the span pipeline.
+"""**Device** profiler: JAX device traces + per-op device memory, folded
+into the span pipeline.
 
 ``JaxProfilerCallback`` brackets a compute in ``jax.profiler.trace`` (xprof
 traces for TensorBoard/XProf) and ``DeviceMemoryCallback`` snapshots device
@@ -7,6 +8,13 @@ guard samples. Both now feed the unified pipeline: profiler start/stop and
 each device-memory snapshot are recorded as :func:`collect.record_decision`
 entries, so they appear on the ``scheduler`` lane of the merged trace and
 inside flight-recorder bundles next to the host-side story.
+
+Not to be confused with ``observability/dispatchprofile.py`` — the
+**dispatch** profiler, which samples the host-side control-plane threads
+(coordinator/dispatch loop) with ``sys._current_frames()``. This module
+profiles what the *devices* do; that one profiles what the *coordinator*
+does. See docs/observability.md "Device profiler" vs "Control-plane
+observability".
 
 ``cubed_tpu.extensions.profiler`` re-exports these classes unchanged (the
 historical import path keeps working).

@@ -680,3 +680,40 @@ def fleet_view() -> dict:
         "workers_disconnected": disconnected,
         "fleets": len(live_fleets()),
     }
+
+
+def dispatch_view() -> Optional[dict]:
+    """Point-in-time control-plane view for ``/snapshot.json`` and the
+    ``cubed_tpu.top`` DISPATCH panel: the dispatch loop's self-accounted
+    utilization/capacity gauges (registry) plus per-message-type frame
+    and byte counts from every live coordinator's link. None when
+    nothing dispatch-shaped has been recorded yet."""
+    from .metrics import get_registry
+
+    snap = get_registry().snapshot()
+    out: dict = {}
+    for key in (
+        "dispatch_utilization", "dispatch_capacity_estimate",
+        "dispatch_submit_s", "dispatch_serialize_s", "dispatch_send_s",
+        "dispatch_unpickle_s", "dispatch_release_s",
+        "dispatch_lock_wait_s", "dispatch_sched_hook_s",
+        "coord_frames_sent", "coord_frames_recv",
+        "coord_frame_bytes_sent", "coord_frame_bytes_recv",
+    ):
+        if key in snap:
+            out[key] = snap[key]
+    frames: Dict[str, dict] = {}
+    for coord in live_fleets():
+        try:
+            fsnap = coord.stats_snapshot().get("frames") or {}
+        except Exception:
+            continue
+        for direction, rows in fsnap.items():
+            agg = frames.setdefault(direction, {})
+            for mtype, (count, nbytes) in rows.items():
+                cur = agg.setdefault(mtype, [0, 0])
+                cur[0] += count
+                cur[1] += nbytes
+    if frames:
+        out["frames"] = frames
+    return out or None

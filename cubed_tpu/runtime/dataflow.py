@@ -62,6 +62,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Any, Dict, Iterator, List, Optional, Set
 
 import networkx as nx
@@ -604,7 +605,20 @@ class DataflowScheduler:
     def on_submit(self, i: int) -> None:
         """First-dispatch hook: fires the op's start event and counts
         tasks that start while an upstream producer op still has
-        unfinished tasks — the overlap the op barrier used to forbid."""
+        unfinished tasks — the overlap the op barrier used to forbid.
+
+        Runs inline on the dispatch loop, so its cost is coordinator
+        overhead: self-accounted into ``dispatch_sched_hook_s`` (with
+        ``on_done``) so the saturation model sees scheduler bookkeeping."""
+        t_hook = time.perf_counter()
+        try:
+            self._on_submit(i)
+        finally:
+            get_registry().counter("dispatch_sched_hook_s").inc(
+                time.perf_counter() - t_hook
+            )
+
+    def _on_submit(self, i: int) -> None:
         op = self.graph.array_names[i]
         self._start_op(op)
         if i in self._submitted:
@@ -630,13 +644,19 @@ class DataflowScheduler:
                 )
 
     def on_done(self, i: int) -> None:
-        if i in self._done:
-            return
-        self._done.add(i)
-        op = self.graph.array_names[i]
-        self._pending[op] -= 1
-        if self._pending[op] == 0:
-            self._end_op(op)
+        t_hook = time.perf_counter()
+        try:
+            if i in self._done:
+                return
+            self._done.add(i)
+            op = self.graph.array_names[i]
+            self._pending[op] -= 1
+            if self._pending[op] == 0:
+                self._end_op(op)
+        finally:
+            get_registry().counter("dispatch_sched_hook_s").inc(
+                time.perf_counter() - t_hook
+            )
 
     def _start_op(self, name: str) -> None:
         if name in self._started_ops:

@@ -93,6 +93,7 @@ from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Any, Dict, Optional
 
+from ..observability.dispatchprofile import TimedLock
 from ..observability.metrics import get_registry
 from .transfer import ChunkLocationRegistry, pick_worker_by_locality
 
@@ -174,6 +175,13 @@ def send_frame(sock: socket.socket, obj: Any, lock: Optional[threading.Lock] = N
         sock.sendall(data)
 
 
+#: per-thread timing of the LAST ``recv_frame`` on this thread (unpickle
+#: cost + wire size) — the dispatch ledger's result-deserialize stamp.
+#: Thread-local because each worker link has its own recv loop: the reader
+#: (``_recv_loop``) always runs on the same thread as the recv it measures
+_recv_timing = threading.local()
+
+
 def recv_frame(sock: socket.socket) -> Any:
     import cloudpickle
 
@@ -182,8 +190,9 @@ def recv_frame(sock: socket.socket) -> Any:
     if n > MAX_FRAME:
         raise CorruptFrameError(f"frame length {n} exceeds limit")
     payload = _recv_exact(sock, n)
+    t0 = time.perf_counter()
     try:
-        return cloudpickle.loads(payload)
+        obj = cloudpickle.loads(payload)
     except Exception as e:
         # torn or garbage payload: the stream is desynchronized — surface a
         # connection-level error, never an uncaught exception that would
@@ -191,6 +200,9 @@ def recv_frame(sock: socket.socket) -> Any:
         raise CorruptFrameError(
             f"undecodable {n}-byte frame ({type(e).__name__}: {e})"
         ) from e
+    _recv_timing.unpickle_s = time.perf_counter() - t0
+    _recv_timing.nbytes = _LEN.size + n
+    return obj
 
 
 def _fail_future(fut: Future, exc: BaseException) -> None:
@@ -331,7 +343,12 @@ class Coordinator:
         #: expected to be backfilled, so submit() waits up to this long for
         #: a replacement to register before raising NoWorkersError
         self.backfill_grace_s: float = 0.0
-        self._lock = threading.Lock()
+        #: the coordinator's hot lock, instrumented: contended-acquire wait
+        #: feeds ``dispatch_lock_wait_s`` and the per-submit ledger's
+        #: ``lock_wait_s`` (observability/dispatchprofile.TimedLock — a
+        #: drop-in Lock; the Condition below works through the stdlib's
+        #: generic acquire/release fallbacks)
+        self._lock = TimedLock()
         self._next_task_id = 0
         self._closed = threading.Event()
         self._worker_joined = threading.Condition(self._lock)
@@ -376,6 +393,14 @@ class Coordinator:
         #: the telemetry sampler and stats_snapshot read as the merged
         #: worker-side view
         self.fleet_metrics: Dict[str, float] = {}
+        #: per-message-type frame/byte counts on the coordinator link, both
+        #: directions ({"sent"/"recv": {mtype: [frames, bytes]}}) — the
+        #: control-plane traffic breakdown stats_snapshot/top expose; plain
+        #: dict increments (GIL-atomic enough for diagnostics), bounded by
+        #: the fixed message-type vocabulary plus a hard key cap
+        self._frame_counts: Dict[str, Dict[str, list]] = {
+            "sent": {}, "recv": {},
+        }
         #: decision-ring entries for locality placement are throttled (the
         #: counters carry the totals; the ring is bounded)
         self._locality_decisions_left = 16
@@ -801,15 +826,49 @@ class Coordinator:
                     "lease_expired", worker=conn.name, reason=reason,
                 )
 
+    def _count_frame(self, direction: str, mtype, nbytes: int) -> None:
+        """Fold one link frame into the per-message-type breakdown and the
+        registry's coordinator-link counters (frames + bytes, per
+        direction). Lock-free on purpose: a racing increment can lose one
+        count, which diagnostics tolerate and the dispatch path's latency
+        budget appreciates."""
+        bucket = self._frame_counts[direction]
+        key = str(mtype or "unknown")
+        row = bucket.get(key)
+        if row is None:
+            if len(bucket) >= 32:
+                key, row = "other", bucket.get("other")
+            if row is None:
+                row = bucket[key] = [0, 0]
+        row[0] += 1
+        row[1] += nbytes
+        reg = get_registry()
+        if direction == "sent":
+            reg.counter("coord_frames_sent").inc()
+            reg.counter("coord_frame_bytes_sent").inc(nbytes)
+        else:
+            reg.counter("coord_frames_recv").inc()
+            reg.counter("coord_frame_bytes_recv").inc(nbytes)
+
     def _recv_loop(self, conn: _WorkerConn, sock, gen: int) -> None:
         try:
             while conn.alive:
                 msg = recv_frame(sock)
+                # the ledger's deserialize stamp: recv_frame times its
+                # cloudpickle.loads on THIS thread (see _recv_timing)
+                unpickle_s = getattr(_recv_timing, "unpickle_s", 0.0)
                 if not isinstance(msg, dict):
                     raise CorruptFrameError(
                         f"non-dict frame from {conn.name}: "
                         f"{type(msg).__name__}"
                     )
+                self._count_frame(
+                    "recv", msg.get("type"),
+                    getattr(_recv_timing, "nbytes", 0),
+                )
+                get_registry().counter(
+                    "dispatch_unpickle_s"
+                ).inc(unpickle_s)
                 with self._lock:
                     if conn.generation != gen:
                         return  # a reconnect superseded this socket
@@ -861,10 +920,23 @@ class Coordinator:
                     if fut is None or fut.done():
                         continue  # duplicate/late reply, or a cancelled twin
                     if mtype == "result":
-                        try:
-                            fut.set_result(
-                                (msg.get("result"), msg.get("stats", {}))
+                        stats = msg.get("stats", {}) or {}
+                        disp = getattr(fut, "_dispatch", None)
+                        if disp is not None:
+                            # complete the coordinator side of the ledger:
+                            # submit() stamped serialize/send/lock-wait on
+                            # this future; the receive side adds the
+                            # result-arrival stamp and unpickle cost, and
+                            # the whole dict rides the existing stats
+                            # channel to map_unordered's success path
+                            stats = dict(stats)
+                            stats["dispatch"] = dict(
+                                disp,
+                                result_recv_tstamp=time.time(),
+                                unpickle_s=unpickle_s,
                             )
+                        try:
+                            fut.set_result((msg.get("result"), stats))
                         except Exception:
                             pass  # cancelled concurrently (losing twin)
                     else:
@@ -1272,7 +1344,14 @@ class Coordinator:
         ``pool.submit(execute_with_stats, function, input, config=...)``; the
         wrapper always runs worker-side.
         """
+        # dispatch ledger: zero the hot-lock accumulator for THIS submit,
+        # and fold the op-blob pickle (cached after first use) into the
+        # serialize cost — submit runs inline on the dispatch loop, so
+        # everything timed here is coordinator overhead by definition
+        self._lock.reset_thread_wait()
+        t_blob = time.perf_counter()
         blob_id, blob = self._blob_for(function, config)
+        blob_cost = time.perf_counter() - t_blob
         fut: Future = Future()
         # routing may need a second try if a send races a worker death
         while True:
@@ -1481,7 +1560,17 @@ class Coordinator:
                 ),
             }
             try:
-                send_frame(conn.sock, msg, conn.send_lock)
+                # serialize and send timed separately: pickle time vs
+                # socket time are different saturation stories (batch the
+                # frame build vs shard the link), so the ledger keeps them
+                # apart
+                t_ser = time.perf_counter()
+                data = frame_bytes(msg)
+                serialize_s = blob_cost + time.perf_counter() - t_ser
+                t_send = time.perf_counter()
+                with conn.send_lock:
+                    conn.sock.sendall(data)
+                send_s = time.perf_counter() - t_send
             except (ConnectionError, OSError) as e:
                 with self._lock:
                     conn.outstanding.pop(task_id, None)
@@ -1497,6 +1586,20 @@ class Coordinator:
                     conn.outstanding.pop(task_id, None)
                     conn.deadlines.pop(task_id, None)
                 raise
+            # coordinator half of the dispatch ledger, attached to the
+            # future the instant the send lands (the recv loop merges it
+            # into the result's stats; a reply racing this attribute set
+            # just ships without a ledger — it stays Optional end to end)
+            fut._dispatch = {
+                "serialize_s": serialize_s,
+                "send_s": send_s,
+                "lock_wait_s": self._lock.thread_wait_s(),
+                "sent_tstamp": time.time(),
+            }
+            self._count_frame("sent", "task", len(data))
+            reg = get_registry()
+            reg.counter("dispatch_serialize_s").inc(serialize_s)
+            reg.counter("dispatch_send_s").inc(send_s)
             with self._lock:
                 # only mark the blob delivered once the send has succeeded
                 conn.blobs_sent.add(blob_id)
@@ -1523,17 +1626,17 @@ class Coordinator:
                 w for w in self._workers if w.alive and w.connected
             ]
         notified = 0
+        # one frame build for the whole fleet (the payload is identical)
+        data = frame_bytes({
+            "type": "compute_cancel",
+            "compute": compute_id,
+            "reason": reason,
+        })
         for conn in conns:
             try:
-                send_frame(
-                    conn.sock,
-                    {
-                        "type": "compute_cancel",
-                        "compute": compute_id,
-                        "reason": reason,
-                    },
-                    conn.send_lock,
-                )
+                with conn.send_lock:
+                    conn.sock.sendall(data)
+                self._count_frame("sent", "compute_cancel", len(data))
                 notified += 1
             except (ConnectionError, OSError):
                 continue  # the task-message path is the backstop
@@ -1572,6 +1675,12 @@ class Coordinator:
         out["chunk_locations"] = self.chunk_registry.stats()
         with self._lock:
             out["fleet_metrics"] = dict(self.fleet_metrics) or None
+        # per-message-type link traffic ({direction: {type: [frames,
+        # bytes]}}) — the DISPATCH panel's frame breakdown
+        out["frames"] = {
+            d: {k: list(v) for k, v in rows.items()}
+            for d, rows in self._frame_counts.items()
+        }
         return out
 
     def close(self) -> None:

@@ -267,6 +267,16 @@ def _map_unordered_batch(
     start_times: Dict[object, float] = {}
     end_times: Dict[object, float] = {}
     create_times: Dict[int, float] = {}
+    #: dispatch ledger, loop side: input -> when it became dispatchable
+    #: (deps met / admitted), and future -> the loop's per-submit stamps
+    #: (submitted_tstamp + the wall time the dispatch loop spent inside
+    #: the submit call — serialize+send on the distributed executor)
+    ready_times: Dict[int, float] = {}
+    submit_meta: Dict[concurrent.futures.Future, dict] = {}
+    #: trailing window of per-task submit cost: the basis of the
+    #: dispatch_capacity_estimate gauge (tasks/sec the dispatch path could
+    #: sustain if it did nothing else)
+    dispatch_costs: deque = deque(maxlen=64)
     # future -> (input index, is_backup, attempt number it was submitted
     # as, admission limit at submit time — None = unbounded; a RESOURCE
     # failure of a task admitted at limit 1 is fatal, degradation is spent)
@@ -320,14 +330,30 @@ def _map_unordered_batch(
         return key
 
     def submit(i: int, is_backup: bool = False):
+        # the dispatch ledger's "dequeued -> sent" window: everything from
+        # here through executor.submit runs ON the dispatch loop (for the
+        # distributed executor, Coordinator.submit — pickle + socket send —
+        # is inline in that call), so its duration IS per-task coordinator
+        # cost, distinct from waiting on a free worker
+        t_dispatch = time.perf_counter()
         if on_input_submit is not None:
             on_input_submit(i)
-        create_times.setdefault(i, time.time())
+        submitted_ts = time.time()
+        create_times.setdefault(i, submitted_ts)
+        ready_times.setdefault(i, submitted_ts)
         fire_task_start(
             callbacks, op_of(i), key_fn=lambda: key_of(i),
             attempt=attempts[i], backup=is_backup,
         )
         fut = executor.submit(execute_with_stats, function, inputs[i], **kwargs)
+        cost = time.perf_counter() - t_dispatch
+        submit_meta[fut] = {
+            "ready_tstamp": ready_times.get(i, submitted_ts),
+            "submitted_tstamp": submitted_ts,
+            "submit_cost_s": cost,
+        }
+        dispatch_costs.append(cost)
+        metrics.counter("dispatch_submit_s").inc(cost)
         start_times[fut] = time.time()
         # the submit-time attempt rides with the future so the end event
         # reports the attempt that actually produced the result (a backup
@@ -356,6 +382,12 @@ def _map_unordered_batch(
 
         With the controller unbounded (no memory pressure ever seen) every
         input submits immediately — exactly the pre-guard behavior."""
+        # deps-ready stamp: the input is dispatchable from here on, whether
+        # it submits now or queues for an admission slot — the interval to
+        # the submit stamp is real backpressure, not coordinator cost
+        now_ts = time.time()
+        create_times.setdefault(i, now_ts)
+        ready_times.setdefault(i, now_ts)
         if not admit_queue and admission.has_slot(len(pending)):
             resubmit(i)
             return
@@ -384,6 +416,15 @@ def _map_unordered_batch(
     for i in range(len(inputs)):
         if i not in blocked and i not in done_inputs:
             admit(i)
+
+    #: dispatch-loop busy-vs-idle self-accounting: time spent blocked in
+    #: the completion waits / backoff sleeps below is idle; everything else
+    #: the loop does (submit, classify, release) is busy. Folded into the
+    #: dispatch_utilization gauge each ~0.5s window — utilization pegged at
+    #: ~1.0 while queue_depth grows is the dispatch-saturation signature
+    #: (the dispatch_saturation alert watches exactly that pair)
+    util_t0 = time.time()
+    util_idle_s = 0.0
 
     try:
         while pending or delayed or repairing or admit_queue or blocked:
@@ -425,15 +466,33 @@ def _map_unordered_batch(
                     )
                     heapq.heappush(delayed, (now + rdelay, ri))
             metrics.gauge("queue_depth").set(len(pending))
+            now_util = time.time()
+            if now_util - util_t0 >= 0.5:
+                elapsed = now_util - util_t0
+                metrics.gauge("dispatch_utilization").set(
+                    max(0.0, min(1.0, 1.0 - util_idle_s / elapsed))
+                )
+                if dispatch_costs:
+                    mean_cost = sum(dispatch_costs) / len(dispatch_costs)
+                    if mean_cost > 0:
+                        metrics.gauge("dispatch_capacity_estimate").set(
+                            1.0 / mean_cost
+                        )
+                util_t0 = now_util
+                util_idle_s = 0.0
             if not pending:
                 # nothing in flight: sleep until the next retry is due or
                 # an in-flight repair completes
                 if delayed:
+                    t_idle = time.perf_counter()
                     time.sleep(max(0.0, min(delayed[0][0] - time.time(), 0.25)))
+                    util_idle_s += time.perf_counter() - t_idle
                 elif repairing:
+                    t_idle = time.perf_counter()
                     concurrent.futures.wait(
                         list(repairing.values()), timeout=0.25
                     )
+                    util_idle_s += time.perf_counter() - t_idle
                 elif admit_queue:
                     # throttled to zero in flight: keep draining
                     continue
@@ -461,13 +520,16 @@ def _map_unordered_batch(
                 timeout = max(0.01, min(timeout, delayed[0][0] - now))
             if repairing:
                 timeout = min(timeout, 0.05)  # notice repair completions fast
+            t_idle = time.perf_counter()
             done, _ = concurrent.futures.wait(
                 list(pending), timeout=timeout,
                 return_when=concurrent.futures.FIRST_COMPLETED,
             )
+            util_idle_s += time.perf_counter() - t_idle
             now = time.time()
             for fut in done:
                 entry = pending.pop(fut, None)
+                meta = submit_meta.pop(fut, None)
                 if entry is None:
                     # a twin that completed in the same wait batch as its
                     # winner: the winner's cancel loop already removed it
@@ -648,6 +710,16 @@ def _map_unordered_batch(
                     if pending[f][0] == i:
                         f.cancel()
                         del pending[f]
+                        submit_meta.pop(f, None)
+                # the dispatch ledger: the loop's own stamps (deps-ready /
+                # dequeued / submit cost) merged with whatever the
+                # coordinator injected into the stats channel (serialize/
+                # send/lock-wait/result-unpickle, distributed executor
+                # only) — the keys are disjoint by construction
+                stats = dict(stats)
+                disp = stats.pop("dispatch", None) or {}
+                if meta:
+                    disp = dict(disp, **meta)
                 handle_callbacks(
                     callbacks,
                     dict(
@@ -657,6 +729,7 @@ def _map_unordered_batch(
                         chunk_key=key_of(i),
                         attempt=attempt,
                         executor=executor_name,
+                        dispatch=disp or None,
                     ),
                 )
                 # dataflow hooks and dependent release fire AFTER the task
@@ -664,9 +737,17 @@ def _map_unordered_batch(
                 # consequences (an op's end event still follows its last
                 # task's end event), and a callback mutating storage for
                 # chaos tests cannot race the released consumer's read
+                t_release = time.perf_counter()
                 if on_input_done is not None:
                     on_input_done(i)
                 release_dependents(i)
+                # dependents-released: fan-out time is dispatch cost too
+                # (it includes the submits it triggers, which also count
+                # under dispatch_submit_s — the ledger, not these coarse
+                # counters, is the double-count-free view)
+                metrics.counter("dispatch_release_s").inc(
+                    time.perf_counter() - t_release
+                )
             if use_backups and not admission.throttling:
                 # no speculative duplicates while degraded for memory: a
                 # backup twin is pure extra footprint
@@ -683,7 +764,9 @@ def _map_unordered_batch(
     finally:
         # reset even when retries are exhausted mid-loop: a stale nonzero
         # queue_depth would read as phantom in-flight tasks forever after
+        # (likewise a pegged utilization with no loop running)
         metrics.gauge("queue_depth").set(0)
+        metrics.gauge("dispatch_utilization").set(0.0)
         if repair_pool is not None:
             repair_pool.shutdown(wait=False, cancel_futures=True)
 

@@ -98,6 +98,17 @@ class TaskEndEvent:
     pid: Optional[int] = None
     #: fleet worker name when the task ran on a named worker, else None
     worker: Optional[str] = None
+    #: the task's control-plane dispatch ledger: client-clock stamps and
+    #: coordinator-side costs for its lifecycle transitions (deps-ready ->
+    #: dequeued -> serialized -> sent -> result-received), merged from the
+    #: dispatch loop's per-submit timing and, on the distributed executor,
+    #: the coordinator's per-frame measurements — keys like
+    #: ``ready_tstamp``/``submitted_tstamp``/``submit_cost_s``/
+    #: ``serialize_s``/``send_s``/``lock_wait_s``/``sent_tstamp``/
+    #: ``result_recv_tstamp``/``unpickle_s``; None when no ledger rode the
+    #: stats channel (see docs/observability.md "Control-plane
+    #: observability")
+    dispatch: Optional[dict] = None
 
 
 class Callback:

@@ -38,6 +38,7 @@ class Spec:
         peer_transfer: Optional[bool] = None,
         telemetry_port: Optional[int] = None,
         service: Optional[Any] = None,
+        dispatch_profile: Optional[bool] = None,
     ):
         self._work_dir = work_dir
         self._reserved_mem = convert_to_bytes(reserved_mem or 0)
@@ -106,6 +107,9 @@ class Spec:
                     f"{type(service).__name__}"
                 )
         self._service = service
+        self._dispatch_profile = (
+            None if dispatch_profile is None else bool(dispatch_profile)
+        )
 
     @property
     def work_dir(self) -> Optional[str]:
@@ -244,6 +248,20 @@ class Spec:
         ``docs/service.md``). ``None`` (the default) means service
         defaults apply."""
         return self._service
+
+    @property
+    def dispatch_profile(self) -> Optional[bool]:
+        """Coordinator self-profiling: ``True`` arms the bounded
+        ``sys._current_frames()`` sampling profiler over the client/
+        coordinator threads for each compute's duration — collapsed
+        stacks land as ``profile-<compute_id>.folded`` in the
+        flight-recorder bundle, a "dispatch profile" lane joins the
+        Perfetto trace, and ``diagnose`` names the top coordinator
+        stacks. ``None`` defers to the ``CUBED_TPU_DISPATCH_PROFILE``
+        env var (operator override, wins; ``1`` enables) or the off
+        default; off is a true no-op — no thread, no sampling
+        (observability/dispatchprofile.py)."""
+        return self._dispatch_profile
 
     def __repr__(self) -> str:
         return (

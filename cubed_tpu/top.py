@@ -12,6 +12,11 @@ view:
   is live: task-seconds, store bytes read/written, peer bytes, retry
   draw (the service's ``_CostTracker`` fold, also exported as the
   ``tenant_cost_*`` series on ``/metrics``);
+- a **DISPATCH panel** — the control plane's saturation flight deck:
+  dispatch-loop utilization, estimated tasks/sec capacity, queue depth,
+  cumulative serialize/send/lock-wait costs, and per-message-type frame
+  counts on the coordinator link (see docs/observability.md
+  "Control-plane observability");
 - **compute progress** — tasks done/total with a live task rate and ETA
   (rate from the ``compute_tasks_done`` series' trailing window);
 - **recent alerts** — the alert engine's last firings, active ones
@@ -119,6 +124,40 @@ def render(snapshot: dict, width: int = 100) -> str:
         f"breaker {breaker}"
     )
     out.append("")
+
+    # -- control plane: the dispatch-saturation flight deck ------------
+    dispatch = snapshot.get("dispatch") or {}
+    util = dispatch.get(
+        "dispatch_utilization", metrics.get("dispatch_utilization")
+    )
+    if dispatch or util is not None:
+        util_s = f"{util:.0%}" if isinstance(util, (int, float)) else "-"
+        cap = dispatch.get(
+            "dispatch_capacity_estimate",
+            metrics.get("dispatch_capacity_estimate"),
+        )
+        cap_s = f"{cap:.0f}/s" if isinstance(cap, (int, float)) else "-"
+        depth = metrics.get("queue_depth", 0)
+        out.append(
+            f"DISPATCH  utilization {util_s}  capacity ~{cap_s}  "
+            f"queue_depth {depth}  "
+            f"serialize {dispatch.get('dispatch_serialize_s', 0):.2f}s  "
+            f"send {dispatch.get('dispatch_send_s', 0):.2f}s  "
+            f"lock_wait {dispatch.get('dispatch_lock_wait_s', 0):.2f}s"
+        )
+        frames = dispatch.get("frames") or {}
+        for direction in ("sent", "recv"):
+            rows = frames.get(direction)
+            if not rows:
+                continue
+            parts = [
+                f"{mtype} {count} ({_fmt_mem(nbytes)})"
+                for mtype, (count, nbytes) in sorted(
+                    rows.items(), key=lambda kv: -kv[1][0]
+                )[:5]
+            ]
+            out.append(f"  frames {direction}: " + "  ".join(parts))
+        out.append("")
 
     # -- fleet table ---------------------------------------------------
     workers = (fleet.get("workers") or {})

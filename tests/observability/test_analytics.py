@@ -202,6 +202,50 @@ def test_critical_path_synthetic_chain():
     assert d["attribution"]["other"] == pytest.approx(0.5, abs=1e-6)
 
 
+def test_zero_width_resume_tasks_do_not_poison_op_stats():
+    """Regression: a chunk-granular resume marks already-done chunks with
+    zero-width task intervals. Those must stay OUT of the op medians and
+    per-op busy statistics — a flood of zeros would drag the median to ~0
+    and flag every genuinely-executed task a straggler."""
+    us = 1e6
+
+    def task(op, chunk, t0, t1, tid=1):
+        return {
+            "name": op, "cat": "task", "ph": "X", "ts": t0 * us,
+            "dur": (t1 - t0) * us, "tid": tid,
+            "args": {"chunk": chunk, "attempt": 0},
+        }
+
+    events = [
+        {"name": "thread_name", "ph": "M", "tid": 1,
+         "args": {"name": "worker w-0"}},
+        {"name": "compute", "cat": "compute", "ph": "X", "ts": 0.0,
+         "dur": 2.0 * us, "tid": 1, "args": {}},
+    ]
+    # 20 resume-satisfied zero-width intervals ...
+    for i in range(20):
+        events.append(task("op-a", f"('a', {i})", 0.1, 0.1))
+    # ... and 4 real executions, all the same healthy 0.2s duration
+    real_chunks = []
+    for i in range(20, 24):
+        chunk = f"('a', {i})"
+        real_chunks.append(chunk)
+        t0 = 0.2 + (i - 20) * 0.3
+        events.append(task("op-a", chunk, t0, t0 + 0.2))
+    bundle = {
+        "manifest": {"compute_id": "c-zw", "status": "succeeded"},
+        "trace": {"traceEvents": events},
+    }
+    d = analyze(bundle).to_dict()
+    # median is 0.2s (not 0): 0.2 < max(0.05, 3 * 0.2) — no stragglers
+    flagged = [r for r in d["critical_path"] if r["straggler"]]
+    assert not flagged, f"real tasks flagged stragglers: {flagged}"
+    row = d["per_op"]["op-a"]
+    assert row["tasks"] == len(real_chunks)
+    assert row["stragglers"] == 0
+    assert row["busy_s"] == pytest.approx(0.8, rel=1e-3)
+
+
 def test_analyze_rejects_traceless_bundle():
     with pytest.raises(ValueError):
         analyze({"manifest": {"compute_id": "c-x"}, "trace": None})

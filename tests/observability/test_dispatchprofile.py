@@ -1,0 +1,235 @@
+"""Dispatch-profiler tests: off is a TRUE no-op (no thread, no samples),
+arming precedence (env > Spec > off), the bounded folded-stack aggregation
+(cap + overflow counter, flamegraph-ready line format), the TimedLock
+wait accounting, and the bundle + diagnose round-trip of an armed compute.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+from cubed_tpu.diagnose import render_report
+from cubed_tpu.observability import dispatchprofile
+from cubed_tpu.observability.dispatchprofile import (
+    DispatchProfiler,
+    TimedLock,
+    profile_enabled,
+    profile_for,
+    profile_scoped,
+    register_profile,
+)
+from cubed_tpu.observability.flightrecorder import FlightRecorder, load_bundle
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+
+PROFILER_THREAD = "dispatch-profiler"
+
+
+def _profiler_threads() -> list:
+    return [
+        t for t in threading.enumerate() if t.name == PROFILER_THREAD
+    ]
+
+
+# ---------------------------------------------------------------------------
+# arming precedence
+# ---------------------------------------------------------------------------
+
+
+def test_profile_enabled_precedence(monkeypatch):
+    monkeypatch.delenv(dispatchprofile.PROFILE_ENV_VAR, raising=False)
+    assert profile_enabled() is False
+    assert profile_enabled(ct.Spec()) is False
+    assert profile_enabled(ct.Spec(dispatch_profile=True)) is True
+    assert profile_enabled(ct.Spec(dispatch_profile=False)) is False
+    # env wins in BOTH directions over the spec
+    monkeypatch.setenv(dispatchprofile.PROFILE_ENV_VAR, "1")
+    assert profile_enabled(ct.Spec(dispatch_profile=False)) is True
+    monkeypatch.setenv(dispatchprofile.PROFILE_ENV_VAR, "0")
+    assert profile_enabled(ct.Spec(dispatch_profile=True)) is False
+
+
+def test_off_is_a_true_noop(monkeypatch, tmp_path):
+    """Unarmed, profile_scoped spawns nothing: no sampler thread exists
+    during a real compute and nothing registers under the compute id."""
+    monkeypatch.delenv(dispatchprofile.PROFILE_ENV_VAR, raising=False)
+    with profile_scoped(ct.Spec(), "c-noop-unit") as prof:
+        assert prof is None
+        assert not _profiler_threads()
+    assert profile_for("c-noop-unit") is None
+
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+    an = np.arange(16.0).reshape(4, 4)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    r = ct.map_blocks(lambda x: x + 1.0, a, dtype=np.float64)
+
+    seen = []
+
+    def spy(x):
+        seen.extend(_profiler_threads())
+        return x + 1.0
+
+    r = ct.map_blocks(spy, r, dtype=np.float64)
+    np.testing.assert_array_equal(
+        np.asarray(r.compute(executor=AsyncPythonDagExecutor())), an + 2.0
+    )
+    assert not seen, "profiler thread ran on an unarmed compute"
+
+
+# ---------------------------------------------------------------------------
+# sampling, folded format, bounds
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_samples_and_folded_format():
+    prof = DispatchProfiler(hz=200.0).start()
+    deadline = time.time() + 0.5
+    while time.time() < deadline and prof.samples == 0:
+        sum(range(2000))  # keep the main thread visibly busy
+    prof.stop()
+    assert prof.samples > 0
+    lines = prof.folded_lines()
+    assert lines
+    for line in lines:
+        stack, count = line.rsplit(" ", 1)
+        assert int(count) >= 1
+        assert ";" in stack  # thread-name;root-first frames
+    # sorted hottest-first
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in lines]
+    assert counts == sorted(counts, reverse=True)
+    top = prof.top_stacks(3)
+    assert top and sum(s["fraction"] for s in prof.top_stacks(10_000)) <= 1.01
+    assert all({"thread", "leaf", "count"} <= set(s) for s in top)
+    # the Perfetto lane reservoir stays bounded and (ts, label) shaped
+    lane = prof.lane_samples()
+    assert len(lane) <= dispatchprofile.MAX_LANE_SAMPLES
+    assert all(isinstance(ts, float) and ": " in label for ts, label in lane)
+    summ = prof.summary()
+    assert summ["samples"] == prof.samples
+    assert summ["duration_s"] is not None
+    # a double stop is harmless
+    prof.stop()
+
+
+def test_folded_stack_cap_counts_overflow(monkeypatch):
+    """Beyond the cap, new stacks are COUNTED as overflow (metric +
+    attribute), never silently dropped — and the folded dict stops
+    growing."""
+    prof = DispatchProfiler()
+    monkeypatch.setattr(dispatchprofile, "MAX_FOLDED_STACKS", 2)
+    prof._folded = {"t;a": 1, "t;b": 1}
+    reg = get_registry()
+    before = reg.snapshot()
+    # own_tid=-1: no thread is excluded as "self", so the calling thread's
+    # own (novel) stack must overflow against the full cap
+    prof._sample_once(own_tid=-1)
+    assert prof.overflow >= 1
+    assert len(prof._folded) == 2
+    assert reg.snapshot_delta(before).get("dispatch_profile_overflow", 0) >= 1
+    # existing stacks still accumulate
+    prof._folded["t;a"] = 5
+    assert prof.folded()["t;a"] == 5
+
+
+def test_register_profile_is_bounded():
+    for i in range(dispatchprofile.MAX_KEPT_PROFILES + 3):
+        register_profile(f"c-bound-{i}", DispatchProfiler())
+    assert profile_for("c-bound-0") is None  # oldest evicted
+    assert profile_for(
+        f"c-bound-{dispatchprofile.MAX_KEPT_PROFILES + 2}"
+    ) is not None
+    assert profile_for(None) is None
+
+
+# ---------------------------------------------------------------------------
+# TimedLock
+# ---------------------------------------------------------------------------
+
+
+def test_timed_lock_measures_contended_wait_only():
+    lock = TimedLock()
+    reg = get_registry()
+    before = reg.snapshot()
+    lock.reset_thread_wait()
+    with lock:
+        pass  # uncontended: no wait accumulates
+    assert lock.thread_wait_s() == 0.0
+
+    hold = threading.Event()
+    held = threading.Event()
+
+    def holder():
+        with lock:
+            held.set()
+            hold.wait(2.0)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert held.wait(2.0)
+    lock.reset_thread_wait()
+    acquired = threading.Event()
+
+    def waiter():
+        with lock:
+            acquired.set()
+
+    w = threading.Thread(target=waiter)
+    w.start()
+    time.sleep(0.05)
+    hold.set()
+    assert acquired.wait(2.0)
+    t.join(2.0), w.join(2.0)
+    # the WAITER's thread-local saw the wait, this thread's did not
+    assert lock.thread_wait_s() == 0.0
+    assert reg.snapshot_delta(before).get("dispatch_lock_wait_s", 0) > 0
+    # Condition compatibility (the coordinator wraps one around it)
+    cond = threading.Condition(TimedLock())
+    with cond:
+        cond.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# armed compute: bundle + diagnose round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_armed_compute_bundles_folded_profile_and_diagnose(
+    monkeypatch, tmp_path,
+):
+    pytest.importorskip("jax")
+    monkeypatch.setenv(dispatchprofile.PROFILE_ENV_VAR, "1")
+    spec = ct.Spec(work_dir=str(tmp_path / "work"), allowed_mem="500MB")
+    an = np.arange(16.0).reshape(4, 4)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+
+    def slow(x):
+        time.sleep(0.03)  # give the ~75Hz sampler something to see
+        return x + 1.0
+
+    r = ct.map_blocks(slow, a, dtype=np.float64)
+    fr = FlightRecorder(bundle_dir=str(tmp_path / "bundles"), always=True)
+    val = np.asarray(
+        r.compute(executor=AsyncPythonDagExecutor(), callbacks=[fr])
+    )
+    np.testing.assert_array_equal(val, an + 1.0)
+    prof = profile_for(fr.compute_id)
+    assert prof is not None, "armed compute registered no profiler"
+    assert prof._thread is None, "profiler not stopped at compute end"
+    assert prof.samples > 0
+
+    bundle_path = fr.dump()
+    folded_path = f"{bundle_path}/profile-{fr.compute_id}.folded"
+    with open(folded_path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln]
+    assert lines == prof.folded_lines()
+    bundle = load_bundle(bundle_path)
+    summ = bundle["manifest"].get("dispatch_profile")
+    assert summ and summ["samples"] == prof.samples
+    report = render_report(bundle)
+    assert "dispatch (coordinator self-profile" in report
+    assert f"profile-{fr.compute_id}.folded" in report

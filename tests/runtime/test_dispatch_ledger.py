@@ -1,0 +1,356 @@
+"""Dispatch-ledger tests: per-task control-plane stamps on the local and
+distributed executors (monotonic, no double-count across retries/backup
+twins), the ledger-informed ``ready_wait`` vs ``dispatch_overhead`` split
+in ``analyze()``, and the chaos proof that ``dispatch_saturation`` fires
+onto every operator surface (decision ring, ``/snapshot.json``, ``top``).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+from cubed_tpu import top
+from cubed_tpu.observability import TraceCollector, analyze
+from cubed_tpu.observability.alerts import (
+    AlertEngine,
+    DispatchSaturationRule,
+    default_rules,
+)
+from cubed_tpu.observability.collect import decisions_since
+from cubed_tpu.observability.timeseries import TimeSeriesStore
+from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+
+
+@pytest.fixture()
+def spec(tmp_path):
+    return ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+
+
+def _ledgers(col: TraceCollector) -> list:
+    return [r["dispatch"] for r in col._records if r.get("dispatch")]
+
+
+# ---------------------------------------------------------------------------
+# stamps on the wire: local loop, distributed coordinator
+# ---------------------------------------------------------------------------
+
+
+def test_local_ledger_stamps_every_task_monotonically(spec):
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    r = ct.map_blocks(lambda x: x + 1.0, a, dtype=np.float64)
+    col = TraceCollector(trace_dir=None)
+    val = np.asarray(
+        r.compute(executor=AsyncPythonDagExecutor(), callbacks=[col])
+    )
+    np.testing.assert_array_equal(val, an + 1.0)
+    ledgers = _ledgers(col)
+    assert len(ledgers) == len(col._records), "task completed ledger-less"
+    for d in ledgers:
+        assert d["ready_tstamp"] <= d["submitted_tstamp"]
+        assert d["submit_cost_s"] >= 0.0
+
+
+def test_distributed_ledger_carries_coordinator_costs(spec):
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(3, 3), spec=spec)
+    r = ct.map_blocks(lambda x: x * 2.0, a, dtype=np.float64)
+    col = TraceCollector(trace_dir=None)
+    with DistributedDagExecutor(n_local_workers=2, worker_threads=2) as ex:
+        val = np.asarray(r.compute(executor=ex, callbacks=[col]))
+    np.testing.assert_array_equal(val, an * 2.0)
+    ledgers = [
+        d for d in _ledgers(col) if d.get("sent_tstamp") is not None
+    ]
+    assert ledgers, "no task shipped a coordinator-side ledger"
+    for d in ledgers:
+        # the full lifecycle, in clock order: deps-ready -> dequeued ->
+        # on the wire -> result back
+        assert d["ready_tstamp"] <= d["submitted_tstamp"]
+        assert d["submitted_tstamp"] <= d["sent_tstamp"] + 1e-6
+        assert d["sent_tstamp"] <= d["result_recv_tstamp"]
+        for k in ("serialize_s", "send_s", "lock_wait_s", "unpickle_s"):
+            assert d[k] >= 0.0
+        # the coordinator-side parts happened INSIDE the wrapping submit
+        # call, so they can never exceed it (the no-double-count invariant
+        # analyze() relies on when it prefers submit_cost_s)
+        assert (
+            d["serialize_s"] + d["send_s"]
+            <= d["submit_cost_s"] + 5e-3
+        )
+
+
+def test_retried_tasks_carry_the_winning_attempts_ledger(tmp_path):
+    """The ledger on a retried task's end event is the WINNING attempt's
+    own dispatch cost, not an accumulation across attempts: its submit
+    stamp sits just before the winning execution (after the failed
+    attempt and its backoff), while ready_tstamp keeps the task's first
+    deps-ready time — so the pre-start gap is never counted twice."""
+    # fault decisions hash the op/array names, which embed process-global
+    # counters — whether a fixed seed exhausts some task's retry budget
+    # depends on suite order. Accept the first seed whose compute both
+    # survives and retried at least one task.
+    an = np.arange(64.0).reshape(8, 8)
+    retried = None
+    for i, seed in enumerate((11, 23, 47, 91, 137)):
+        spec = ct.Spec(
+            work_dir=str(tmp_path / f"w{i}"), allowed_mem="500MB",
+            fault_injection={"task_failure_rate": 0.25, "seed": seed},
+        )
+        a = ct.from_array(an, chunks=(2, 2), spec=spec)
+        r = ct.map_blocks(lambda x: x + 3.0, a, dtype=np.float64)
+        col = TraceCollector(trace_dir=None)
+        try:
+            val = np.asarray(
+                r.compute(executor=AsyncPythonDagExecutor(), callbacks=[col])
+            )
+        except Exception:
+            continue  # this seed burned through a task's retry budget
+        np.testing.assert_array_equal(val, an + 3.0)
+        recs = [rec for rec in col._records if rec["attempt"] > 0]
+        if recs:
+            retried = recs
+            break
+    assert retried, "no seed produced a survivable retried compute"
+    for rec in retried:
+        d = rec.get("dispatch")
+        assert d is not None
+        # the winning attempt's submit immediately precedes its start
+        assert d["submitted_tstamp"] <= rec["start"] + 1e-6
+        assert rec["start"] - d["submitted_tstamp"] < 2.0
+        # ready_tstamp is the FIRST deps-ready time: the failed attempt
+        # plus its backoff live between the two stamps exactly once
+        assert d["ready_tstamp"] <= d["submitted_tstamp"]
+        # per-attempt cost, not a lifetime accumulation
+        assert d["submit_cost_s"] < 1.0
+
+
+# ---------------------------------------------------------------------------
+# analyze(): the ready_wait vs dispatch_overhead split
+# ---------------------------------------------------------------------------
+
+_US = 1e6
+
+
+def _task(op, chunk, t0, t1, dispatch=None, tid=1):
+    args = {"chunk": chunk, "attempt": 0}
+    if dispatch is not None:
+        args["dispatch"] = dispatch
+    return {
+        "name": op, "cat": "task", "ph": "X", "ts": t0 * _US,
+        "dur": (t1 - t0) * _US, "tid": tid, "args": args,
+    }
+
+
+def _bundle(events, edges):
+    return {
+        "manifest": {"compute_id": "c-ledger", "status": "succeeded",
+                     "chunk_graph": edges},
+        "trace": {"traceEvents": events},
+    }
+
+
+def test_analyze_splits_queue_wait_with_ledger():
+    """A 3-task chain with known gaps: ledgered gaps split into
+    dispatch_overhead (the coordinator's measured cost, clamped to the
+    gap) + ready_wait; the ledger-less task keeps legacy queue_wait. The
+    buckets still tile the wall clock exactly."""
+    events = [
+        {"name": "compute", "cat": "compute", "ph": "X", "ts": 0.0,
+         "dur": 10.0 * _US, "tid": 1, "args": {}},
+        _task("op-a", "('a', 0)", 1.0, 2.0),  # no ledger: legacy bucket
+        # 3s gap, coordinator says 1.2s of it was submit cost
+        _task("op-b", "('b', 0)", 5.0, 6.0,
+              dispatch={"submit_cost_s": 1.2}),
+        # 1s gap, parts-only ledger (serialize+send+lock = 0.4s) and a
+        # cost larger than... no: 0.4 < 1.0 -> 0.4 overhead, 0.6 ready
+        _task("op-c", "('c', 0)", 7.0, 9.0,
+              dispatch={"serialize_s": 0.25, "send_s": 0.1,
+                        "lock_wait_s": 0.05}),
+    ]
+    edges = {
+        "op-a\t('a', 0)": [],
+        "op-b\t('b', 0)": ["op-a\t('a', 0)"],
+        "op-c\t('c', 0)": ["op-b\t('b', 0)"],
+    }
+    d = analyze(_bundle(events, edges)).to_dict()
+    attr = d["attribution"]
+    assert attr["queue_wait"] == pytest.approx(1.0, abs=1e-6)
+    assert attr["dispatch_overhead"] == pytest.approx(1.6, abs=1e-6)
+    assert attr["ready_wait"] == pytest.approx(1.8 + 0.6, abs=1e-6)
+    assert sum(attr.values()) == pytest.approx(10.0, rel=1e-6)
+    rows = {r["op"]: r for r in d["critical_path"]}
+    # rows keep the FULL gap in queue_wait_s (ranking stability) and
+    # expose the split beside it only when a ledger informed it
+    assert rows["op-b"]["queue_wait_s"] == pytest.approx(3.0, abs=1e-6)
+    assert rows["op-b"]["dispatch_overhead_s"] == pytest.approx(1.2)
+    assert rows["op-b"]["ready_wait_s"] == pytest.approx(1.8)
+    assert "dispatch_overhead_s" not in rows["op-a"]
+
+
+def test_analyze_clamps_dispatch_cost_to_the_gap():
+    """A ledger claiming more submit cost than the observed gap cannot
+    mint time: overhead clamps to the gap, ready_wait floors at zero, and
+    the total still tiles the wall clock (the no-double-count proof)."""
+    events = [
+        {"name": "compute", "cat": "compute", "ph": "X", "ts": 0.0,
+         "dur": 4.0 * _US, "tid": 1, "args": {}},
+        _task("op-a", "('a', 0)", 0.5, 1.0),
+        _task("op-b", "('b', 0)", 1.5, 3.0,
+              dispatch={"submit_cost_s": 99.0}),
+    ]
+    edges = {"op-a\t('a', 0)": [], "op-b\t('b', 0)": ["op-a\t('a', 0)"]}
+    d = analyze(_bundle(events, edges)).to_dict()
+    attr = d["attribution"]
+    assert attr["dispatch_overhead"] == pytest.approx(0.5, abs=1e-6)
+    assert attr["ready_wait"] == 0.0
+    assert sum(attr.values()) == pytest.approx(4.0, rel=1e-6)
+
+
+def test_live_compute_attribution_includes_dispatch_and_tiles(spec):
+    """End to end on the real executor: every task ships a ledger, so the
+    legacy queue_wait bucket is empty, dispatch_overhead is nonzero, and
+    the buckets sum to the measured wall clock within the 10% bar."""
+    an = np.arange(36.0).reshape(6, 6)
+    a = ct.from_array(an, chunks=(2, 2), spec=spec)
+    r = a
+    for _ in range(3):
+        r = ct.map_blocks(lambda x: x + 1.0, a, dtype=np.float64)
+    col = TraceCollector(trace_dir=None)
+    np.asarray(
+        r.compute(executor=AsyncPythonDagExecutor(), callbacks=[col],
+                  optimize_graph=False)
+    )
+    d = analyze(col).to_dict()
+    attr = d["attribution"]
+    assert attr["queue_wait"] == 0.0, (
+        "a ledgered compute left time in the legacy bucket"
+    )
+    assert attr["dispatch_overhead"] >= 0.0
+    wall = d["wall_clock_s"]
+    assert abs(sum(attr.values()) - wall) <= 0.10 * wall
+
+
+# ---------------------------------------------------------------------------
+# the dispatch_saturation alert: rule semantics + chaos proof
+# ---------------------------------------------------------------------------
+
+
+def _saturated_store(now: float, draining: bool = False) -> TimeSeriesStore:
+    store = TimeSeriesStore()
+    for i in range(25):
+        ts = now - 25 + i
+        store.record("dispatch_utilization", 0.97, ts=ts)
+        depth = (30 - i) if draining else (5 + i)
+        store.record("queue_depth", depth, ts=ts)
+    return store
+
+
+def test_dispatch_saturation_rule_semantics():
+    now = 1000.0
+    rule = DispatchSaturationRule(window_s=20.0)
+    firing = rule.evaluate(_saturated_store(now), now)
+    assert firing is not None
+    assert firing["metric"] == "dispatch_utilization"
+    assert firing["value"] >= 0.9 and firing["queue_depth"] > 0
+    # a draining backlog is saturated-but-coping: no page
+    assert rule.evaluate(_saturated_store(now, draining=True), now) is None
+    # a dip below the threshold anywhere in the window is not saturation
+    dipped = _saturated_store(now)
+    dipped.record("dispatch_utilization", 0.5, ts=now - 10)
+    assert rule.evaluate(dipped, now) is None
+    # partial window coverage (the loop just got busy) is not saturation
+    fresh = TimeSeriesStore()
+    for i in range(3):
+        fresh.record("dispatch_utilization", 0.99, ts=now - 3 + i)
+        fresh.record("queue_depth", 9, ts=now - 3 + i)
+    assert rule.evaluate(fresh, now) is None
+    assert rule.evaluate(TimeSeriesStore(), now) is None
+
+
+def test_default_rules_include_dispatch_saturation():
+    rules = {r.name: r for r in default_rules()}
+    assert "dispatch_saturation" in rules
+    assert rules["dispatch_saturation"].severity == "critical"
+
+
+@pytest.mark.chaos
+def test_chaos_dispatch_saturation_reaches_every_surface(
+    tmp_path, monkeypatch,
+):
+    """A saturated-coordinator window (pegged utilization, growing queue)
+    fires dispatch_saturation through the REAL engine, and the firing is
+    visible everywhere an operator looks: the decision ring, the
+    ``/snapshot.json`` payload, and a ``top --once``-equivalent render
+    (including the DISPATCH panel itself)."""
+    from cubed_tpu.observability import export
+
+    export.shutdown()
+    monkeypatch.delenv(export.TELEMETRY_PORT_ENV_VAR, raising=False)
+    rt = export.ensure_started(0)
+    try:
+        now = time.time()
+        for i in range(25):
+            ts = now - 25 + i
+            rt.store.record("dispatch_utilization", 0.97, ts=ts)
+            rt.store.record("queue_depth", 5 + i, ts=ts)
+        rule = DispatchSaturationRule(
+            description="coordinator saturated (chaos test)",
+        )
+        rt.alert_engine.rules = [rule]
+        rt.alert_engine._state = {
+            rule.name: {"active": False, "last_fired": 0.0}
+        }
+        fired = rt.alert_engine.tick(now=now)
+        assert [f["rule"] for f in fired] == ["dispatch_saturation"]
+        assert rt.alert_engine.active() == ["dispatch_saturation"]
+        # 1) the decision ring
+        ring = [
+            d for d in decisions_since(0)
+            if d["kind"] == "alert_fired"
+            and d["rule"] == "dispatch_saturation"
+        ]
+        assert ring, "firing missing from the decision ring"
+        # 2) /snapshot.json (the same payload the HTTP endpoint serves)
+        snap = rt.snapshot()
+        assert any(
+            a.get("rule") == "dispatch_saturation" for a in snap["alerts"]
+        )
+        assert "dispatch_saturation" in snap["alerts_active"]
+        # the live gauge would populate snapshot["dispatch"] mid-compute;
+        # make the panel render deterministically here (the dispatch view
+        # wins over metrics when both are present, so inject into both —
+        # earlier tests in this process may have left a stale 0.0 gauge)
+        snap["metrics"]["dispatch_utilization"] = 0.97
+        snap["metrics"]["dispatch_capacity_estimate"] = 120.0
+        snap["dispatch"] = dict(
+            snap.get("dispatch") or {},
+            dispatch_utilization=0.97,
+            dispatch_capacity_estimate=120.0,
+        )
+        # 3) the dashboard frame (what --once prints)
+        frame = top.render(snap)
+        assert "DISPATCH" in frame
+        assert "utilization 97%" in frame
+        assert "dispatch_saturation" in frame
+    finally:
+        export.shutdown()
+
+
+def test_saturation_engine_edge_and_cooldown():
+    now = 1000.0
+    store = _saturated_store(now)
+    engine = AlertEngine(
+        store, rules=[DispatchSaturationRule()], cooldown_s=60.0,
+    )
+    assert len(engine.tick(now=now)) == 1
+    for i in range(5):
+        ts = now + 1 + i
+        store.record("dispatch_utilization", 0.97, ts=ts)
+        store.record("queue_depth", 40 + i, ts=ts)
+    assert engine.tick(now=now + 5) == []  # sustained: inside cooldown
