@@ -41,7 +41,7 @@ _TIMELINE_GROUPS = {
                      "lease_expired", "worker_rejected",
                      "worker_drain_requested", "worker_draining",
                      "worker_drained", "scale_up", "scale_down",
-                     "spawn_died"),
+                     "spawn_died", "coordinator_takeover"),
     # the p2p data plane: per-compute arming, locality-preferred
     # dispatches, and peer-fetch store fallbacks (runtime/transfer.py)
     "data movement": ("peer_transfer", "placement_locality",

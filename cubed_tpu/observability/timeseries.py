@@ -659,11 +659,13 @@ def fleet_view() -> dict:
     aggregate counts the health verdict is made of."""
     workers: Dict[str, dict] = {}
     live = pressured = disconnected = 0
+    epoch = 0
     for coord in live_fleets():
         try:
             snap = coord.stats_snapshot()
         except Exception:
             continue
+        epoch = max(epoch, int(snap.get("epoch") or 0))
         for name, row in (snap.get("workers") or {}).items():
             if not row.get("alive"):
                 continue
@@ -678,6 +680,10 @@ def fleet_view() -> dict:
         "workers_live": live,
         "workers_pressured": pressured,
         "workers_disconnected": disconnected,
+        # the control-plane epoch (max across fleets): bumps on every
+        # coordinator takeover, so a dashboard reading 1+ knows this
+        # fleet was adopted by a successor at least once
+        "epoch": epoch,
         "fleets": len(live_fleets()),
     }
 

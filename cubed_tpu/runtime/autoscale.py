@@ -225,6 +225,15 @@ class Autoscaler:
         """One policy evaluation: backfill, then scale up/down."""
         p = self.policy
         now = time.monotonic()
+        in_takeover = getattr(self.coordinator, "in_takeover", None)
+        if in_takeover is not None and in_takeover():
+            # a successor coordinator is mid-takeover: the adopted fleet
+            # is disconnected-but-leased ON PURPOSE, not a set of holes to
+            # backfill — spawning replacements now would double the fleet
+            # exactly when the real workers are about to re-attach
+            with self._lock:
+                self.stats["autoscaler_ticks"] += 1
+            return
         view = self.coordinator.load_view()
         with self._lock:
             self.stats["autoscaler_ticks"] += 1

@@ -199,6 +199,28 @@ def task_hint_key(m) -> str:
     return _task_chunk_key(m)
 
 
+def task_tag(name: str, m):
+    """The durable ``(op, chunk-key)`` identity of one dispatched task,
+    or None for items with no chunk-shaped identity.
+
+    Derived only from the plan (never from runtime counters), so a
+    successor coordinator's re-submit of the same work computes the SAME
+    tag the crashed epoch recorded in its control log — the join key that
+    lets ``Coordinator.submit(tag=...)`` hand back an adopted in-flight
+    future instead of re-dispatching (see runtime/distributed.py).
+    Rechunk slice-regions use their region identity: their
+    ``_task_chunk_key`` would drop the leading slice and collide.
+    Create-arrays items (LazyZarrArray targets, not out-key tuples) have
+    no stable key — they run untagged, which only costs an idempotent
+    re-run across a takeover."""
+    if not isinstance(m, (tuple, list)):
+        return None
+    try:
+        return (name, task_hint_key(m))
+    except Exception:
+        return None
+
+
 class ChunkGraph:
     """The chunk-level task graph of one finalized plan.
 

@@ -305,6 +305,10 @@ class _WorkerConn:
         #: lost; renewed by every received frame while connected
         self.lease_deadline = float("inf")
         self.disconnect_reason: Optional[str] = None
+        #: coordinator epoch this session last (re)joined under — the
+        #: per-worker EPOCH column in ``top`` (an adopted worker shows the
+        #: prior epoch until its reconnect lands on the successor)
+        self.joined_epoch = 0
 
 
 class Coordinator:
@@ -324,6 +328,8 @@ class Coordinator:
         timeout_strikes: int = 2,
         blob_cache_size: int = 1024,
         lease_s: float = 15.0,
+        control_dir: Optional[str] = None,
+        takeover_grace_s: Optional[float] = None,
     ):
         self._server = socket.create_server((host, port))
         self._server.settimeout(0.2)
@@ -382,7 +388,9 @@ class Coordinator:
             "workers_reconnected": 0, "leases_expired": 0,
             "frames_corrupt": 0, "workers_rejected": 0,
             "peer_locate_requests": 0, "placement_locality_hits": 0,
-            "compute_cancels_sent": 0,
+            "compute_cancels_sent": 0, "coordinator_takeovers": 0,
+            "stale_epoch_frames": 0, "tasks_readopted": 0,
+            "assignments_requeued": 0,
         }
         #: (store, chunk key) -> producing worker, fed by the `produced`
         #: lists piggybacked on sequenced result frames; drives the
@@ -404,6 +412,31 @@ class Coordinator:
         #: decision-ring entries for locality placement are throttled (the
         #: counters carry the totals; the ring is bounded)
         self._locality_decisions_left = 16
+        #: live coordinator failover (runtime/journal.ControlLog): the
+        #: epoch fences frames across coordinator incarnations, and the
+        #: control log is the bounded snapshot a successor pointed at the
+        #: same ``control_dir`` re-adopts the running fleet from
+        self.epoch = 0
+        self.control_dir = control_dir
+        self._control = None
+        self._control_sink = None
+        #: takeover window: until this monotonic deadline, adopted-but-
+        #: silent workers stay leased (the autoscaler must not backfill
+        #: them) and adopted futures wait for worker outbox replays
+        self._takeover_deadline = 0.0
+        #: (op, chunk-key) tag -> adopted Future for the prior epoch's
+        #: in-flight dispatches: ``submit`` with the same tag hands the
+        #: adopted future back instead of re-dispatching (tasks_readopted)
+        self._adopted: Dict[tuple, Future] = {}
+        #: adopted futures actually handed out via submit(); the lease
+        #: loop's takeover backstop requeues any still pending once the
+        #: window closes (a genuinely lost assignment: no replay owned it)
+        self._adopted_issued: list = []
+        #: (conn, task_id, tag, fut) for every adoption, so the backstop
+        #: can clear the stub bookkeeping exactly once
+        self._adopted_pending: list = []
+        if control_dir is not None:
+            self._init_control_plane(takeover_grace_s)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="coordinator-accept", daemon=True
         )
@@ -422,6 +455,150 @@ class Coordinator:
         from ..observability.timeseries import register_fleet
 
         register_fleet(self)
+
+    # -- live failover: control-plane snapshot + fleet adoption ---------
+
+    def _init_control_plane(self, takeover_grace_s: Optional[float]) -> None:
+        """Open the control log; when it already records a prior epoch,
+        this coordinator is a SUCCESSOR: bump the epoch, re-adopt the
+        snapshot's fleet (workers re-attach through their session tokens,
+        in-flight dispatches become adopted futures), fence the old epoch,
+        and advertise the new one in the rendezvous file. Runs inside
+        ``__init__`` before any service thread starts — no locking."""
+        from ..observability.collect import add_decision_sink
+        from .journal import ControlLog, control_log_path, load_control
+
+        prior = load_control(control_log_path(self.control_dir))
+        self._control = ControlLog(self.control_dir)
+        if prior["epoch"] >= 0:
+            self.epoch = prior["epoch"] + 1
+        # successor task ids must never collide with the prior epoch's:
+        # workers keep their assignment-dedup state across a resumed
+        # reconnect, and a colliding id would be silently swallowed as a
+        # duplicate — shift each epoch into its own id space
+        self._next_task_id = self.epoch << 40
+        grace = (
+            float(takeover_grace_s) if takeover_grace_s is not None
+            else max(2 * self.lease_s, 30.0)
+        )
+        if self.epoch > 0:
+            self._takeover_deadline = time.monotonic() + grace
+            self._adopt_fleet(prior, grace)
+        self._control.record_epoch(self.epoch, self.address)
+        self._control.advertise(self.epoch, self.address)
+        get_registry().gauge("coordinator_epoch").set(self.epoch)
+        # mirror connectivity decisions into the control log so the NEXT
+        # successor can stitch a two-epoch timeline; replayed prior-epoch
+        # entries carry an ``epoch`` attr and are not re-mirrored
+        kinds = {
+            "worker_disconnected", "worker_reconnected", "lease_expired",
+            "worker_rejected", "worker_drain_requested", "worker_draining",
+            "worker_drained", "scale_up", "scale_down", "spawn_died",
+        }
+        control, epoch = self._control, self.epoch
+
+        def _sink(entry: dict) -> None:
+            if entry.get("kind") in kinds and entry.get("epoch") is None:
+                control.record_decision(epoch, entry)
+
+        self._control_sink = _sink
+        add_decision_sink(_sink)
+
+    def _adopt_fleet(self, prior: dict, grace: float) -> None:
+        """Rebuild the prior epoch's fleet from its snapshot: every
+        recorded worker becomes a disconnected-but-leased session (same
+        name, same token — the reconnect handshake resumes it), every
+        in-flight dispatch becomes an adopted future keyed by its (op,
+        chunk-key) tag, and the chunk-location registry is replayed.
+        Nothing is re-dispatched here: ``submit`` hands an adopted future
+        back when the DAG re-asks for that task, the worker's outbox
+        replay resolves it, and the lease loop's backstop requeues only
+        what the takeover window proves genuinely lost."""
+        from ..observability.collect import record_decision
+
+        deadline = time.monotonic() + grace
+        for name, rec in prior["workers"].items():
+            hello = {
+                "name": name,
+                "nthreads": rec.get("nthreads", 1),
+                "peer_addr": rec.get("peer_addr"),
+            }
+            conn = _WorkerConn(
+                None, tuple(rec.get("address") or ("?", 0)), hello
+            )
+            conn.token = rec["token"]
+            conn.connected = False
+            conn.disconnect_reason = "adopted after coordinator takeover"
+            conn.lease_deadline = deadline
+            conn.joined_epoch = max(0, prior["epoch"])
+            self._workers.append(conn)
+            self._workers_ever += 1
+            self._worker_names_ever.add(name)
+        by_name = {w.name: w for w in self._workers}
+        readopted = 0
+        for task_id, rec in prior["inflight"].items():
+            tag, conn = rec.get("tag"), by_name.get(rec.get("worker"))
+            if not tag or conn is None:
+                continue
+            fut: Future = Future()
+            conn.outstanding[int(task_id)] = fut
+            self._adopted[tuple(tag)] = fut
+            self._adopted_pending.append((conn, int(task_id), tuple(tag), fut))
+            readopted += 1
+        for loc in prior["chunk_locations"]:
+            wname = loc.get("worker")
+            if wname in by_name:
+                self.chunk_registry.record(
+                    wname,
+                    [(loc.get("store"), loc.get("key"),
+                      int(loc.get("nbytes") or 0))],
+                )
+        self.stats["coordinator_takeovers"] += 1
+        get_registry().counter("coordinator_takeovers").inc()
+        # replay the prior epoch's connectivity decisions (bounded) into
+        # THIS process's ring, keeping their original ``epoch`` attr, so
+        # diagnose renders one stitched two-epoch timeline
+        for entry in prior["decisions"]:
+            kind = entry.get("decision")
+            if not kind:
+                continue
+            attrs = {
+                k: v for k, v in entry.items()
+                if k not in ("kind", "decision", "t", "ts", "version")
+            }
+            record_decision(kind, **attrs)
+        record_decision(
+            "coordinator_takeover", epoch=self.epoch,
+            prior_epoch=prior["epoch"],
+            workers_adopted=len(prior["workers"]),
+            inflight_readopted=readopted, grace_s=round(grace, 3),
+        )
+        self._control.record_decision(self.epoch, {
+            "kind": "coordinator_takeover", "prior_epoch": prior["epoch"],
+            "workers_adopted": len(prior["workers"]),
+            "inflight_readopted": readopted,
+        })
+        logger.warning(
+            "coordinator takeover: epoch %d adopted %d worker(s) and %d "
+            "in-flight dispatch(es) from epoch %d (takeover window %.1fs)",
+            self.epoch, len(prior["workers"]), readopted, prior["epoch"],
+            grace,
+        )
+
+    def in_takeover(self) -> bool:
+        """True while the successor's takeover window is open: adopted
+        workers count as leased capacity (the autoscaler must not treat
+        them as holes to backfill) and adopted futures wait for worker
+        outbox replays before anything is requeued."""
+        return time.monotonic() < self._takeover_deadline
+
+    def _record_worker_control(self, conn: _WorkerConn, pid=None) -> None:
+        if self._control is None:
+            return
+        self._control.record_worker(
+            conn.name, conn.token, conn.nthreads,
+            peer_addr=conn.peer_addr, address=conn.address, pid=pid,
+        )
 
     # -- worker management ---------------------------------------------
 
@@ -493,6 +670,7 @@ class Coordinator:
             )
         conn = _WorkerConn(sock, addr, hello)
         conn.lease_deadline = time.monotonic() + self.lease_s
+        conn.joined_epoch = self.epoch
         # register BEFORE acking — acking first left a window where a fast
         # client's submit() raised NoWorkersError against a worker that
         # believed itself registered — but keep the conn UNROUTABLE
@@ -510,7 +688,7 @@ class Coordinator:
         try:
             send_frame(sock, {
                 "type": "hello_ack", "token": conn.token, "resume": False,
-                "lease_s": self.lease_s,
+                "lease_s": self.lease_s, "epoch": self.epoch,
             })
         except (ConnectionError, OSError) as e:
             logger.warning("hello_ack to %s failed: %s", name, e)
@@ -531,6 +709,9 @@ class Coordinator:
         with self._lock:
             conn.connected = True
             self._worker_joined.notify_all()
+        # fsync'd AFTER the ack: the worker is durably part of the fleet a
+        # successor would adopt only once both sides agree it registered
+        self._record_worker_control(conn, pid=hello.get("pid"))
         threading.Thread(
             target=self._recv_loop,
             args=(conn, sock, conn.generation),
@@ -560,16 +741,66 @@ class Coordinator:
             gen = conn.generation
             conn.lease_deadline = time.monotonic() + self.lease_s
             conn.disconnect_reason = None
+            conn.joined_epoch = self.epoch
             self.stats["workers_reconnected"] += 1
+            # reconcile against what the worker actually HOLDS (its
+            # assignment-dedup set plus unacked outbox frames, carried on
+            # the resume hello): an assignment this side sent that the
+            # dead link ate is outstanding here but unknown there — no
+            # replay will ever resolve it, and the renewed lease would
+            # shield the hole forever. Requeue exactly those.
+            requeue = []
+            holding = hello.get("holding") if hello else None
+            if holding is not None:
+                held = set(holding)
+                issued = {id(f) for f in self._adopted_issued}
+                for tid in [t for t in conn.outstanding if t not in held]:
+                    fut = conn.outstanding.pop(tid)
+                    conn.deadlines.pop(tid, None)
+                    conn.ghost_ids.discard(tid)
+                    if fut.done():
+                        continue
+                    entry = next(
+                        (e for e in self._adopted_pending
+                         if e[0] is conn and e[1] == tid), None,
+                    )
+                    if entry is not None:
+                        # an adopted dispatch the prior epoch logged but
+                        # never delivered: settle it now instead of
+                        # waiting out the takeover window
+                        self._adopted_pending.remove(entry)
+                        if id(fut) not in issued:
+                            # never handed out via submit: forget the tag
+                            # so the DAG dispatches it fresh
+                            self._adopted.pop(entry[2], None)
+                            continue
+                        self._adopted_issued = [
+                            f for f in self._adopted_issued if f is not fut
+                        ]
+                    requeue.append((tid, fut))
+                self.stats["assignments_requeued"] += len(requeue)
             outstanding = len(conn.outstanding)
             self._worker_joined.notify_all()
-        try:
-            old_sock.close()
-        except OSError:
-            pass
+        if old_sock is not None:  # None: an adopted stub re-attaching
+            try:
+                old_sock.close()
+            except OSError:
+                pass
+        for tid, fut in requeue:
+            _fail_future(fut, WorkerLostError(
+                f"assignment {tid} never reached worker {conn.name} "
+                "(lost with the dead link); requeueing"
+            ))
+        if requeue:
+            get_registry().counter("assignments_requeued").inc(len(requeue))
+            logger.warning(
+                "worker %s reconnected without %d assignment(s) this side "
+                "thought it held; requeued them", conn.name, len(requeue),
+            )
         get_registry().counter("workers_reconnected").inc()
         record_decision(
             "worker_reconnected", worker=conn.name, outstanding=outstanding,
+            requeued=len(requeue),
         )
         logger.warning(
             "worker %s reconnected (%d in-flight tasks kept under its "
@@ -578,11 +809,15 @@ class Coordinator:
         try:
             send_frame(sock, {
                 "type": "hello_ack", "token": conn.token, "resume": True,
-                "lease_s": self.lease_s,
+                "lease_s": self.lease_s, "epoch": self.epoch,
             }, conn.send_lock)
         except (ConnectionError, OSError) as e:
             self._on_disconnect(conn, f"hello_ack failed: {e}", gen=gen)
             return True  # adopted (and immediately disconnected again)
+        # refresh the snapshot row (the peer address may have moved with
+        # the new route, and a successor's log needs this worker recorded
+        # under ITS epoch too)
+        self._record_worker_control(conn, pid=(hello or {}).get("pid"))
         threading.Thread(
             target=self._recv_loop,
             args=(conn, sock, gen),
@@ -603,7 +838,8 @@ class Coordinator:
                 ever = self._workers_ever
             raise TimeoutError(
                 f"only {self.n_workers} of {count} workers joined the "
-                f"coordinator at {host}:{port} within {timeout}s "
+                f"coordinator at {host}:{port} (epoch {self.epoch}) "
+                f"within {timeout}s "
                 f"({ever} ever joined, {self.stats['workers_lost']} lost); "
                 "start workers with 'python -m cubed_tpu.runtime.worker "
                 f"{host}:{port}' on each host, or raise "
@@ -678,10 +914,15 @@ class Coordinator:
                 self._departed.popitem(last=False)
             conn.outstanding.clear()
             conn.deadlines.clear()
-        try:
-            conn.sock.close()
-        except OSError:
-            pass
+        if conn.sock is not None:  # None: an adopted stub that never re-attached
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        if self._control is not None:
+            # fsync'd: a successor must not re-adopt a worker this epoch
+            # already declared gone (its tasks were requeued HERE)
+            self._control.record_worker_gone(conn.name)
         # a departed worker can no longer serve peer fetches: drop its
         # chunk locations so readers go straight to the store instead of
         # timing out against a corpse
@@ -732,6 +973,22 @@ class Coordinator:
             # freshly installed socket closed by this stale failure
             gen = conn.generation
         if self._closed.is_set() or conn.draining:
+            if (
+                conn.draining
+                and not self._closed.is_set()
+                and not conn.outstanding
+            ):
+                # the drain already finished every task (nothing in
+                # flight) but the link died before the ``drained`` frame
+                # landed — e.g. a reconnect loop that exhausted its
+                # retries mid-drain. Seal the drain instead of counting a
+                # worker loss: the departure is exactly as clean as if
+                # the frame had arrived
+                self._on_drained(
+                    conn,
+                    {"reason": "drain-complete (link lost after completion)"},
+                )
+                return
             # shutdown, or a drainer that died mid-drain: the old semantics
             # (and the old diagnostics, e.g. the drain hard-kill hint)
             self._drop_worker(conn, reason)
@@ -825,6 +1082,39 @@ class Coordinator:
                 record_decision(
                     "lease_expired", worker=conn.name, reason=reason,
                 )
+            # takeover backstop: once the window closes, any adopted
+            # future still pending was a genuinely lost assignment — no
+            # surviving worker replayed its result and no lease expiry
+            # settled it. Requeue issued ones exactly once (_fail_future's
+            # done-guard absorbs a racing late replay); forget the rest so
+            # a later submit of that tag dispatches fresh.
+            if self._adopted_pending and not self.in_takeover():
+                with self._lock:
+                    pending = self._adopted_pending
+                    self._adopted_pending = []
+                    issued = {id(f) for f in self._adopted_issued}
+                    self._adopted_issued = []
+                    requeue = []
+                    for conn, tid, tag, fut in pending:
+                        if fut.done():
+                            continue
+                        conn.outstanding.pop(tid, None)
+                        conn.deadlines.pop(tid, None)
+                        if id(fut) in issued:
+                            requeue.append((tid, fut))
+                        else:
+                            self._adopted.pop(tag, None)
+                for tid, fut in requeue:
+                    _fail_future(fut, WorkerLostError(
+                        f"adopted task {tid} from the prior epoch never "
+                        "replayed a result inside the takeover window; "
+                        "requeueing as worker loss"
+                    ))
+                if requeue:
+                    logger.warning(
+                        "takeover window closed: requeued %d adopted "
+                        "task(s) with no replayed result", len(requeue),
+                    )
 
     def _count_frame(self, direction: str, mtype, nbytes: int) -> None:
         """Fold one link frame into the per-message-type breakdown and the
@@ -869,6 +1159,20 @@ class Coordinator:
                 get_registry().counter(
                     "dispatch_unpickle_s"
                 ).inc(unpickle_s)
+                fepoch = msg.get("epoch")
+                if fepoch is not None and int(fepoch) != self.epoch:
+                    # a frame stamped by another coordinator incarnation:
+                    # fence it — neither applied NOR acked, since an ack
+                    # under this epoch would clear an outbox frame the
+                    # epoch that owns it never processed
+                    with self._lock:
+                        self.stats["stale_epoch_frames"] += 1
+                    get_registry().counter("stale_epoch_frames").inc()
+                    logger.warning(
+                        "fenced stale-epoch frame from %s (frame epoch "
+                        "%s, ours %d)", conn.name, fepoch, self.epoch,
+                    )
+                    continue
                 with self._lock:
                     if conn.generation != gen:
                         return  # a reconnect superseded this socket
@@ -894,7 +1198,8 @@ class Coordinator:
                     # the very frame the partition ate
                     try:
                         send_frame(
-                            conn.sock, {"type": "ack", "seq": seq},
+                            conn.sock,
+                            {"type": "ack", "seq": seq, "epoch": self.epoch},
                             conn.send_lock,
                         )
                     except (ConnectionError, OSError):
@@ -910,6 +1215,10 @@ class Coordinator:
                         # the future resolves so a consumer dispatched by
                         # this completion can already locate the bytes
                         self.chunk_registry.record(conn.name, produced)
+                        if self._control is not None:
+                            self._control.record_chunk_locations(
+                                conn.name, produced
+                            )
                     with self._lock:
                         fut = conn.outstanding.pop(msg["task_id"], None)
                         conn.deadlines.pop(msg["task_id"], None)
@@ -919,6 +1228,11 @@ class Coordinator:
                         conn.ghost_ids.discard(msg["task_id"])
                     if fut is None or fut.done():
                         continue  # duplicate/late reply, or a cancelled twin
+                    if self._control is not None:
+                        # flushed, not fsync'd: losing this line costs one
+                        # idempotent re-run after the NEXT takeover, never
+                        # correctness
+                        self._control.record_done(msg["task_id"])
                     if mtype == "result":
                         stats = msg.get("stats", {}) or {}
                         disp = getattr(fut, "_dispatch", None)
@@ -1030,6 +1344,7 @@ class Coordinator:
                                     "type": "heartbeat_echo",
                                     "t0": msg["t0"],
                                     "t_coord": time.time(),
+                                    "epoch": self.epoch,
                                 },
                                 conn.send_lock,
                             )
@@ -1113,6 +1428,7 @@ class Coordinator:
                             "req_id": msg.get("req_id"),
                             "worker": wname if peer_addr is not None else None,
                             "addr": peer_addr,
+                            "epoch": self.epoch,
                         }, conn.send_lock)
                     except (ConnectionError, OSError):
                         pass  # the reader's locate times out -> store read
@@ -1213,7 +1529,8 @@ class Coordinator:
         try:
             send_frame(
                 conn.sock,
-                {"type": "drain", "grace_s": grace_s, "reason": reason},
+                {"type": "drain", "grace_s": grace_s, "reason": reason,
+                 "epoch": self.epoch},
                 conn.send_lock,
             )
         except (ConnectionError, OSError) as e:
@@ -1332,7 +1649,7 @@ class Coordinator:
 
     def submit(
         self, _stats_wrapper, function, task_input, *, config=None,
-        locality=None,
+        locality=None, tag=None,
     ) -> Future:
         """Ship one task to the least-loaded live worker — or, when
         ``locality`` names the task's input chunks ``[(store, key), ...]``
@@ -1343,7 +1660,22 @@ class Coordinator:
         The first positional argument exists to mirror
         ``pool.submit(execute_with_stats, function, input, config=...)``; the
         wrapper always runs worker-side.
+
+        ``tag`` is the task's durable ``(op, chunk-key)`` identity. After a
+        coordinator takeover, a submit whose tag matches a dispatch adopted
+        from the prior epoch returns the adopted future — the worker may
+        still be running that task (or its replayed result already resolved
+        it), so re-dispatching would re-run completed work.
         """
+        if tag is not None and self._adopted:
+            with self._lock:
+                adopted = self._adopted.pop(tuple(tag), None)
+                if adopted is not None:
+                    self.stats["tasks_readopted"] += 1
+                    self._adopted_issued.append(adopted)
+            if adopted is not None:
+                get_registry().counter("tasks_readopted").inc()
+                return adopted
         # dispatch ledger: zero the hot-lock accumulator for THIS submit,
         # and fold the op-blob pickle (cached after first use) into the
         # serialize cost — submit runs inline on the dispatch loop, so
@@ -1418,7 +1750,8 @@ class Coordinator:
                         )
                     raise NoWorkersError(
                         f"cannot submit task: no live workers connected to "
-                        f"coordinator {host}:{port}; {hint}"
+                        f"coordinator {host}:{port} (epoch {self.epoch}); "
+                        f"{hint}"
                     )
                 if (
                     self.backfill_grace_s > 0
@@ -1510,7 +1843,7 @@ class Coordinator:
             from . import cancellation as cancel_mod
             from . import memory
             from . import transfer as p2p
-            from .faults import wire_config
+            from .faults import get_injector, wire_config
 
             if locality_note is not None:
                 record_decision(
@@ -1520,6 +1853,7 @@ class Coordinator:
             msg = {
                 "type": "task",
                 "task_id": task_id,
+                "epoch": self.epoch,
                 "blob_id": blob_id,
                 "blob": blob if first_use else None,
                 "input": task_input,
@@ -1607,6 +1941,21 @@ class Coordinator:
             self.stats["tasks_sent"] += 1
             if first_use:
                 self.stats["blobs_sent"] += 1
+            if self._control is not None and tag is not None:
+                # the dispatch-frontier record a successor folds: which
+                # (op, chunk-key) was in flight where (flushed — a lost
+                # line costs one idempotent re-run; untagged tasks have no
+                # durable identity to readopt, so they aren't recorded)
+                self._control.record_dispatch(task_id, tag, conn.name)
+            inj = get_injector()
+            if inj is not None and inj.coordinator_dispatch_tick(self.epoch):
+                # chaos hook: the coordinator process hard-exits after the
+                # Nth real dispatch (crash / crash-during-takeover knobs)
+                logger.warning(
+                    "coordinator: injected crash after dispatch %d "
+                    "(epoch %d)", task_id, self.epoch,
+                )
+                os._exit(137)
             return fut
 
     def broadcast_cancel(
@@ -1631,6 +1980,7 @@ class Coordinator:
             "type": "compute_cancel",
             "compute": compute_id,
             "reason": reason,
+            "epoch": self.epoch,
         })
         for conn in conns:
             try:
@@ -1653,12 +2003,14 @@ class Coordinator:
         Departed workers keep their final row (``alive: False`` + drop
         reason) so worker loss remains visible in the snapshot."""
         out: dict = dict(self.stats)
+        out["epoch"] = self.epoch
         with self._lock:
             workers: dict = {name: dict(row) for name, row in self._departed.items()}
             for w in self._workers:
                 workers[w.name] = {
                     "alive": w.alive,
                     "connected": w.connected,
+                    "epoch": w.joined_epoch,
                     "nthreads": w.nthreads,
                     "outstanding": len(w.outstanding),
                     "ghosts": len(w.ghost_ids),
@@ -1693,11 +2045,23 @@ class Coordinator:
             # wake any submit() blocked on a backfill wait: closed wins
             self._worker_joined.notify_all()
         for conn in workers:
-            try:
-                send_frame(conn.sock, {"type": "shutdown"}, conn.send_lock)
-            except (ConnectionError, OSError):
-                pass
+            if conn.sock is not None:
+                try:
+                    send_frame(
+                        conn.sock,
+                        {"type": "shutdown", "epoch": self.epoch},
+                        conn.send_lock,
+                    )
+                except (ConnectionError, OSError):
+                    pass
             self._drop_worker(conn, "shutdown")
+        if self._control_sink is not None:
+            from ..observability.collect import remove_decision_sink
+
+            remove_decision_sink(self._control_sink)
+            self._control_sink = None
+        if self._control is not None:
+            self._control.close()
         try:
             self._server.close()
         except OSError:
@@ -1792,8 +2156,17 @@ class _WorkerLink:
         self.sock = sock
         self.lock = threading.Lock()
         self.seq = 0
-        #: (seq, enqueue-monotonic, frame bytes) — refreshed at replay so
-        #: the staleness watchdog measures THIS link's silence
+        #: the coordinator epoch this link last handshook under (from the
+        #: hello_ack). Every outbound frame is stamped with it AT FRAME
+        #: TIME, and outbox replays re-stamp — "replay the unacked outbox
+        #: to the new epoch" is what lets a successor accept a result the
+        #: crashed epoch dispatched. Inbound frames with an OLDER epoch
+        #: (a zombie prior coordinator) are fenced by the recv loop
+        self.epoch = 0
+        #: (seq, enqueue-monotonic, message dict) — dicts, not frames, so
+        #: a replay can re-stamp the current epoch; enqueue times are
+        #: refreshed at replay so the staleness watchdog measures THIS
+        #: link's silence
         self.outbox: deque = deque()
         self.outbox_cap = int(outbox_cap)
         #: monotonic time of the last frame actually delivered to us —
@@ -1804,6 +2177,16 @@ class _WorkerLink:
         self.token: Optional[str] = None
         #: the coordinator's advertised lease window (reconnect sizing hint)
         self.lease_hint: Optional[float] = None
+
+    def held_task_ids(self) -> set:
+        """Task ids named by an unacked important frame in the outbox:
+        a replay will re-deliver their result/error/abandoned outcome, so
+        the coordinator may keep waiting on them."""
+        with self.lock:
+            return {
+                m["task_id"] for (_s, _t, m) in self.outbox
+                if "task_id" in m
+            }
 
     def send(self, msg: dict, important: bool = False) -> bool:
         """Frame and send one message. Important frames are sequenced and
@@ -1817,9 +2200,9 @@ class _WorkerLink:
             if important:
                 self.seq += 1
                 msg = dict(msg, seq=self.seq)
-            data = frame_bytes(msg)
+            data = frame_bytes(dict(msg, epoch=self.epoch))
             if important:
-                self.outbox.append((self.seq, time.monotonic(), data))
+                self.outbox.append((self.seq, time.monotonic(), msg))
                 while len(self.outbox) > self.outbox_cap:
                     self.outbox.popleft()
                     get_registry().counter("outbox_dropped").inc()
@@ -1882,12 +2265,49 @@ class _WorkerLink:
             # refresh enqueue stamps: the watchdog must measure the NEW
             # link's progress, not how long the partition lasted
             self.outbox = deque(
-                (seq, now, data) for seq, _t, data in self.outbox
+                (seq, now, msg) for seq, _t, msg in self.outbox
             )
-            for _seq, _t, data in self.outbox:
-                sock.sendall(data)
+            for _seq, _t, msg in self.outbox:
+                # re-stamped with the CURRENT epoch: a successor fences
+                # frames from the epoch that dispatched these tasks, so a
+                # replay must speak the epoch it handshook
+                sock.sendall(frame_bytes(dict(msg, epoch=self.epoch)))
             self.sock = sock
         self.last_rx = now
+
+
+def _give_up_message(
+    wname: str, endpoint: str, epoch: int, waited_s: float,
+    rendezvous: Optional[str] = None,
+) -> str:
+    """The worker's reconnect-give-up diagnostic. A worker used to die of
+    a bare socket error here, which is undebuggable from its own log —
+    name the coordinator endpoint and the last epoch this worker was
+    joined under, plus a ``NoWorkersError``-style hint table."""
+    lines = [
+        f"worker {wname!r}: could not reach the coordinator at {endpoint} "
+        f"(last epoch {epoch}) for {waited_s:.0f}s; giving up.",
+        "Likely causes: the coordinator process crashed or was killed "
+        "(check its log / exit code)",
+        f"the coordinator host or network path is down (try dialing "
+        f"{endpoint} from this host)",
+    ]
+    if rendezvous:
+        lines.append(
+            f"no successor advertised a takeover in {rendezvous!r} — if a "
+            "replacement coordinator is expected, check that it runs with "
+            "the same control_dir"
+        )
+    else:
+        lines.append(
+            "no rendezvous file is configured (--rendezvous), so a "
+            "restarted coordinator cannot re-adopt this worker"
+        )
+    lines.append(
+        "raise --reconnect-give-up if the control plane can legitimately "
+        "stay dark longer than this window"
+    )
+    return "; ".join(lines)
 
 
 def run_worker(
@@ -1896,6 +2316,7 @@ def run_worker(
     name: Optional[str] = None,
     drain_grace_s: float = 10.0,
     reconnect_give_up_s: float = 30.0,
+    rendezvous: Optional[str] = None,
 ) -> None:
     """Connect to ``host:port`` and execute tasks until shutdown/EOF.
 
@@ -1918,7 +2339,14 @@ def run_worker(
     partition) is detected by the heartbeat watchdog: no frames received
     for a few seconds, or an important frame unacked past its window,
     forces the same reconnect path. Only after ``reconnect_give_up_s`` of
-    failed attempts does the worker exit."""
+    failed attempts does the worker exit.
+
+    ``rendezvous`` names the coordinator's advertisement file (see
+    ``runtime/journal.write_rendezvous``): the reconnect loop re-reads it
+    each attempt, re-targets its dial at a successor's address, and — for
+    as long as the advertisement names a NEWER epoch than the one this
+    worker last joined (an open takeover window) — the give-up clock is
+    suspended, so a fleet mid-takeover never dies of impatience."""
     import cloudpickle
     import signal as _signal
     from concurrent.futures import ThreadPoolExecutor
@@ -1938,6 +2366,13 @@ def run_worker(
     from .utils import execute_with_stats
 
     host, _, port = coordinator.rpartition(":")
+    #: mutable dial target: a rendezvous advertisement re-points it at a
+    #: successor coordinator's address mid-reconnect
+    dial = {"host": host or "127.0.0.1", "port": int(port)}
+    #: highest epoch ever seen advertised — each NEW epoch earns the
+    #: reconnect loop one fresh give-up window, bounding how long a worker
+    #: chases successors that never accept it
+    adv_seen = {"epoch": -1}
     wname = name or f"{socket.gethostname()}:{os.getpid()}"
     #: the p2p data plane's worker half: chunk cache + serving socket. The
     #: listener is cheap and always started (its address must ride the
@@ -1995,7 +2430,7 @@ def run_worker(
         ):
             raise ConnectionError("injected network partition")
         s = socket.create_connection(
-            (host or "127.0.0.1", int(port)), timeout=10
+            (dial["host"], dial["port"]), timeout=10
         )
         try:
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -2016,6 +2451,15 @@ def run_worker(
                 hello["peer_addr"] = peer_rt.advertised_addr(local_ip)
             if link.token is not None:
                 hello["token"] = link.token
+                # every task id this session ever accepted (the dedup
+                # set covers queued, running, and finished work) plus
+                # unacked outbox frames: the coordinator reconciles its
+                # outstanding set against this and requeues assignments
+                # the dead link ate — nothing here will ever complete
+                # an assignment we never received
+                hello["holding"] = sorted(
+                    set(seen_tasks) | link.held_task_ids()
+                )
             send_frame(s, hello)
             ack = recv_frame(s)
             if isinstance(ack, dict) and ack.get("type") == "hello_reject":
@@ -2031,6 +2475,10 @@ def run_worker(
                 # ids restart at 0): stale dedup state must not swallow
                 # the new session's assignments
                 seen_tasks.clear()
+            # the epoch must be current BEFORE adopt replays the outbox:
+            # replayed frames are re-stamped with it, and a successor
+            # fences anything stamped by the epoch that crashed
+            link.epoch = int(ack.get("epoch") or 0)
             link.adopt(s, ack.get("token"), resumed)
         except BaseException:
             try:
@@ -2039,22 +2487,66 @@ def run_worker(
                 pass
             raise
 
+    def _check_rendezvous() -> bool:
+        """Re-read the successor advertisement, re-targeting the dial at
+        its address. True exactly once per newly advertised epoch newer
+        than the one this worker last joined — an open takeover window,
+        which earns the reconnect loop a fresh give-up allowance."""
+        if rendezvous is None:
+            return False
+        from .journal import read_rendezvous
+
+        adv = read_rendezvous(rendezvous)
+        if adv is None:
+            return False
+        if adv["addr"] != (dial["host"], dial["port"]):
+            logger.warning(
+                "worker %s: rendezvous advertises epoch %d at %s:%s; "
+                "re-targeting the reconnect", wname, adv["epoch"],
+                adv["addr"][0], adv["addr"][1],
+            )
+            dial["host"], dial["port"] = adv["addr"]
+        if adv["epoch"] > link.epoch and adv["epoch"] > adv_seen["epoch"]:
+            adv_seen["epoch"] = adv["epoch"]
+            return True
+        return False
+
     def _reconnect() -> bool:
         """Re-establish the coordinator link after a drop, with backoff,
-        for up to ``reconnect_give_up_s``. In-flight tasks keep running
-        throughout; success replays the outbox. False = give up (exit)."""
+        for up to ``reconnect_give_up_s`` — suspended (restarted) each
+        time the rendezvous file advertises a NEW successor epoch. In-
+        flight tasks keep running throughout; success replays the outbox.
+        False = give up (exit)."""
         give_up = time.monotonic() + max(0.0, reconnect_give_up_s)
         delay = 0.05
         while not stop.is_set() and not drain["on"]:
+            if _check_rendezvous():
+                # a successor is mid-takeover: dying now would abandon a
+                # fleet that is about to be re-adopted
+                give_up = time.monotonic() + max(0.0, reconnect_give_up_s)
             if time.monotonic() > give_up:
                 logger.error(
-                    "worker %s: could not reach the coordinator for %.0fs; "
-                    "giving up", wname, reconnect_give_up_s,
+                    "%s",
+                    _give_up_message(
+                        wname, f"{dial['host']}:{dial['port']}", link.epoch,
+                        reconnect_give_up_s, rendezvous,
+                    ),
                 )
                 return False
             try:
                 _connect()
             except _RegistrationRejected as e:
+                if rendezvous is not None:
+                    # a successor can reject transiently while its own
+                    # adoption settles; the rendezvous window (give_up
+                    # above) decides when chasing it stops being worth it
+                    logger.warning(
+                        "worker %s: registration rejected (%s); retrying "
+                        "under the rendezvous window", wname, e,
+                    )
+                    time.sleep(delay)
+                    delay = min(delay * 2, 1.0)
+                    continue
                 logger.error(
                     "worker %s: registration rejected (%s); exiting",
                     wname, e,
@@ -2581,6 +3073,18 @@ def run_worker(
                 logger.warning(
                     "worker %s: non-dict frame %r ignored", wname,
                     type(msg).__name__,
+                )
+                continue
+            mepoch = msg.get("epoch")
+            if mepoch is not None and int(mepoch) < link.epoch:
+                # a zombie prior-epoch coordinator still speaking on an
+                # old socket: fence its frames — above all its acks,
+                # which must not clear outbox results the successor epoch
+                # has never processed
+                get_registry().counter("stale_epoch_frames").inc()
+                logger.warning(
+                    "worker %s: fenced stale-epoch frame (%r, epoch %s < "
+                    "%d)", wname, msg.get("type"), mepoch, link.epoch,
                 )
                 continue
             inj = get_injector()

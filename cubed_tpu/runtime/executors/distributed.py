@@ -39,6 +39,7 @@ from ..dataflow import (
     effective_scheduler,
     record_scheduler_mode,
     task_hint_key,
+    task_tag,
 )
 from ..distributed import Coordinator, NoWorkersError
 from ..memory import AdmissionController
@@ -116,6 +117,8 @@ class DistributedDagExecutor(DagExecutor):
         batch_size: Optional[int] = None,
         compute_arrays_in_parallel: bool = False,
         retry_policy: Optional[RetryPolicy] = None,
+        control_dir: Optional[str] = None,
+        takeover_grace_s: Optional[float] = None,
         **kwargs,
     ):
         if n_local_workers is None and listen is None:
@@ -162,6 +165,14 @@ class DistributedDagExecutor(DagExecutor):
         self.batch_size = batch_size
         self.compute_arrays_in_parallel = compute_arrays_in_parallel
         self.retry_policy = retry_policy
+        #: control-plane durability directory (runtime/journal.py
+        #: ControlLog): the coordinator persists its epoch, worker roster,
+        #: and dispatch frontier there and advertises its address in
+        #: ``rendezvous.json``. A fresh executor pointed at the same dir
+        #: after a coordinator crash comes up as the next epoch and adopts
+        #: the still-running fleet instead of cold-starting.
+        self.control_dir = control_dir
+        self.takeover_grace_s = takeover_grace_s
         self.kwargs = kwargs
         self._coordinator: Optional[Coordinator] = None
         #: append-only spawn log: worker ``local-<i>`` is ``_procs[i]``
@@ -207,7 +218,9 @@ class DistributedDagExecutor(DagExecutor):
             coord = Coordinator(host or "0.0.0.0", int(port or 0),
                                 task_timeout=self.task_timeout,
                                 timeout_strikes=self.timeout_strikes,
-                                lease_s=self.lease_s)
+                                lease_s=self.lease_s,
+                                control_dir=self.control_dir,
+                                takeover_grace_s=self.takeover_grace_s)
             logger.info(
                 "coordinator listening on %s:%s; waiting for %d workers",
                 coord.address[0], coord.address[1], self.min_workers,
@@ -215,7 +228,9 @@ class DistributedDagExecutor(DagExecutor):
         else:
             coord = Coordinator("127.0.0.1", 0, task_timeout=self.task_timeout,
                                 timeout_strikes=self.timeout_strikes,
-                                lease_s=self.lease_s)
+                                lease_s=self.lease_s,
+                                control_dir=self.control_dir,
+                                takeover_grace_s=self.takeover_grace_s)
         self._coordinator = coord
         initial_names: list = []
         if self.n_local_workers:
@@ -271,6 +286,12 @@ class DistributedDagExecutor(DagExecutor):
         # executor's configured grace ride the command line
         if "CUBED_TPU_DRAIN_GRACE_S" not in os.environ:
             cmd += ["--drain-grace", str(self.drain_grace_s)]
+        if self.control_dir is not None:
+            # workers chase a successor coordinator through the
+            # advertisement file instead of dying with the old socket
+            from ..journal import rendezvous_path
+
+            cmd += ["--rendezvous", rendezvous_path(self.control_dir)]
         with self._procs_lock:
             i = len(self._procs)
             name = f"local-{i}"
@@ -577,7 +598,7 @@ class DistributedDagExecutor(DagExecutor):
                     )
                     mappable, _ = pending_mappable(name, node, resume, state)
                     map_unordered(
-                        _OpPool(coord, pipeline),
+                        _OpPool(coord, pipeline, name),
                         pipeline.function,
                         mappable,
                         retry_policy=policy,
@@ -623,13 +644,19 @@ class _OpPool:
     coordinator (map_unordered calls
     ``pool.submit(execute_with_stats, function, input, config=...)``)."""
 
-    def __init__(self, coordinator: Coordinator, pipeline):
+    def __init__(self, coordinator: Coordinator, pipeline, op_name=None):
         self.coordinator = coordinator
         self.pipeline = pipeline
+        self.op_name = op_name
 
     def submit(self, stats_wrapper, function, task_input, *, config=None):
+        tag = (
+            task_tag(self.op_name, task_input)
+            if self.op_name is not None
+            else None
+        )
         return self.coordinator.submit(
-            stats_wrapper, function, task_input, config=config
+            stats_wrapper, function, task_input, config=config, tag=tag
         )
 
 
@@ -661,5 +688,5 @@ class _InterleavedPool:
             locality = self.locality_hints.get((name, task_hint_key(m)))
         return self.coordinator.submit(
             stats_wrapper, pipeline.function, m, config=pipeline.config,
-            locality=locality,
+            locality=locality, tag=task_tag(name, m),
         )

@@ -177,6 +177,14 @@ class FaultConfig:
     peer_delay_s: float = 0.05
     peer_corrupt_rate: float = 0.0
     peer_reset_rate: float = 0.0
+    #: coordinator-side crash knobs (live-failover chaos): the coordinator
+    #: PROCESS hard-exits (137) once its per-process count of real task
+    #: dispatches reaches the threshold (>=1, one-shot). The takeover
+    #: variant fires only in a SUCCESSOR (epoch > 0) — killing the control
+    #: plane again mid-takeover, the double-failure a second successor
+    #: must absorb
+    coordinator_crash_after_dispatches: int = 0
+    coordinator_takeover_crash_after_dispatches: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "FaultConfig":
@@ -225,6 +233,8 @@ class FaultConfig:
             or self.peer_delay_rate
             or self.peer_corrupt_rate
             or self.peer_reset_rate
+            or self.coordinator_crash_after_dispatches
+            or self.coordinator_takeover_crash_after_dispatches
         )
 
 
@@ -495,6 +505,30 @@ class FaultInjector:
         ):
             return "preempt"
         return None
+
+    # -- coordinator (live-failover chaos) -------------------------------
+
+    def coordinator_dispatch_tick(self, epoch: int) -> bool:
+        """Called once per REAL task dispatch on the coordinator; True
+        exactly when this process should hard-exit (one-shot per process,
+        mirroring ``worker_task_tick``). ``coordinator_crash_after_dispatches``
+        fires in any epoch; the ``_takeover_`` variant only in a successor
+        (epoch > 0), modelling a second control-plane crash landing while
+        the first takeover is still settling."""
+        cfg = self.config
+        n_any = cfg.coordinator_crash_after_dispatches
+        n_tko = cfg.coordinator_takeover_crash_after_dispatches
+        if not n_any and not (n_tko and epoch > 0):
+            return False
+        with self._lock:
+            n = self._counts.get(("coordinator_tick", ""), 0) + 1
+            self._counts[("coordinator_tick", "")] = n
+        if (n_any and n == n_any) or (n_tko and epoch > 0 and n == n_tko):
+            reg = get_registry()
+            reg.counter("faults_injected").inc()
+            reg.counter("faults_injected_coordinator_crash").inc()
+            return True
+        return False
 
 
 # ----------------------------------------------------------------------

@@ -70,6 +70,14 @@ def main(argv=None) -> None:
         "CUBED_TPU_RECONNECT_GIVE_UP_S)",
     )
     parser.add_argument(
+        "--rendezvous", default=None,
+        help="path to the coordinator's rendezvous advertisement file "
+        "(written when the coordinator runs with a control_dir); the "
+        "reconnect loop re-reads it to chase a successor coordinator "
+        "after a control-plane crash, and the give-up clock is suspended "
+        "while a takeover window is open",
+    )
+    parser.add_argument(
         "-v", "--verbose", action="store_true", help="log at INFO level"
     )
     parser.add_argument(
@@ -92,6 +100,7 @@ def main(argv=None) -> None:
         args.coordinator, nthreads=args.threads, name=args.name,
         drain_grace_s=args.drain_grace,
         reconnect_give_up_s=args.reconnect_give_up,
+        rendezvous=args.rendezvous,
     )
 
 

@@ -47,6 +47,18 @@ def tenant_dirname(tenant: str) -> str:
     return safe or "_"
 
 
+def service_control_dir(service_dir: str) -> str:
+    """The service's coordinator control-plane directory.
+
+    Lives beside the tenant queues so one ``service_dir`` is the whole
+    durability story: request queues make accepted work survive a crash
+    (offline recovery), and the control dir makes the *fleet* survive one
+    — a restarted service whose executor points here comes up as the next
+    coordinator epoch and re-adopts still-running workers instead of
+    cold-starting them (see runtime/journal.py ``ControlLog``)."""
+    return os.path.join(str(service_dir), "_control")
+
+
 class TenantRequestJournal:
     """One tenant's durable request queue (writer side)."""
 

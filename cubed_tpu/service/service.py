@@ -460,6 +460,20 @@ class ComputeService:
 
             executor = AsyncPythonDagExecutor()
         self.executor = executor
+        if (
+            self.config.service_dir
+            and getattr(executor, "control_dir", "absent") is None
+        ):
+            # arm live coordinator failover for distributed executors that
+            # weren't given an explicit control dir: a service restart then
+            # ADOPTS a still-running fleet (next epoch, rendezvous file)
+            # instead of cold-starting it, and offline request recovery
+            # only covers what the takeover couldn't
+            from .durability import service_control_dir
+
+            executor.control_dir = service_control_dir(
+                self.config.service_dir
+            )
         self.spec = spec
         self.arbiter = FairShareArbiter(
             self.config.tenants, self.config.default_weight

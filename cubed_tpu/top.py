@@ -105,7 +105,8 @@ def render(snapshot: dict, width: int = 100) -> str:
     fleet = snapshot.get("fleet") or {}
     metrics = snapshot.get("metrics") or {}
     out.append(
-        f"cubed_tpu.top  {stamp}  workers {fleet.get('workers_live', 0)} "
+        f"cubed_tpu.top  {stamp}  epoch {fleet.get('epoch', 0)}  "
+        f"workers {fleet.get('workers_live', 0)} "
         f"({fleet.get('workers_pressured', 0)} pressured, "
         f"{fleet.get('workers_disconnected', 0)} disconnected)  "
         f"tasks_completed {metrics.get('tasks_completed', 0)}  "
@@ -162,7 +163,7 @@ def render(snapshot: dict, width: int = 100) -> str:
     # -- fleet table ---------------------------------------------------
     workers = (fleet.get("workers") or {})
     out.append(
-        f"{'WORKER':<16}{'STATE':<14}{'RSS':>10}{'LOAD':>8}"
+        f"{'WORKER':<16}{'STATE':<14}{'EPOCH':>6}{'RSS':>10}{'LOAD':>8}"
         f"{'TASKS':>8}{'CACHE':>10}{'HIT%':>6}  CLOCK"
     )
     if not workers:
@@ -181,8 +182,11 @@ def render(snapshot: dict, width: int = 100) -> str:
         cache = row.get("peer_cache") or {}
         off = row.get("clock_offset")
         clock = f"{off:+.3f}s" if isinstance(off, (int, float)) else "-"
+        epoch = row.get("epoch")
+        epoch_s = str(epoch) if isinstance(epoch, int) else "-"
         out.append(
-            f"{name:<16}{state:<14}{_fmt_mem(row.get('rss')):>10}"
+            f"{name:<16}{state:<14}{epoch_s:>6}"
+            f"{_fmt_mem(row.get('rss')):>10}"
             f"{load:>8}{row.get('tasks_sent', 0):>8}"
             f"{_fmt_mem(cache.get('bytes')):>10}"
             f"{_worker_hit_rate(row):>6}  {clock}"

@@ -161,7 +161,7 @@ def test_reconnect_within_lease_keeps_task_ownership():
             "type": "result", "task_id": task["task_id"],
             "result": 42.0, "stats": {}, "seq": 1,
         })
-        assert recv_frame(s2) == {"type": "ack", "seq": 1}
+        assert recv_frame(s2) == {"type": "ack", "seq": 1, "epoch": 0}
         result, _stats = fut.result(timeout=5)
         assert result == 42.0
 
@@ -244,8 +244,8 @@ def test_duplicate_sequenced_result_applied_once():
         }
         send_frame(s, msg)
         send_frame(s, msg)  # the duplicate
-        assert recv_frame(s) == {"type": "ack", "seq": 1}
-        assert recv_frame(s) == {"type": "ack", "seq": 1}
+        assert recv_frame(s) == {"type": "ack", "seq": 1, "epoch": 0}
+        assert recv_frame(s) == {"type": "ack", "seq": 1, "epoch": 0}
         assert fut.result(timeout=5)[0] == 5.0
         assert (
             get_registry().counter("fleet_messages_deduped").value - before
