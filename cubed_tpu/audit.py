@@ -1,0 +1,10 @@
+"""``python -m cubed_tpu.audit`` — post-hoc invariant auditor CLI.
+
+Thin entry point over :mod:`cubed_tpu.runtime.audit`; see that module for
+the invariant catalogue and docs/reliability.md for the runbook.
+"""
+
+from .runtime.audit import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -46,6 +46,9 @@ _TIMELINE_GROUPS = {
     # dispatches, and peer-fetch store fallbacks (runtime/transfer.py)
     "data movement": ("peer_transfer", "placement_locality",
                       "peer_fallback"),
+    # seeded chaos: every fault the injector fired (runtime/faults.py) —
+    # a repro bundle names what was injected, where, and when
+    "injected faults": ("fault_injected",),
     # the live-telemetry alert engine's firings (observability/alerts.py);
     # the dedicated "alerts" section above prints the same rows with their
     # severities — this keeps them in timeline context with everything else
@@ -232,6 +235,20 @@ def render_report(bundle: dict, timeline_limit: int = 20) -> str:
             v = metrics.get(name)
             if v:
                 out.append(f"  {name:<26} {v:>12}  {caption}")
+
+    # chaos runs: the per-site injection counters, so the bundle states
+    # up front how much seeded failure the compute absorbed (the per-event
+    # detail follows in the "injected faults" timeline)
+    if metrics.get("faults_injected"):
+        out.append(_section(
+            f"injected faults ({metrics['faults_injected']} total)"
+        ))
+        for name in sorted(metrics):
+            if name.startswith("faults_injected_") and metrics[name]:
+                out.append(
+                    f"  {name[len('faults_injected_'):]:<26} "
+                    f"{metrics[name]:>8}"
+                )
 
     decisions = m.get("decisions") or []
     for title, kinds in _TIMELINE_GROUPS.items():

@@ -267,10 +267,21 @@ class FaultInjector:
             return False
         if self._roll(site, key) >= rate:
             return False
+        self._count_injection(site, key=key)
+        return True
+
+    @staticmethod
+    def _count_injection(site: str, **context) -> None:
+        """One injected fault: the conservation-law counters (total +
+        per-site, incremented together — the invariant auditor checks
+        they stay equal) plus a decision-ring record, so a diagnose
+        bundle's timeline names what was injected and when."""
+        from ..observability.collect import record_decision
+
         reg = get_registry()
         reg.counter("faults_injected").inc()
         reg.counter(f"faults_injected_{site}").inc()
-        return True
+        record_decision("fault_injected", site=site, **context)
 
     # -- storage --------------------------------------------------------
 
@@ -470,24 +481,18 @@ class FaultInjector:
                 self._partition_until[worker_name] = (
                     time.monotonic() + cfg.partition_duration_s
                 )
-            reg = get_registry()
-            reg.counter("faults_injected").inc()
-            reg.counter("faults_injected_partition").inc()
+            self._count_injection("partition", worker=worker_name)
         if (
             worker_name in cfg.worker_crash_names
             and n == cfg.worker_crash_after_tasks
         ):
-            reg = get_registry()
-            reg.counter("faults_injected").inc()
-            reg.counter("faults_injected_worker_crash").inc()
+            self._count_injection("worker_crash", worker=worker_name)
             return "crash"
         if (
             worker_name in cfg.worker_hang_names
             and n == cfg.worker_hang_after_tasks
         ):
-            reg = get_registry()
-            reg.counter("faults_injected").inc()
-            reg.counter("faults_injected_worker_hang").inc()
+            self._count_injection("worker_hang", worker=worker_name)
             return "hang"
         if (
             cfg.worker_preempt_rate
@@ -524,9 +529,7 @@ class FaultInjector:
             n = self._counts.get(("coordinator_tick", ""), 0) + 1
             self._counts[("coordinator_tick", "")] = n
         if (n_any and n == n_any) or (n_tko and epoch > 0 and n == n_tko):
-            reg = get_registry()
-            reg.counter("faults_injected").inc()
-            reg.counter("faults_injected_coordinator_crash").inc()
+            self._count_injection("coordinator_crash", epoch=epoch)
             return True
         return False
 

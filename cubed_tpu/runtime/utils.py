@@ -9,6 +9,7 @@ it, so remote executors report IO accurately.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import os
 import time
@@ -134,12 +135,23 @@ def handle_callbacks(callbacks: Optional[Sequence[Callback]], stats: dict) -> No
 
 
 def chunk_key(task_input) -> str:
-    """A short, human-readable key for a task's mappable item."""
+    """A short, human-readable key for a task's mappable item.
+
+    Long keys are shortened but stay COLLISION-PROOF: the journal,
+    resume frontier, and invariant auditor all identify tasks by
+    ``(op, chunk_key)``, and a bare prefix truncation made distinct
+    create-arrays tasks (whose keys embed long work-dir paths sharing a
+    prefix) alias each other — the auditor flagged such aliases as
+    duplicate result application. A digest of the full string keeps
+    shortened keys unique."""
     try:
         s = str(task_input)
     except Exception:
         s = object.__repr__(task_input)
-    return s if len(s) <= 120 else s[:117] + "..."
+    if len(s) <= 120:
+        return s
+    digest = hashlib.sha1(s.encode("utf-8", "replace")).hexdigest()[:8]
+    return f"{s[:108]}...#{digest}"
 
 
 def _wants_task_start(callbacks) -> bool:

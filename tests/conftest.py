@@ -54,6 +54,28 @@ def spec(tmp_path):
     return ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB", reserved_mem=0)
 
 
+@pytest.fixture
+def invariant_audit():
+    """Post-hoc exactly-once audit over whatever durable artifacts a test's
+    compute left behind (journal / control log / store / metrics delta) —
+    asserts the report is clean and returns it. Chaos suites call this at
+    the end so 'survived the fault' also means 'never did anything
+    illegal along the way'."""
+    from cubed_tpu.runtime.audit import InvariantAuditor
+
+    def _audit(journal=None, control_dir=None, work_dir=None, metrics=None,
+               expect_success=True):
+        report = InvariantAuditor(
+            journal=journal, control_dir=control_dir, work_dir=work_dir,
+            metrics=metrics, expect_success=expect_success,
+        ).audit()
+        assert report.ok, report.render()
+        assert report.checked, "auditor was given nothing to audit"
+        return report
+
+    return _audit
+
+
 def pytest_addoption(parser):
     parser.addoption(
         "--runslow", action="store_true", default=False, help="run slow tests"

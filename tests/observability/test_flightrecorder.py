@@ -187,3 +187,35 @@ def test_decision_ring_feeds_failing_task_payloads(tmp_path):
     assert m["error"]["op"] == "op-x"
     assert m["error"]["chunk"] == "2.3"
     assert m["failing_tasks"][-1]["error"] == "bad block"
+
+
+def test_diagnose_renders_injected_fault_counters_and_timeline():
+    """A chaos bundle names what was injected: the per-site counter
+    summary plus the fault_injected decision timeline, so a repro bundle
+    is self-describing about the seeded failure it absorbed."""
+    from cubed_tpu.diagnose import render_report
+
+    bundle = {"manifest": {
+        "compute_id": "c-chaos",
+        "status": "succeeded",
+        "metrics": {
+            "faults_injected": 3,
+            "faults_injected_storage_read": 2,
+            "faults_injected_task": 1,
+            "faults_injected_straggler": 0,  # zero sites stay silent
+        },
+        "decisions": [
+            {"ts": 10.0, "kind": "fault_injected",
+             "site": "storage_read", "key": "a/0.1"},
+            {"ts": 10.2, "kind": "fault_injected",
+             "site": "storage_read", "key": "a/1.0"},
+            {"ts": 10.5, "kind": "fault_injected",
+             "site": "task", "key": "op-2:(0, 1)"},
+        ],
+    }}
+    report = render_report(bundle)
+    assert "injected faults (3 total)" in report
+    assert "storage_read" in report and "2" in report
+    assert "injected faults timeline (3 events)" in report
+    assert "site=task" in report
+    assert "straggler" not in report

@@ -94,6 +94,25 @@ def test_every_decision_kind_is_documented():
     )
 
 
+def test_every_fault_knob_is_documented_in_reliability_docs():
+    """The same rot-guard for chaos: every FaultConfig knob must appear in
+    docs/reliability.md's fault-injection knob table — campaigns compose
+    ALL knobs, so an undocumented knob is an unreviewable schedule."""
+    from dataclasses import fields
+
+    from cubed_tpu.runtime.faults import FaultConfig
+
+    doc = (REPO / "docs" / "reliability.md").read_text(encoding="utf-8")
+    knobs = sorted(f.name for f in fields(FaultConfig))
+    assert len(knobs) >= 30  # the introspection keeps finding the knobs
+    missing = sorted(k for k in knobs if k not in doc)
+    assert not missing, (
+        "FaultConfig knobs missing from the docs/reliability.md chaos-knob "
+        f"table: {missing} — document each knob (what it injects, where it "
+        "fires) so chaos schedules stay reviewable"
+    )
+
+
 def test_every_default_alert_rule_is_documented():
     from cubed_tpu.observability.alerts import default_rules
 

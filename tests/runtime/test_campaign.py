@@ -1,0 +1,340 @@
+"""Composed-failure campaign proofs.
+
+The tier-1 headline: a fixed-seed schedule composing >= 3 failure
+domains simultaneously (storage brownout + spot preemption + network
+partition, during a rechunk) completes bitwise-correct AND
+invariant-auditor-clean. Plus: schedule generation is deterministic per
+seed, failing schedules shrink to a minimal reproducing subset, and the
+repro file replays the identical failure.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from cubed_tpu.runtime.campaign import (
+    KNOB_ATOMS,
+    KNOB_DOMAINS,
+    CampaignRunner,
+    FaultSchedule,
+    WORKLOADS,
+    main as chaos_main,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _restore_gensym_names():
+    """CampaignRunner pins plan names per run and advances the global
+    gensym continuation by design; later suites' seeded chaos decisions
+    key on array NAMES (store._fault_key), so leave the counter exactly
+    where it started."""
+    import itertools
+
+    from cubed_tpu import utils as ct_utils
+
+    n0 = next(ct_utils.sym_counter)
+    ct_utils.sym_counter = itertools.count(n0)
+    yield
+    ct_utils.sym_counter = itertools.count(n0)
+
+
+# -- schedule model -------------------------------------------------------
+
+
+def test_schedule_roundtrip(tmp_path):
+    sched = FaultSchedule(
+        seed=7, workload="rechunk",
+        faults={"seed": 7, "storage_read_failure_rate": 0.1,
+                "partition_worker_names": ["local-1"]},
+        events=[{"kind": "cancel", "after_completes": 3}],
+    )
+    path = str(tmp_path / "repro-7.json")
+    sched.save(path)
+    back = FaultSchedule.load(path)
+    assert back.to_dict() == sched.to_dict()
+    assert back.domains == {"storage", "partition", "cancellation"}
+
+
+def test_schedule_mode_properties():
+    threaded = FaultSchedule(
+        seed=1, workload="blockwise_chain",
+        faults={"seed": 1, "task_failure_rate": 0.1},
+    )
+    assert not threaded.needs_fleet and not threaded.needs_subprocess
+    fleet = FaultSchedule(
+        seed=1, workload="rechunk",
+        faults={"seed": 1, "worker_preempt_rate": 0.3},
+    )
+    assert fleet.needs_fleet and not fleet.needs_subprocess
+    proc = FaultSchedule(
+        seed=1, workload="rechunk",
+        faults={"seed": 1, "coordinator_crash_after_dispatches": 5},
+    )
+    assert proc.needs_subprocess and proc.needs_fleet
+    killer = FaultSchedule(
+        seed=1, workload="rechunk", faults={"seed": 1},
+        events=[{"kind": "client_kill", "after_s": 1.0}],
+    )
+    assert killer.needs_subprocess
+
+
+def test_every_fault_knob_has_a_domain_and_an_atom():
+    # the shrink atoms and the domain map must cover the full knob set
+    from dataclasses import fields
+
+    from cubed_tpu.runtime.faults import FaultConfig
+
+    knobs = {f.name for f in fields(FaultConfig)} - {"seed"}
+    atom_knobs = {k for group in KNOB_ATOMS for k in group}
+    assert knobs == atom_knobs, knobs ^ atom_knobs
+    assert knobs == set(KNOB_DOMAINS), knobs ^ set(KNOB_DOMAINS)
+
+
+def test_generate_deterministic_per_seed_and_composes_domains(tmp_path):
+    runner = CampaignRunner(str(tmp_path))
+    a = runner.generate(123)
+    b = runner.generate(123)
+    assert a.to_dict() == b.to_dict()
+    assert len(a.domains) >= 3
+    assert a.workload in WORKLOADS
+    assert not a.needs_subprocess  # process faults are opt-in
+    # different seeds explore different schedules
+    assert any(
+        runner.generate(s).to_dict() != a.to_dict() for s in range(5)
+    )
+
+
+def test_generate_process_faults_only_when_allowed(tmp_path):
+    runner = CampaignRunner(str(tmp_path))
+    assert not any(
+        runner.generate(s).needs_subprocess for s in range(30)
+    )
+    armed = [
+        runner.generate(s, n_domains=6, allow_process_faults=True)
+        for s in range(30)
+    ]
+    assert any(s.needs_subprocess for s in armed)
+
+
+def test_unknown_knob_fails_loudly(tmp_path):
+    runner = CampaignRunner(str(tmp_path))
+    res = runner.run(FaultSchedule(
+        seed=1, workload="blockwise_chain",
+        faults={"seed": 1, "no_such_knob": 0.5},
+    ))
+    assert not res.ok and res.stage == "compute"
+    assert "no_such_knob" in res.error
+
+
+# -- the tier-1 composed-failure proof ------------------------------------
+
+#: storage brownout + spot preemption + network partition, composed on
+#: one seed during a rechunk: >= 3 domains firing simultaneously
+COMPOSED_3DOMAIN = FaultSchedule(
+    seed=1800,
+    workload="rechunk",
+    faults={
+        "seed": 1800,
+        # storage: brownout-grade flakiness + throttling
+        "storage_read_failure_rate": 0.08,
+        "storage_write_failure_rate": 0.08,
+        "storage_throttle_rate": 0.1,
+        # elasticity: a spot preemption wave mid-compute
+        "worker_preempt_rate": 0.3,
+        "worker_preempt_after_tasks": 2,
+        "preempt_notice_s": 0.3,
+        # partition: control-plane message delay/duplication
+        "net_msg_delay_rate": 0.15,
+        "net_msg_delay_s": 0.05,
+        "net_msg_dup_rate": 0.1,
+    },
+)
+
+
+def test_composed_three_domain_campaign_bitwise_and_auditor_clean(tmp_path):
+    assert len(COMPOSED_3DOMAIN.domains) >= 3, COMPOSED_3DOMAIN.domains
+    runner = CampaignRunner(str(tmp_path))
+    res = runner.run(COMPOSED_3DOMAIN)
+    assert res.ok, res.render()
+    assert res.report is not None and res.report.ok, res.report.render()
+    # the audit actually covered the journal, control log, and store
+    for inv in ("exactly_once_application", "single_ownership",
+                "epoch_monotonicity", "manifest_store_crc",
+                "retry_budget_conservation", "counter_conservation"):
+        assert inv in res.report.checked, res.report.checked
+    # and faults genuinely fired: fleet-side injections count in the
+    # worker processes' registries, but the retries they force (and any
+    # client-side injections) are visible here
+    assert (
+        res.stats.get("task_retries", 0) > 0
+        or res.stats.get("faults_injected", 0) > 0
+    ), res.stats
+
+
+def test_threaded_schedule_deterministic_per_seed(tmp_path):
+    """The same seeded schedule rolls identical injector decisions run
+    over run (plan names pinned), so the injected-fault count is exactly
+    reproducible — what makes repro files trustworthy."""
+    sched = FaultSchedule(
+        seed=77, workload="blockwise_chain",
+        faults={"seed": 77, "storage_read_failure_rate": 0.1,
+                "storage_write_failure_rate": 0.1,
+                "task_failure_rate": 0.05},
+    )
+    runner = CampaignRunner(str(tmp_path))
+    r1 = runner.run(sched)
+    r2 = runner.run(sched)
+    assert r1.ok and r2.ok, (r1.render(), r2.render())
+    assert r1.stats.get("faults_injected") == r2.stats.get(
+        "faults_injected"
+    ), (r1.stats, r2.stats)
+
+
+def test_cancel_event_composes_with_faults_and_resumes_bitwise(tmp_path):
+    """A mid-compute cancel composed with storage flakiness: the run is
+    cancelled, resumed from its journal, and must still land bitwise and
+    auditor-clean (two journal segments, no duplicate application)."""
+    sched = FaultSchedule(
+        seed=31, workload="blockwise_chain",
+        faults={"seed": 31, "storage_read_failure_rate": 0.08,
+                "straggler_rate": 0.5, "straggler_delay_s": 0.1},
+        events=[{"kind": "cancel", "after_completes": 2}],
+    )
+    runner = CampaignRunner(str(tmp_path))
+    res = runner.run(sched)
+    assert res.ok, res.render()
+    assert "cancellation" in sched.domains
+
+
+# -- shrink + repro -------------------------------------------------------
+
+
+def _failing_schedule():
+    # task_failure_rate=1.0 deterministically exhausts the retry budget;
+    # the straggler and storage-throttle atoms are irrelevant passengers
+    # shrink must strip
+    return FaultSchedule(
+        seed=55, workload="blockwise_chain",
+        faults={
+            "seed": 55,
+            "task_failure_rate": 1.0,
+            "straggler_rate": 0.2, "straggler_delay_s": 0.05,
+            "storage_throttle_rate": 0.05,
+        },
+    )
+
+
+def test_failing_schedule_shrinks_to_minimal_and_replays(tmp_path):
+    runner = CampaignRunner(str(tmp_path))
+    sched = _failing_schedule()
+    res = runner.run(sched)
+    assert not res.ok and res.stage == "compute", res.render()
+    assert res.signature[1] == "FaultInjectedTaskError", res.error
+
+    minimal = runner.shrink(sched, signature=res.signature)
+    # only the culprit atom (plus the seed) survives
+    assert set(minimal.faults) == {"seed", "task_failure_rate"}, (
+        minimal.faults
+    )
+    assert minimal.seed == sched.seed
+
+    # the repro file replays the identical failure
+    final = runner.run(minimal)
+    repro = runner.write_repro(minimal, final, str(tmp_path / "repro.json"))
+    doc = json.loads(open(repro).read())
+    assert doc["failure"]["stage"] == "compute"
+    replayed = runner.replay(repro)
+    assert replayed.signature == res.signature, replayed.render()
+
+
+def test_shrink_refuses_passing_schedule(tmp_path):
+    runner = CampaignRunner(str(tmp_path))
+    sched = FaultSchedule(
+        seed=2, workload="blockwise_chain", faults={"seed": 2},
+    )
+    with pytest.raises(ValueError, match="passing schedule"):
+        runner.shrink(sched)
+
+
+def test_shrink_drops_irrelevant_event(tmp_path):
+    # shrink removes events too, not just knobs (no run needed: custom check)
+    runner = CampaignRunner(str(tmp_path))
+    sched = FaultSchedule(
+        seed=9, workload="blockwise_chain",
+        faults={"seed": 9, "task_failure_rate": 1.0},
+        events=[{"kind": "cancel", "after_completes": 2}],
+    )
+
+    def only_needs_task_faults(s):
+        return "task_failure_rate" in s.faults
+
+    minimal = runner.shrink(sched, check=only_needs_task_faults)
+    assert minimal.events == []
+    assert set(minimal.faults) == {"seed", "task_failure_rate"}
+
+
+# -- CLI ------------------------------------------------------------------
+
+
+def test_cli_repro_replay_exit_codes(tmp_path, capsys):
+    runner = CampaignRunner(str(tmp_path / "scratch"))
+    passing = FaultSchedule(
+        seed=3, workload="blockwise_chain",
+        faults={"seed": 3, "storage_read_failure_rate": 0.05},
+    )
+    p = str(tmp_path / "repro-pass.json")
+    passing.save(p)
+    assert chaos_main(["--repro", p, "--base-dir",
+                       str(tmp_path / "scratch")]) == 0
+    out = capsys.readouterr().out
+    assert "PASS" in out
+
+    failing = FaultSchedule(
+        seed=4, workload="blockwise_chain",
+        faults={"seed": 4, "task_failure_rate": 1.0},
+    )
+    f = str(tmp_path / "repro-fail.json")
+    failing.save(f)
+    assert chaos_main(["--repro", f, "--base-dir",
+                       str(tmp_path / "scratch")]) == 1
+
+
+def test_cli_requires_exactly_one_mode():
+    with pytest.raises(SystemExit):
+        chaos_main([])
+    with pytest.raises(SystemExit):
+        chaos_main(["--seed", "1", "--repro", "x.json"])
+
+
+# -- soak (slow) ----------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_campaign_soak_generated_seeds_all_clean(tmp_path):
+    """The --campaign soak shape: generated schedules over a seed range
+    must all land bitwise + auditor-clean (failures would shrink and
+    write repros, failing this test with the repro path in the log)."""
+    runner = CampaignRunner(str(tmp_path))
+    summary = runner.run_campaign(range(4), log=print)
+    assert summary["failures"] == [], summary
+
+
+@pytest.mark.slow
+def test_subprocess_mode_coordinator_kill_recovers(tmp_path):
+    """A schedule carrying a coordinator-crash knob runs in a child
+    interpreter; the child dies by injection and the clean replay from
+    the same seed must succeed."""
+    runner = CampaignRunner(str(tmp_path))
+    sched = FaultSchedule(
+        seed=88, workload="blockwise_chain",
+        faults={"seed": 88, "storage_read_failure_rate": 0.05,
+                "coordinator_crash_after_dispatches": 3},
+    )
+    assert sched.needs_subprocess
+    res = runner.run(sched)
+    assert res.ok, res.render()
+    assert res.stats.get("child_rc") != 0 or res.stats.get("child_killed")

@@ -58,8 +58,14 @@ def _spec(tmp_path, **fault_kwargs):
 # -- end-to-end under storage flakiness, per executor --------------------
 
 
-def test_chaos_threaded_storage_flakiness_bitwise_correct(tmp_path):
-    spec = _spec(tmp_path, **CHAOS_STORAGE)
+def test_chaos_threaded_storage_flakiness_bitwise_correct(
+    tmp_path, invariant_audit
+):
+    journal = str(tmp_path / "chaos.journal.jsonl")
+    spec = ct.Spec(
+        work_dir=str(tmp_path), allowed_mem="500MB", journal=journal,
+        fault_injection=CHAOS_STORAGE,
+    )
     an = np.arange(400, dtype=np.float64).reshape(20, 20)
     a = ct.from_array(an, chunks=(2, 2), spec=spec)  # 100 chunks
     b = xp.add(a, 1.0)
@@ -76,6 +82,10 @@ def test_chaos_threaded_storage_flakiness_bitwise_correct(tmp_path):
     assert cap.stats.get("task_retries", 0) > 0, cap.stats
     bo = cap.stats.get("retry_backoff_s") or {}
     assert bo.get("count", 0) == cap.stats["task_retries"]
+    # and the durable artifacts prove nothing illegal happened on the way
+    invariant_audit(
+        journal=journal, work_dir=str(tmp_path), metrics=cap.stats
+    )
 
 
 def test_chaos_sequential_storage_flakiness(tmp_path):
@@ -120,7 +130,9 @@ def test_chaos_multiprocess_storage_flakiness(tmp_path, monkeypatch):
     assert cap.stats.get("task_retries", 0) > 0, cap.stats
 
 
-def test_chaos_distributed_worker_crash_mid_compute(tmp_path, monkeypatch):
+def test_chaos_distributed_worker_crash_mid_compute(
+    tmp_path, monkeypatch, invariant_audit
+):
     """Storage flakiness plus one injected worker hard-exit: in-flight tasks
     fail with WorkerLostError and requeue onto the survivor for free, task
     faults burn normal retries, and the result is still bitwise-correct."""
@@ -141,8 +153,9 @@ def test_chaos_distributed_worker_crash_mid_compute(tmp_path, monkeypatch):
     spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
     an = np.arange(256, dtype=np.float64).reshape(16, 16)
     before = get_registry().snapshot()
+    control_dir = str(tmp_path / "ctrl")
     ex = DistributedDagExecutor(
-        n_local_workers=2,
+        n_local_workers=2, control_dir=control_dir,
         retry_policy=RetryPolicy(retries=6, backoff_base=0.01, seed=0),
     )
     try:
@@ -167,6 +180,12 @@ def test_chaos_distributed_worker_crash_mid_compute(tmp_path, monkeypatch):
         ), departed
     finally:
         ex.close()
+    # the control log must show the crash as a LEGAL ownership hand-off
+    # (worker_gone release between re-dispatches), and the metrics delta
+    # must conserve the retry and injection counters
+    invariant_audit(
+        control_dir=control_dir, work_dir=str(tmp_path), metrics=delta
+    )
 
 
 from ..utils import SlowAdd as _SlowAdd  # noqa: E402

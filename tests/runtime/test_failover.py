@@ -613,7 +613,7 @@ def _run_failover_phases(tmp_path, adopt_env_extra=None, kills=1):
 
 
 @pytest.mark.chaos
-def test_chaos_coordinator_sigkill_live_failover(tmp_path):
+def test_chaos_coordinator_sigkill_live_failover(tmp_path, invariant_audit):
     """Acceptance proof: SIGKILL the coordinator process at ~50% task
     completion mid-dataflow-compute; a successor process pointed at the
     same control_dir adopts the orphaned worker fleet (epoch 1), the
@@ -632,10 +632,19 @@ def test_chaos_coordinator_sigkill_live_failover(tmp_path):
     # takeover wall clock stays under 2x a generous uninterrupted
     # estimate (~46 tasks x 0.15s across 2 workers, plus fixed overhead)
     assert report["wall_s"] < 2 * (46 * 0.15 / 2 + 3.0), report
+    # the two-epoch control log must show the takeover as a LEGAL
+    # ownership transfer and strictly increasing epochs; the journal's
+    # kill/resume segments must each stay exactly-once
+    invariant_audit(
+        journal=str(tmp_path / "failover.journal.jsonl"),
+        control_dir=str(tmp_path / "ctrl"), work_dir=str(tmp_path),
+    )
 
 
 @pytest.mark.chaos
-def test_chaos_coordinator_killed_again_during_takeover(tmp_path):
+def test_chaos_coordinator_killed_again_during_takeover(
+    tmp_path, invariant_audit
+):
     """Second variant: the FIRST successor is itself killed mid-takeover
     (seeded fault: hard-exit after 3 dispatches in an epoch > 0); the
     second successor (epoch 2) adopts whatever both prior epochs left
@@ -651,3 +660,9 @@ def test_chaos_coordinator_killed_again_during_takeover(tmp_path):
     assert report["epoch"] == 2
     assert report["workers_lost"] == 0
     assert report["resumed_tasks"] < report["total"], report
+    # three epochs (0 killed, 1 crashed mid-takeover, 2 finished): the
+    # control log must still audit as monotone with legal hand-offs
+    invariant_audit(
+        journal=str(tmp_path / "failover.journal.jsonl"),
+        control_dir=str(tmp_path / "ctrl"), work_dir=str(tmp_path),
+    )

@@ -500,16 +500,20 @@ def test_autoscaler_does_not_backfill_leased_worker():
 
 
 @pytest.mark.chaos
-def test_chaos_partition_and_message_faults_bitwise_correct(tmp_path):
+def test_chaos_partition_and_message_faults_bitwise_correct(
+    tmp_path, invariant_audit
+):
     """Acceptance proof: seeded message drop/delay/duplication plus a
     ≥2s one-way partition of one worker mid-compute (dataflow scheduler
     on) completes bitwise-correct with ZERO workers_lost, at least one
     reconnect, and every task's result applied exactly once."""
     from cubed_tpu.runtime.executors.distributed import DistributedDagExecutor
 
+    journal = str(tmp_path / "partition.journal.jsonl")
+    control_dir = str(tmp_path / "ctrl")
     spec = ct.Spec(
         work_dir=str(tmp_path), allowed_mem="500MB",
-        scheduler="dataflow",
+        scheduler="dataflow", journal=journal,
         fault_injection=dict(
             seed=1234,
             net_msg_drop_rate=0.04,
@@ -524,7 +528,7 @@ def test_chaos_partition_and_message_faults_bitwise_correct(tmp_path):
     )
     an = np.arange(144, dtype=np.float64).reshape(12, 12)
     ex = DistributedDagExecutor(
-        n_local_workers=2, worker_threads=1,
+        n_local_workers=2, worker_threads=1, control_dir=control_dir,
         task_timeout=6.0, retries=6, use_backups=False, lease_s=12.0,
     )
     try:
@@ -545,3 +549,9 @@ def test_chaos_partition_and_message_faults_bitwise_correct(tmp_path):
         )
     finally:
         ex.close()
+    # exactly-once is also provable post-hoc: duplicate frame deliveries
+    # must never reach the journal as duplicate applications, and every
+    # re-dispatch across the partition must show an ownership release
+    invariant_audit(
+        journal=journal, control_dir=control_dir, work_dir=str(tmp_path)
+    )

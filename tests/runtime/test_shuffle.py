@@ -350,7 +350,9 @@ def test_fleet_shuffle_analytics_bucket(tmp_path):
 
 
 @pytest.mark.chaos
-def test_chaos_peer_faults_during_shuffle_bitwise(tmp_path, monkeypatch):
+def test_chaos_peer_faults_during_shuffle_bitwise(
+    tmp_path, monkeypatch, invariant_audit
+):
     """Seeded drop/corrupt/delay/reset across the shuffle's peer fetches:
     bitwise-correct via the store fallback, zero retry-budget draw."""
     monkeypatch.setenv(
@@ -377,10 +379,14 @@ def test_chaos_peer_faults_during_shuffle_bitwise(tmp_path, monkeypatch):
     assert delta.get("peer_fetch_fallbacks", 0) > 0, delta
     assert delta.get("task_retries", 0) == 0, delta
     assert delta.get("worker_loss_requeues", 0) == 0, delta
+    # store + metrics stay conservation-clean under peer-path chaos
+    invariant_audit(work_dir=str(tmp_path), metrics=delta)
 
 
 @pytest.mark.chaos
-def test_chaos_worker_hard_killed_mid_shuffle(tmp_path, monkeypatch):
+def test_chaos_worker_hard_killed_mid_shuffle(
+    tmp_path, monkeypatch, invariant_audit
+):
     """A producing worker hard-exits mid-compute: its cached source
     chunks vanish with it, the shuffle's reads degrade to store reads,
     and the result stays bitwise-correct with zero user-visible retries
@@ -405,6 +411,7 @@ def test_chaos_worker_hard_killed_mid_shuffle(tmp_path, monkeypatch):
     np.testing.assert_array_equal(res, an + 1.0)
     delta = reg.snapshot_delta(before)
     assert delta.get("task_retries", 0) == 0, delta
+    invariant_audit(work_dir=str(tmp_path), metrics=delta)
 
 
 _CRASH_SCRIPT = r"""
@@ -460,7 +467,9 @@ finally:
 
 
 @pytest.mark.chaos
-def test_chaos_client_sigkill_mid_rechunk_resume_bitwise(tmp_path):
+def test_chaos_client_sigkill_mid_rechunk_resume_bitwise(
+    tmp_path, invariant_audit
+):
     """Acceptance proof: SIGKILL the client while the rechunk stage is
     partially complete (observed live from the fsync'd journal), rebuild
     the same plan in a fresh process, and ``resume_compute`` — the result
@@ -532,3 +541,7 @@ def test_chaos_client_sigkill_mid_rechunk_resume_bitwise(tmp_path):
     assert report["skipped"] > 0
     assert report["resumed_tasks"] < report["total"], report
     assert load_journal(journal)["complete"] is True
+    # the two-segment journal (SIGKILL'd run + resume) must stay
+    # exactly-once WITHIN each segment — a cross-segment re-run is the
+    # point of resume, a within-segment duplicate is double application
+    invariant_audit(journal=journal, work_dir=str(tmp_path))

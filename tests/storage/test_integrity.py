@@ -123,10 +123,18 @@ def test_corrupt_manifest_shard_tolerated(tmp_path):
 # -- verify_chunks --------------------------------------------------------
 
 
-def test_verify_chunks_detects_bitflip_and_quarantines(tmp_path):
+def test_verify_chunks_detects_bitflip_and_quarantines(
+    tmp_path, invariant_audit
+):
+    from cubed_tpu.runtime.audit import InvariantAuditor
+
     store = tmp_path / "a"
     _make_array(store)
     _flip_byte(store / "1.0", offset=5)
+    # pre-quarantine, the manifest/store CRC invariant is genuinely broken
+    # — the post-hoc auditor sees the same corruption the verify scan will
+    dirty = InvariantAuditor(work_dir=str(tmp_path)).audit()
+    assert [v.invariant for v in dirty.violations] == ["manifest_store_crc"]
     before = get_registry().snapshot()
     arr = open_zarr_array(str(store), mode="r")
     valid, corrupt, verified = arr.verify_chunks()
@@ -140,6 +148,8 @@ def test_verify_chunks_detects_bitflip_and_quarantines(tmp_path):
     assert delta.get("chunks_corrupt_detected") == 1
     assert delta.get("chunks_quarantined") == 1
     assert delta.get("chunks_verified", 0) >= 4
+    # quarantine restores the invariant: the marker legalises the absence
+    invariant_audit(work_dir=str(tmp_path), metrics=delta)
 
 
 def test_verify_chunks_detects_truncation(tmp_path):
