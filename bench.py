@@ -1719,6 +1719,157 @@ def measure_multitenant_service(timeout: float):
         return None
 
 
+#: overload-shedding bench: 2 tenants at ~2x the service's capacity, the
+#: degradation ladder on vs CUBED_TPU_OVERLOAD=off — goodput is requests
+#: that SUCCEEDED (deadline met) per second; shed-on must beat shed-off
+OVL_TASK_S = 0.08         # per-request kernel sleep (1 chunk = 1 task)
+OVL_N_PER_TENANT = 16     # submissions per tenant (2 tenants)
+OVL_SUBMIT_GAP_S = 0.04   # ~2x overload vs the single admission slot
+OVL_DEADLINE_S = 0.5
+
+OVERLOAD_SHEDDING = r"""
+import json, os, sys, tempfile, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import cubed_tpu as ct
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+from cubed_tpu.service import (
+    ComputeService, OverloadPolicy, ServiceOverloadedError,
+)
+
+TASK_S = {task_s!r}
+N = {n!r}
+GAP = {gap!r}
+DEADLINE = {deadline!r}
+
+an = np.arange(16, dtype=np.float64).reshape(4, 4)
+spec = ct.Spec(work_dir=tempfile.mkdtemp(), allowed_mem="2GB")
+
+
+def build(k):
+    def kernel(x, _k=float(k)):
+        time.sleep(TASK_S)
+        return x + _k
+
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    return ct.map_blocks(kernel, a, dtype=np.float64)
+
+
+svc = ComputeService(
+    executor=AsyncPythonDagExecutor(),
+    max_concurrent=1,
+    result_cache=False,  # every request must EXECUTE (goodput, not reuse)
+    overload_policy=OverloadPolicy(
+        queue_l1=2, queue_l2=4, queue_l3=1000,
+        down_dwell_s=10.0, tick_interval_s=0.02,
+    ),
+    breaker_threshold=3, breaker_cooldown_s=0.5,
+).start()
+handles, shed = [], 0
+t0 = time.perf_counter()
+try:
+    for i in range(N):
+        for tenant, klass in (("slo", "interactive"), ("bulk", "batch")):
+            try:
+                handles.append(svc.submit(
+                    build(i * 10 + (tenant == "bulk")), tenant=tenant,
+                    deadline_s=DEADLINE, request_class=klass,
+                ))
+            except ServiceOverloadedError:
+                shed += 1
+        time.sleep(GAP)
+    ok = failed = 0
+    for h in handles:
+        try:
+            h.result(timeout=600)
+            ok += 1
+        except ServiceOverloadedError:
+            shed += 1
+        except Exception:
+            failed += 1  # deadline blown (or aborted mid-run)
+    elapsed = time.perf_counter() - t0
+    ovl = svc.stats_snapshot()["overload"]
+finally:
+    svc.close()
+
+print(json.dumps({{
+    "elapsed": elapsed,
+    "submitted": 2 * N,
+    "ok": ok,
+    "shed": shed,
+    "failed": failed,
+    "goodput": ok / max(1e-9, elapsed),
+    "overload_enabled": ovl["enabled"],
+    "max_level_seen": ovl.get("level", 0),
+    "transitions": ovl.get("transitions", 0),
+}}), flush=True)
+"""
+
+
+def measure_overload_shedding(timeout: float):
+    """Two tenants at ~2x capacity against a one-slot service, run twice:
+    degradation ladder ON, then ``CUBED_TPU_OVERLOAD=off``. Goodput is
+    deadline-met successes per second — shedding trades rejected requests
+    (fast, typed, retry-after attached) for requests that finish on time,
+    so ``goodput_on`` must beat ``goodput_off``. Recorded as
+    ``overload_shedding`` in BENCH_METRICS.json; the intra-run ratio and
+    the goodput_on trajectory ride the perf gate. Returns None on
+    failure — additive, never the reason a bench run dies."""
+    script = OVERLOAD_SHEDDING.format(
+        repo=REPO, task_s=OVL_TASK_S, n=OVL_N_PER_TENANT,
+        gap=OVL_SUBMIT_GAP_S, deadline=OVL_DEADLINE_S,
+    )
+    try:
+        arms = {}
+        for arm in ("on", "off"):
+            env = _scrubbed_cpu_env()
+            if arm == "off":
+                env["CUBED_TPU_OVERLOAD"] = "off"
+            out = subprocess.run(
+                [sys.executable, "-c", script],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=timeout / 2,
+            )
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"overload arm {arm} failed (rc={out.returncode}): "
+                    f"{out.stderr[-2000:]}"
+                )
+            arms[arm] = json.loads(out.stdout.strip().splitlines()[-1])
+        on, off = arms["on"], arms["off"]
+        res = {
+            "elapsed": on["elapsed"] + off["elapsed"],
+            "goodput_on": on["goodput"],
+            "goodput_off": off["goodput"],
+            "goodput_ratio": on["goodput"] / max(1e-9, off["goodput"]),
+            "shed_on": on["shed"],
+            "failed_on": on["failed"],
+            "failed_off": off["failed"],
+            "max_level_seen": on["max_level_seen"],
+            "transitions": on["transitions"],
+        }
+        print(
+            f"overload shedding: goodput {res['goodput_on']:.2f}/s (ladder "
+            f"on, {on['ok']} ok / {on['shed']} shed / {on['failed']} "
+            f"failed) vs {res['goodput_off']:.2f}/s (off, {off['ok']} ok / "
+            f"{off['failed']} failed) — ratio "
+            f"{res['goodput_ratio']:.2f}x, peak L{on['max_level_seen']}",
+            file=sys.stderr, flush=True,
+        )
+        if res["goodput_ratio"] < 1.0:
+            print(
+                "OVERLOAD REGRESSION: shedding did not beat the off arm "
+                f"(ratio {res['goodput_ratio']:.2f}x)",
+                file=sys.stderr,
+            )
+        return res
+    except Exception as e:
+        print(f"overload shedding sweep skipped: {e}", file=sys.stderr)
+        return None
+
+
 def _scrubbed_cpu_env() -> dict:
     """Tunnel-free env: no plugin-gating vars, ONE CPU device.
 
@@ -2230,6 +2381,17 @@ def main() -> None:
         print("multitenant service sweep skipped: out of budget",
               file=sys.stderr)
 
+    # overload shedding: 2-tenant goodput at ~2x overload, degradation
+    # ladder on vs CUBED_TPU_OVERLOAD=off — the robustness win the
+    # overload controller is on the hook for (shed-on must beat shed-off)
+    if OVERALL_DEADLINE_S - (time.monotonic() - _T0) > 45:
+        ovl = measure_overload_shedding(_remaining(90))
+        if ovl is not None:
+            metrics_record["overload_shedding"] = ovl
+    else:
+        print("overload shedding sweep skipped: out of budget",
+              file=sys.stderr)
+
     # per-op timing / IO-byte trajectories ride alongside the headline
     # numbers so future rounds can localize regressions without re-profiling
     prev_trajectory = _previous_trajectory()
@@ -2508,6 +2670,22 @@ def perf_regressions(prev: dict, cur: dict) -> list:
                     f"{old_pe:.2f}s ({pct:+.1f}%)"
                 )
             continue
+        if name == "overload_shedding":
+            # the ladder's reason to exist: shed-on goodput must beat
+            # shed-off in the SAME run, and must not rot run-over-run
+            ratio = cfg.get("goodput_ratio")
+            if isinstance(ratio, (int, float)) and ratio < 1.0:
+                out.append(
+                    f"overload_shedding ladder-on goodput no longer beats "
+                    f"ladder-off (ratio {ratio:.2f}x)"
+                )
+            pct = _delta_pct(cfg.get("goodput_on"), old.get("goodput_on"))
+            if pct is not None and pct <= -PERF_GATE_THRESHOLD_PCT:
+                out.append(
+                    f"overload_shedding goodput {cfg['goodput_on']:.2f}/s "
+                    f"vs {old['goodput_on']:.2f}/s ({pct:+.1f}%)"
+                )
+            continue  # a paced, fixed-length scenario: wall is by design
         if name == "multitenant_service":
             # the front door must not rot: QPS dropping >20% or p99
             # latency growing >20% both gate (elapsed rides the generic

@@ -53,6 +53,11 @@ _TIMELINE_GROUPS = {
     # the dedicated "alerts" section above prints the same rows with their
     # severities — this keeps them in timeline context with everything else
     "alerts": ("alert_fired",),
+    # the overload ladder's transitions, what it shed at admission, the
+    # per-tenant circuit breakers, and poison-request quarantines
+    # (service/overload.py + the executors' quarantine path)
+    "overload": ("overload_level", "request_shed", "tenant_breaker",
+                 "poison_quarantine"),
 }
 
 #: the data-movement section's metric rows (manifest metrics snapshot);

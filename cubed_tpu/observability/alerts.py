@@ -357,6 +357,25 @@ def default_rules(retry_budget_hint: float = 50.0) -> list:
             "store_breaker_state); expect degraded throughput, raise "
             "provisioned store throughput or lean on the peer data plane",
         ),
+        ThresholdRule(
+            "overload_shedding", metric="overload_level",
+            threshold=2, severity="critical",
+            description="the service's degradation ladder reached L2+: "
+            "deadline-infeasible requests are failed at admission and "
+            "new batch submits are rejected with retry-after hints "
+            "(L3 rejects everything) — check the top OVERLOAD row, the "
+            "overload_level decisions for the signals that drove it, "
+            "and drain or widen the fleet",
+        ),
+        ThresholdRule(
+            "tenant_breaker_open", metric="tenant_breakers_open",
+            threshold=1,
+            description="at least one tenant's circuit breaker is open "
+            "(consecutive request failures hit the trip threshold): "
+            "that tenant's submits are rejected until a half-open probe "
+            "succeeds — check its tenant_breaker decisions and whether "
+            "a poison request (poison_quarantine) is the root cause",
+        ),
     ]
 
 

@@ -59,6 +59,8 @@ KNOB_ATOMS = (
     ("storage_throttle_rate",),
     ("storage_corrupt_rate",),
     ("task_failure_rate",),
+    ("task_fatal_rate",),
+    ("task_fatal_chunk_keys",),
     ("straggler_rate", "straggler_delay_s"),
     ("task_mem_spike_rate", "task_mem_spike_bytes"),
     ("worker_crash_names", "worker_crash_after_tasks"),
@@ -88,6 +90,13 @@ KNOB_DOMAINS = {
     "storage_throttle_rate": "storage",
     "storage_corrupt_rate": "integrity",
     "task_failure_rate": "task",
+    # poison-task knobs: the WORKLOAD is the fault (a request whose chunks
+    # kill their worker every attempt). Deliberately absent from
+    # _DOMAIN_TEMPLATES — a generated campaign expects bitwise success,
+    # and a poison chunk is *supposed* to fail (with PoisonTaskError);
+    # explicit schedules and tests/service/test_overload.py exercise it
+    "task_fatal_rate": "workload",
+    "task_fatal_chunk_keys": "workload",
     "straggler_rate": "task",
     "straggler_delay_s": "task",
     "task_mem_spike_rate": "memory",
@@ -124,10 +133,12 @@ EVENT_DOMAINS = {
 }
 
 #: fleet-side knobs force the distributed in-process fleet (the threaded
-#: executor has no workers to crash, partition, or preempt)
+#: executor has no workers to crash, partition, or preempt — and a
+#: poison task kills a WORKER process, so "workload" is fleet-side too)
 FLEET_KNOBS = frozenset(
     k for k, d in KNOB_DOMAINS.items()
-    if d in ("worker_loss", "elasticity", "partition", "coordinator")
+    if d in ("worker_loss", "elasticity", "partition", "coordinator",
+             "workload")
 )
 
 #: knobs/events that hard-exit the CURRENT process (coordinator crash
@@ -240,6 +251,24 @@ def _wl_rechunk(ct, xp, spec):
     return [("rechunk", lazy, (an + 3.0) * 2.0)]
 
 
+def _wl_overload_flood(ct, xp, spec):
+    """A 2x-overload shape: one tenant floods many small computes while a
+    victim tenant runs one normal reduce — the overload/poison chaos
+    surface. Under a plain campaign every compute must still land
+    bitwise; seeding ``task_fatal_*`` on top of it (explicit schedules,
+    tests/service/test_overload.py) turns a flood chunk into a poison
+    task whose *request* fails while the fleet and the victim survive."""
+    pairs = []
+    for i in range(5):
+        an = np.arange(36, dtype=np.float64).reshape(6, 6) + i
+        a = ct.from_array(an, chunks=(3, 3), spec=spec)
+        pairs.append((f"flood-{i}", a * 2.0 + float(i), an * 2.0 + float(i)))
+    vn = np.arange(144, dtype=np.float64).reshape(12, 12)
+    v = ct.from_array(vn, chunks=(4, 4), spec=spec)
+    pairs.append(("victim", xp.sum(v + 1.0, axis=0), (vn + 1.0).sum(axis=0)))
+    return pairs
+
+
 def _wl_multi_tenant(ct, xp, spec):
     """Two tenants' requests through one runtime, the shape the service
     layer serves — each must land bitwise in spite of the other's load."""
@@ -258,6 +287,7 @@ WORKLOADS = {
     "tree_reduce": _wl_tree_reduce,
     "rechunk": _wl_rechunk,
     "multi_tenant": _wl_multi_tenant,
+    "overload_flood": _wl_overload_flood,
 }
 
 

@@ -928,10 +928,19 @@ class Coordinator:
         # timing out against a corpse
         self.chunk_registry.drop_worker(conn.name)
         exc_cls = WorkerDrainedError if clean else WorkerLostError
-        for task_id, fut in orphans:
-            _fail_future(
-                fut, exc_cls(f"worker {conn.name} lost: {reason}")
-            )
+        nthreads = max(1, conn.nthreads or 1)
+        for idx, (task_id, fut) in enumerate(orphans):
+            err = exc_cls(f"worker {conn.name} lost: {reason}")
+            if not clean:
+                # only the task slots actually executing at the abrupt
+                # death can have CAUSED it. Dispatch and slot execution
+                # are both FIFO and completed tasks pop out of
+                # `outstanding`, so the oldest `nthreads` remaining
+                # entries were the ones running — everything behind them
+                # was merely queued on the corpse and must not collect a
+                # poison-quarantine strike for its neighbor's crime
+                err.was_executing = idx < nthreads
+            _fail_future(fut, err)
         if clean and orphans:
             # tasks still queued on the worker when its drain closed the
             # socket: abandoned like the in-flight ones, requeued free
@@ -2363,7 +2372,7 @@ def run_worker(
     from . import memory
     from . import transfer as p2p
     from .faults import arm_from_wire, get_injector
-    from .utils import execute_with_stats
+    from .utils import chunk_key, execute_with_stats
 
     host, _, port = coordinator.rpartition(":")
     #: mutable dial target: a rendezvous advertisement re-points it at a
@@ -2736,6 +2745,18 @@ def run_worker(
                         # embedded (non-main-thread) worker: no handler to
                         # receive the signal — drain directly
                         _begin_drain("preempted", drain["grace"])
+                if injector.task_fatal(chunk_key(msg["input"])):
+                    # the poison-task chaos shape: THIS input kills every
+                    # worker it lands on (kernel OOM-kill / segfault),
+                    # deterministically per chunk key — abrupt exit, no
+                    # drain, no error frame; the coordinator sees a dead
+                    # link and requeues, and the quarantine path in
+                    # map_unordered must end the loop
+                    logger.warning(
+                        "worker %s: injected poison-task fatal (task %s)",
+                        wname, task_id,
+                    )
+                    os._exit(137)
             blob_id = msg["blob_id"]
             # decode under a lock (concurrent same-blob tasks must not race
             # the decode/pop), inside the task try: an undeserializable op

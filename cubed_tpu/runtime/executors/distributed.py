@@ -468,6 +468,18 @@ class DistributedDagExecutor(DagExecutor):
             "fleet_compute", n_workers=coord.n_workers,
             coordinator=f"{coord.address[0]}:{coord.address[1]}",
         )
+        if coord.n_workers == 0 and (
+            self._autoscaler is not None and self.min_workers > 0
+        ):
+            # the fleet self-heals (autoscaler holds a min_workers floor):
+            # a momentarily empty fleet — e.g. a poison task just took out
+            # every worker at once — is a backfill in flight, not a
+            # configuration error, so ride it out instead of failing the
+            # compute in the gap
+            try:
+                coord.wait_for_workers(1, timeout=self.worker_start_timeout)
+            except TimeoutError:
+                pass  # fall through to the zero-workers diagnostic
         if coord.n_workers == 0:
             # fail fast with a diagnostic instead of letting the first
             # submit discover it mid-plan (min_workers=0 configurations

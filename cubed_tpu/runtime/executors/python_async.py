@@ -47,6 +47,7 @@ from ..pipeline import (
 from ..resilience import (
     DEFAULT_RETRIES,
     Classification,
+    PoisonTaskError,
     RetryBudget,
     RetryPolicy,
     budget_exhausted_error,
@@ -89,6 +90,30 @@ def _count_integrity_failure(metrics, exc) -> None:
             "quarantine", store=str(payload.get("store", "")),
             chunk=payload.get("chunk_key"),
         )
+
+
+def _clean_worker_loss(exc: BaseException) -> bool:
+    """True when a REQUEUE-classified failure was a CLEAN worker exit
+    (drain/preemption — ``WorkerDrainedError``): the worker announced its
+    departure and handed tasks back unexecuted, so it is evidence about
+    the INFRASTRUCTURE, never about the task. Matched by MRO name so this
+    pure-local module never imports the distributed machinery."""
+    return any(
+        c.__name__ == "WorkerDrainedError" for c in type(exc).__mro__
+    )
+
+
+def _overload_sheds_optional() -> bool:
+    """True while any live service OverloadController is at L1 or above:
+    speculative backups are pure extra load, shed first. Late import —
+    the ladder lives in the service layer, and executors must work
+    without it."""
+    try:
+        from ...service.overload import sheds_optional_work
+
+        return sheds_optional_work()
+    except Exception:
+        return False
 
 
 def map_unordered(
@@ -252,6 +277,11 @@ def _map_unordered_batch(
     attempts: Dict[int, int] = {i: 0 for i in range(len(inputs))}
     #: free worker-loss reroutes consumed per input (capped by the policy)
     requeues: Dict[int, int] = {}
+    #: ABRUPT worker deaths per input (lease expiry / verified hard exit —
+    #: never clean drains): the poison-request evidence. One input taking
+    #: out max_requeues + 1 hosts in a row is quarantined with a
+    #: PoisonTaskError instead of burning retries and workers fleet-wide
+    fatal_strikes: Dict[int, int] = {}
     #: min-heap of (due time, input index) retries awaiting their backoff
     delayed: list[tuple[float, int]] = []
     #: inputs ready to run but waiting for an admission slot (memory
@@ -557,6 +587,31 @@ def _map_unordered_batch(
                     record_failed_task(op_of(i), key_of(i), attempt, exc)
                     if (
                         cls is Classification.REQUEUE
+                        and not _clean_worker_loss(exc)
+                        and getattr(exc, "was_executing", True)
+                    ):
+                        # an ABRUPT worker death with THIS task EXECUTING
+                        # (was_executing False marks tasks that were only
+                        # queued on the corpse — innocents, no strike):
+                        # one strike toward the poison verdict. K =
+                        # max_requeues + 1 consecutive worker-fatal
+                        # attempts convicts the task — the workers keep
+                        # dying wherever it lands, so rerouting further
+                        # only feeds it hosts
+                        fatal_strikes[i] = fatal_strikes.get(i, 0) + 1
+                        if fatal_strikes[i] > policy.max_requeues:
+                            metrics.counter("poison_quarantined").inc()
+                            record_decision(
+                                "poison_quarantine", op=op_of(i),
+                                chunk=key_of(i),
+                                attempts=fatal_strikes[i],
+                            )
+                            cancel_pending()
+                            raise PoisonTaskError(
+                                op_of(i), key_of(i), fatal_strikes[i]
+                            ) from exc
+                    if (
+                        cls is Classification.REQUEUE
                         and requeues.get(i, 0) < policy.max_requeues
                     ):
                         # the worker died, not the task: reroute to a
@@ -748,9 +803,14 @@ def _map_unordered_batch(
                 metrics.counter("dispatch_release_s").inc(
                     time.perf_counter() - t_release
                 )
-            if use_backups and not admission.throttling:
-                # no speculative duplicates while degraded for memory: a
-                # backup twin is pure extra footprint
+            if (
+                use_backups
+                and not admission.throttling
+                and not _overload_sheds_optional()
+            ):
+                # no speculative duplicates while degraded for memory (or
+                # while the service overload ladder is shedding optional
+                # work at L1+): a backup twin is pure extra footprint
                 for fut, (i, is_backup, _attempt, _lim) in list(pending.items()):
                     if is_backup or i in done_inputs or i in backups:
                         continue

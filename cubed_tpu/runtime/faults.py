@@ -141,6 +141,17 @@ class FaultConfig:
     worker_preempt_rate: float = 0.0
     worker_preempt_after_tasks: int = 2
     preempt_notice_s: float = 1.0
+    #: POISON-TASK faults (the overload/quarantine chaos shape): a task
+    #: whose chunk key rolls under task_fatal_rate — or is listed in
+    #: task_fatal_chunk_keys — hard-kills its WORKER (os._exit 137,
+    #: modelling a kernel OOM-kill or segfault pinned to one poison
+    #: input). Deterministic PER CHUNK KEY with a fixed occurrence-0 roll:
+    #: every retry/requeue of the same chunk kills its next host too, so
+    #: only the quarantine path (PoisonTaskError after K worker-fatal
+    #: attempts) ever ends it. Fleet-only: fires in run_worker, never in
+    #: thread/process executors (it would kill the client process)
+    task_fatal_rate: float = 0.0
+    task_fatal_chunk_keys: tuple = field(default_factory=tuple)
     #: control-plane message faults, decided per frame at the worker's
     #: framing layer ("tx" = worker→coordinator, "rx" = coordinator→worker):
     #: a dropped frame silently vanishes (the reconnect/outbox/lease
@@ -198,7 +209,7 @@ class FaultConfig:
         d = dict(d)
         for k in (
             "worker_crash_names", "worker_hang_names",
-            "partition_worker_names",
+            "partition_worker_names", "task_fatal_chunk_keys",
         ):
             if k in d:
                 d[k] = tuple(d[k])
@@ -224,6 +235,8 @@ class FaultConfig:
             or (self.worker_crash_names and self.worker_crash_after_tasks)
             or (self.worker_hang_names and self.worker_hang_after_tasks)
             or (self.worker_preempt_rate and self.worker_preempt_after_tasks)
+            or self.task_fatal_rate
+            or self.task_fatal_chunk_keys
             or self.net_msg_drop_rate
             or self.net_msg_dup_rate
             or self.net_msg_delay_rate
@@ -358,6 +371,32 @@ class FaultInjector:
             raise FaultInjectedTaskError(
                 f"injected task failure (seed={self.config.seed}, key={key!r})"
             )
+
+    def task_fatal(self, chunk_key: str) -> bool:
+        """True -> this task's worker must hard-exit (fleet-only call
+        site: ``run_worker``, which ``os._exit(137)``s before executing).
+
+        Unlike every other site this decision does NOT advance an
+        occurrence counter: the roll is a pure function of
+        ``(seed, chunk_key)``, so the same poison chunk kills its host on
+        EVERY attempt — requeues reroute it to a fresh worker and kill
+        that one too, which is exactly the shape the poison-request
+        quarantine must end."""
+        cfg = self.config
+        if not (cfg.task_fatal_rate or cfg.task_fatal_chunk_keys):
+            return False
+        hit = str(chunk_key) in cfg.task_fatal_chunk_keys
+        if not hit and cfg.task_fatal_rate > 0.0:
+            digest = hashlib.sha256(
+                f"{cfg.seed}:task_fatal:{chunk_key}:0".encode()
+            ).digest()
+            hit = (
+                int.from_bytes(digest[:8], "big") / 2**64
+                < cfg.task_fatal_rate
+            )
+        if hit:
+            self._count_injection("task_fatal", key=str(chunk_key)[:120])
+        return hit
 
     def task_mem_spike(self, key: str) -> int:
         """Synthetic memory-spike bytes for this task attempt (0 = none).

@@ -98,6 +98,31 @@ class RetryBudgetExceededError(RuntimeError):
     per-task noise. Carries the triggering task error as ``__cause__``."""
 
 
+class PoisonTaskError(RuntimeError):
+    """One task kills every worker it lands on: the *request* is the fault.
+
+    Raised by the quarantine path in ``map_unordered`` after a single
+    input's task has taken out its worker ``attempts`` times in a row
+    (abrupt deaths only — clean drains/preemptions never count). Names
+    the culprit ``(op, chunk)`` so an operator can find the poison input,
+    and pickles faithfully (``__reduce__``) so the verdict survives pool
+    result queues and the service's durable-journal round trip."""
+
+    def __init__(self, op: str, chunk: str, attempts: int):
+        self.op = str(op)
+        self.chunk = str(chunk)
+        self.attempts = int(attempts)
+        super().__init__(
+            f"poison task quarantined: op {self.op!r} chunk {self.chunk!r} "
+            f"killed its worker on {self.attempts} consecutive attempts "
+            "(OOM-kill/segfault-shaped exits); the request is the fault — "
+            "workers survive, the rest of the fleet is untouched"
+        )
+
+    def __reduce__(self):
+        return (type(self), (self.op, self.chunk, self.attempts))
+
+
 #: exception type names that are near-certainly deterministic programming
 #: errors when raised by a task body: re-running the same idempotent task on
 #: the same input reproduces them bit-for-bit. Matched by name so remote
@@ -202,6 +227,12 @@ class RetryPolicy:
             # abort is an instruction, not a failure — never retried,
             # never drawing budget, locally or off the fleet wire
             return Classification.CANCELLED
+        if isinstance(exc, PoisonTaskError) or getattr(
+            exc, "remote_type", None
+        ) == "PoisonTaskError":
+            # a quarantined poison task: the verdict is final by
+            # construction (it already burned its worker-fatal attempts)
+            return Classification.FAIL_FAST
         if isinstance(exc, (MemoryError, MemoryGuardExceededError)):
             # the task ran out of memory (or the runtime guard caught it
             # about to): retrying at full concurrency recreates the

@@ -11,6 +11,14 @@ from .cache import (  # noqa: F401
     input_state_digest,
     structural_fingerprint,
 )
+from .overload import (  # noqa: F401
+    CostEstimator,
+    DeadlineInfeasibleError,
+    OverloadController,
+    OverloadPolicy,
+    ServiceOverloadedError,
+    TenantBreaker,
+)
 from .service import (  # noqa: F401
     ComputeService,
     RequestCancelledError,
@@ -25,6 +33,12 @@ __all__ = [
     "RequestHandle",
     "RequestCancelledError",
     "TenantThrottledError",
+    "ServiceOverloadedError",
+    "DeadlineInfeasibleError",
+    "OverloadController",
+    "OverloadPolicy",
+    "TenantBreaker",
+    "CostEstimator",
     "FairShareArbiter",
     "ServiceAdmission",
     "PlanCache",

@@ -334,6 +334,15 @@ class PlanCache:
                 get_registry().counter("plan_cache_misses").inc()
             return entry
 
+    def peek(self, fingerprint: Optional[str]) -> Optional[PlanCacheEntry]:
+        """Entry lookup that touches neither the hit/miss counters nor the
+        LRU order — for the overload feasibility estimator (reading the
+        cached plan's task count), not for serving plans."""
+        if fingerprint is None:
+            return None
+        with self._lock:
+            return self._entries.get(fingerprint)
+
     def put(
         self, fingerprint: Optional[str], finalized, canonical: List[str],
     ) -> None:

@@ -135,11 +135,22 @@ class TenantRequestJournal:
         return True
 
     def record_done(self, request_id: str, status: str,
-                    error: Optional[str] = None) -> None:
+                    error: Optional[str] = None,
+                    error_type: Optional[str] = None,
+                    retry_after_s: Optional[float] = None) -> None:
         """Seal one request (``status`` in completed/failed/cancelled) and
-        reclaim its payload — a done request must never be re-run."""
+        reclaim its payload — a done request must never be re-run.
+
+        ``error_type``/``retry_after_s`` carry a TYPED failure through
+        the journal (e.g. an overload shed's ``ServiceOverloadedError``
+        and its retry-after hint), so post-restart inspection sees the
+        same rejection the live handle raised."""
         self._journal.append(
             "done", request_id=request_id, status=status, error=error,
+            error_type=error_type,
+            retry_after_s=(
+                None if retry_after_s is None else float(retry_after_s)
+            ),
         )
         for path in (
             self.payload_path(request_id),

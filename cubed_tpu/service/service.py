@@ -61,7 +61,18 @@ from .cache import (
     input_state_digest,
     structural_fingerprint,
 )
-from .durability import TenantRequestJournal, load_requests
+from .durability import TenantRequestJournal, load_requests, tenant_dirname
+from .overload import (
+    L2_SHED_LOAD,
+    L3_EMERGENCY,
+    CostEstimator,
+    DeadlineInfeasibleError,
+    OverloadController,
+    OverloadPolicy,
+    ServiceOverloadedError,
+    TenantBreaker,
+    overload_env_disabled,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -146,6 +157,10 @@ class ServiceConfig:
         max_queued_per_tenant: int = 1024,
         service_dir: Optional[str] = None,
         recover: bool = True,
+        overload: bool = True,
+        overload_policy: Optional[OverloadPolicy] = None,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 10.0,
     ):
         self.tenants = dict(tenants or {})
         self.default_weight = float(default_weight)
@@ -160,6 +175,12 @@ class ServiceConfig:
             raise ValueError("max_queued_per_tenant must be >= 1")
         self.service_dir = service_dir
         self.recover = bool(recover)
+        #: the overload degradation ladder + per-tenant circuit breakers
+        #: (service/overload.py); CUBED_TPU_OVERLOAD=off disables both
+        self.overload = bool(overload)
+        self.overload_policy = overload_policy
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
 
     @classmethod
     def resolve(
@@ -180,6 +201,10 @@ class ServiceConfig:
                 max_queued_per_tenant=spec_cfg.max_queued_per_tenant,
                 service_dir=spec_cfg.service_dir,
                 recover=spec_cfg.recover,
+                overload=spec_cfg.overload,
+                overload_policy=spec_cfg.overload_policy,
+                breaker_threshold=spec_cfg.breaker_threshold,
+                breaker_cooldown_s=spec_cfg.breaker_cooldown_s,
             )
         elif isinstance(spec_cfg, dict):
             base.update(spec_cfg)
@@ -194,6 +219,10 @@ class ServiceConfig:
                 max_queued_per_tenant=config.max_queued_per_tenant,
                 service_dir=config.service_dir,
                 recover=config.recover,
+                overload=config.overload,
+                overload_policy=config.overload_policy,
+                breaker_threshold=config.breaker_threshold,
+                breaker_cooldown_s=config.breaker_cooldown_s,
             )
         base.update({k: v for k, v in overrides.items() if v is not None})
         resolved = cls(**base)
@@ -212,6 +241,8 @@ class ServiceConfig:
         env_mq = _env_int(MAX_QUEUED_ENV_VAR)
         if env_mq is not None:
             resolved.max_queued_per_tenant = env_mq
+        if overload_env_disabled():
+            resolved.overload = False
         return resolved
 
 
@@ -300,7 +331,7 @@ class _Request:
         "plan_cache_hit", "result_cache_hit", "recovered",
         "resume_journal", "durable", "compute_id", "coalesced_into",
         "fingerprint", "canonical", "cost", "deadline_epoch", "token",
-        "cancel_requested",
+        "cancel_requested", "request_class",
     )
 
     def __init__(self, service: "ComputeService", tenant: str, array,
@@ -340,6 +371,10 @@ class _Request:
         #: True when the client asked for the cancel (distinguishes a
         #: CANCELLED outcome from a deadline FAILURE in _run_request)
         self.cancel_requested = False
+        #: "batch" (default) or "interactive" — the shed ORDER under
+        #: overload: L2 rejects new batch submits first, interactive
+        #: submits are only refused at L3
+        self.request_class = "batch"
 
 
 class _ComputeIdCallback:
@@ -372,7 +407,7 @@ class _CostTracker:
 
     __slots__ = (
         "task_seconds", "bytes_read", "bytes_written", "peer_bytes",
-        "retries",
+        "retries", "tasks",
     )
 
     def __init__(self):
@@ -381,8 +416,10 @@ class _CostTracker:
         self.bytes_written = 0
         self.peer_bytes = 0
         self.retries = 0
+        self.tasks = 0
 
     def on_task_end(self, event) -> None:
+        self.tasks += 1
         start = getattr(event, "function_start_tstamp", None)
         end = getattr(event, "function_end_tstamp", None)
         if start is not None and end is not None:
@@ -401,6 +438,7 @@ class _CostTracker:
             "bytes_written": self.bytes_written,
             "peer_bytes": self.peer_bytes,
             "retries": self.retries,
+            "tasks": self.tasks,
         }
 
 
@@ -410,6 +448,7 @@ class _TenantStats:
         "throttled", "recovered", "plan_cache_hits", "result_cache_hits",
         "coalesced", "cost_task_seconds", "cost_bytes_read",
         "cost_bytes_written", "cost_peer_bytes", "cost_retries",
+        "cost_tasks", "shed",
     )
 
     def __init__(self, weight: float):
@@ -431,6 +470,9 @@ class _TenantStats:
         self.cost_bytes_written = 0
         self.cost_peer_bytes = 0
         self.cost_retries = 0
+        self.cost_tasks = 0
+        #: submissions rejected by the overload ladder / breaker
+        self.shed = 0
 
 
 class ComputeService:
@@ -508,6 +550,15 @@ class ComputeService:
         self._threads: list = []
         self._closed = threading.Event()
         self._started = False
+        #: the overload degradation ladder (None = disabled: config or
+        #: CUBED_TPU_OVERLOAD=off) + the pieces it admits through —
+        #: per-tenant circuit breakers and the feasibility cost model
+        self.overload: Optional[OverloadController] = (
+            OverloadController(self.config.overload_policy)
+            if self.config.overload else None
+        )
+        self.estimator = CostEstimator()
+        self._breakers: Dict[str, TenantBreaker] = {}
 
     # -- lifecycle -----------------------------------------------------
 
@@ -583,6 +634,8 @@ class ComputeService:
         from ..observability.timeseries import unregister_service
 
         unregister_service(self)
+        if self.overload is not None:
+            self.overload.close()
         for j in self._journals.values():
             j.close()
 
@@ -601,12 +654,17 @@ class ComputeService:
     def submit(
         self, array, tenant: str = "default",
         deadline_s: Optional[float] = None,
+        request_class: str = "batch",
     ) -> RequestHandle:
         """Accept one compute for ``tenant``; returns immediately.
 
         Durable when a service_dir is armed (payload + fsync'd accepted
         record before return). Raises :class:`TenantThrottledError` past
-        the tenant's queued-request bound.
+        the tenant's queued-request bound, and
+        :class:`ServiceOverloadedError` (with a ``retry_after_s`` hint)
+        when the overload ladder or the tenant's circuit breaker is
+        shedding — at L2 only ``request_class="batch"`` submits are
+        refused (interactive still lands); at L3 every submit is.
 
         ``deadline_s`` is an END-TO-END deadline from this submission
         (queue wait included): past it the request fails with
@@ -615,10 +673,56 @@ class ComputeService:
         included) within about a task of the deadline."""
         if self._closed.is_set():
             raise RuntimeError("service is closed")
+        if request_class not in ("batch", "interactive"):
+            raise ValueError(
+                "request_class must be 'batch' or 'interactive', got "
+                f"{request_class!r}"
+            )
         if not self._started:
             self.start()
         tenant = str(tenant)
         reg = get_registry()
+        probe_breaker = None
+        if self.overload is not None:
+            with self._lock:
+                depth = sum(len(q) for q in self._queues.values())
+            level = self.overload.tick(depth)
+            if level >= L3_EMERGENCY or (
+                level >= L2_SHED_LOAD and request_class == "batch"
+            ):
+                retry = self.overload.retry_after_s(depth)
+                self._note_shed(
+                    tenant, reason="overload_level", level=level,
+                    request_class=request_class,
+                    retry_after_s=round(retry, 3),
+                )
+                raise ServiceOverloadedError(
+                    f"service is shedding load (overload L{level} "
+                    f"{self.overload.snapshot()['name']!r}): "
+                    f"{request_class} submit for tenant {tenant!r} "
+                    f"rejected; retry after {retry:.1f}s",
+                    retry_after_s=retry,
+                )
+            breaker = self._breaker(tenant)
+            retry = breaker.check()
+            if retry is not None:
+                self._note_shed(
+                    tenant, reason="breaker_open",
+                    strikes=breaker.strikes,
+                    retry_after_s=round(retry, 3),
+                )
+                raise ServiceOverloadedError(
+                    f"tenant {tenant!r} circuit breaker is open "
+                    f"({breaker.strikes} consecutive failures); retry "
+                    f"after {retry:.1f}s",
+                    retry_after_s=retry,
+                )
+            if breaker.state == TenantBreaker.HALF_OPEN:
+                # this submit holds the single half-open probe slot: a
+                # rejection below (throttle bound, journal error) must
+                # hand the slot back, or no probe ever resolves the
+                # breaker
+                probe_breaker = breaker
         with self._lock:
             stats = self._ensure_tenant_locked(tenant)
             q = self._queues.setdefault(tenant, deque())
@@ -635,6 +739,8 @@ class ComputeService:
                     queued=len(q) + reserved,
                     bound=self.config.max_queued_per_tenant,
                 )
+                if probe_breaker is not None:
+                    probe_breaker.abort_probe()
                 raise TenantThrottledError(
                     f"tenant {tenant!r} has {len(q) + reserved} queued "
                     f"request(s) (bound {self.config.max_queued_per_tenant})"
@@ -643,27 +749,31 @@ class ComputeService:
                 )
             self._reserved[tenant] = reserved + 1
         req = _Request(self, tenant, array)
+        req.request_class = request_class
         if deadline_s is not None:
             req.deadline_epoch = time.time() + float(deadline_s)
         enqueue = True
         try:
+            if self.plan_cache is not None or self.result_cache is not None:
+                # computed once here, reused by _execute (the durable
+                # record, the caches, and the overload feasibility gate
+                # all key on the same fingerprint); with both caches off
+                # it is journal metadata only — not worth a
+                # masking-pickle pass per submit
+                req.fingerprint, req.canonical = structural_fingerprint(
+                    array.plan.dag
+                )
             if self.config.service_dir:
                 journal = self._tenant_journal(tenant)
-                if self.plan_cache is not None or self.result_cache is not None:
-                    # computed once here, reused by _execute (the durable
-                    # record and the caches key on the same fingerprint);
-                    # with both caches off it is journal metadata only —
-                    # not worth a masking-pickle pass per submit
-                    req.fingerprint, req.canonical = structural_fingerprint(
-                        array.plan.dag
-                    )
                 req.durable = journal.record_accepted(
                     req.request_id, array, fingerprint=req.fingerprint,
                     deadline_epoch=req.deadline_epoch,
                 )
         except BaseException:
             enqueue = False  # never hand the queue a request the caller
-            raise            # believes was rejected
+            if probe_breaker is not None:  # believes was rejected
+                probe_breaker.abort_probe()
+            raise
         finally:
             with self._work:
                 self._reserved[tenant] -= 1
@@ -695,6 +805,11 @@ class ComputeService:
         reg = get_registry()
         for tenant, records in pending.items():
             journal = self._tenant_journal(tenant)
+            if self.overload is not None:
+                # re-arm the tenant's durable breaker NOW: a breaker that
+                # was open at the crash must reject this tenant's next
+                # submit, not wait for its first post-restart failure
+                self._breaker(tenant)
             for rec in records:
                 rid = rec["request_id"]
                 if rec["payload_path"] is None:
@@ -722,6 +837,10 @@ class ComputeService:
                 # passed during the outage fails at admission with the
                 # typed error instead of running unbounded
                 req.deadline_epoch = rec.get("deadline_epoch")
+                # the fingerprint too: the overload feasibility gate keys
+                # the plan-cache task count on it, so a recovered request
+                # sheds with the same typed rejection a live one would
+                req.fingerprint = rec.get("fingerprint")
                 req.resume_journal = rec["compute_journal"]
                 with self._work:
                     stats = self._ensure_tenant_locked(tenant)
@@ -749,6 +868,15 @@ class ComputeService:
         while not self._closed.is_set():
             req = None
             try:
+                if self.overload is not None:
+                    # the ladder's policy loop rides the dispatch loop:
+                    # the controller self-limits to its tick interval, so
+                    # this is ~4 signal reads a second, not 5 a wait-cycle
+                    with self._lock:
+                        depth = sum(
+                            len(q) for q in self._queues.values()
+                        )
+                    self.overload.tick(depth)
                 with self._work:
                     req = self._next_admissible_locked()
                     if req is None:
@@ -815,6 +943,7 @@ class ComputeService:
             req.token.cancel("client cancel")
         try:
             req.token.check()  # expired while queued: fail at admission
+            self._check_feasible(req)
             value = self._execute(req)
         except _RequeueRequest:
             # a coalesced follower whose leader was cancelled: back onto
@@ -849,6 +978,7 @@ class ComputeService:
                     "service_request_failed", tenant=req.tenant,
                     request=req.request_id, error=type(e).__name__,
                 )
+                self._note_outcome(req, ok=False, deadline_missed=True)
                 self._finish(req, FAILED, error=e)
             else:
                 # a client cancel (or shutdown) that reached a RUNNING
@@ -883,6 +1013,11 @@ class ComputeService:
                 "service_request_failed", tenant=req.tenant,
                 request=req.request_id, error=type(e).__name__,
             )
+            if not isinstance(e, ServiceOverloadedError):
+                # a shed is the SERVICE's decision, not evidence about
+                # the tenant's workload: it must not feed the breaker or
+                # the miss window, or shedding would self-amplify
+                self._note_outcome(req, ok=False)
             self._finish(req, FAILED, error=e)
         else:
             with self._lock:
@@ -893,6 +1028,7 @@ class ComputeService:
                 if req.result_cache_hit:
                     stats.result_cache_hits += 1
             reg.counter("service_requests_completed").inc()
+            self._note_outcome(req, ok=True)
             if not req.result_cache_hit:
                 # only a request that actually EXECUTED is evidence the
                 # fleet can take more load: cache hits and coalesced
@@ -1093,6 +1229,7 @@ class ComputeService:
             kwargs["resume"] = True
         if req.token is not None:
             kwargs["cancellation"] = req.token
+        t0 = time.monotonic()
         try:
             plan.execute(
                 executor=self.executor,
@@ -1107,6 +1244,12 @@ class ComputeService:
             # either way, so per-tenant accounting reflects consumption,
             # not just successful consumption
             self._fold_cost(req, cost)
+        # only a SUCCESSFUL run teaches the feasibility model (a failed
+        # or aborted one under-counts its tasks, and a poisoned tenant
+        # polluting its own rate would distort the global fallback)
+        self.estimator.observe(
+            req.tenant, cost.tasks, time.monotonic() - t0
+        )
         target = finalized.dag.nodes[target_name]["target"]
         arr = open_if_lazy_zarr_array(target)
         out = arr[...] if getattr(arr, "shape", ()) else arr[()]
@@ -1121,6 +1264,7 @@ class ComputeService:
             stats.cost_bytes_written += cost.bytes_written
             stats.cost_peer_bytes += cost.peer_bytes
             stats.cost_retries += cost.retries
+            stats.cost_tasks += cost.tasks
 
     # -- completion / cancel -------------------------------------------
 
@@ -1147,6 +1291,12 @@ class ComputeService:
                         f"{type(error).__name__}: {error}"
                         if error is not None else None
                     ),
+                    # structured fields so a typed rejection (and its
+                    # retry-after hint) survives the journal round trip
+                    error_type=(
+                        type(error).__name__ if error is not None else None
+                    ),
+                    retry_after_s=getattr(error, "retry_after_s", None),
                 )
             except Exception:
                 logger.exception(
@@ -1226,6 +1376,116 @@ class ComputeService:
                 self._journals[tenant] = j
             return j
 
+    # -- overload / breakers -------------------------------------------
+
+    def _breaker(self, tenant: str) -> TenantBreaker:
+        """The tenant's circuit breaker (created on first use; durable
+        beside the tenant's request journal when a service_dir is armed,
+        so a tripped breaker survives a service SIGKILL)."""
+        with self._lock:
+            b = self._breakers.get(tenant)
+            if b is None:
+                state_path = None
+                if self.config.service_dir:
+                    d = os.path.join(
+                        self.config.service_dir, tenant_dirname(tenant)
+                    )
+                    try:
+                        os.makedirs(d, exist_ok=True)
+                        state_path = os.path.join(d, "breaker.json")
+                    except OSError:
+                        pass  # volatile breaker beats no breaker
+                b = TenantBreaker(
+                    tenant,
+                    threshold=self.config.breaker_threshold,
+                    cooldown_s=self.config.breaker_cooldown_s,
+                    state_path=state_path,
+                )
+                self._breakers[tenant] = b
+            return b
+
+    def _note_shed(self, tenant: str, reason: str, **extra) -> None:
+        with self._lock:
+            self._ensure_tenant_locked(tenant).shed += 1
+        get_registry().counter("requests_shed").inc()
+        record_decision(
+            "request_shed", tenant=tenant, reason=reason, **extra
+        )
+
+    def _note_outcome(
+        self, req: _Request, ok: bool, deadline_missed: bool = False,
+    ) -> None:
+        """Feed one request outcome to the overload signals: the
+        deadline-miss window and the tenant's breaker."""
+        if self.overload is None:
+            return
+        self.overload.note_completion(deadline_missed)
+        breaker = self._breaker(req.tenant)
+        if ok:
+            breaker.on_success()
+        else:
+            breaker.on_failure()
+
+    def _plan_task_count(self, req: _Request) -> Optional[int]:
+        """Task count of the request's cached FinalizedPlan (None when
+        the plan cache has never seen this fingerprint — the feasibility
+        gate fails open on a cold cache)."""
+        if self.plan_cache is None or req.fingerprint is None:
+            return None
+        entry = self.plan_cache.peek(req.fingerprint)
+        if entry is None:
+            return None
+        try:
+            total = 0
+            dag = entry.finalized.dag
+            for name in dag.nodes:
+                node = dag.nodes[name]
+                if node.get("type") != "op":
+                    continue
+                pop = node.get("primitive_op")
+                n = getattr(pop, "num_tasks", None)
+                if n:
+                    total += int(n)
+            return total or None
+        except Exception:
+            return None
+
+    def _check_feasible(self, req: _Request) -> None:
+        """L2+ deadline-feasibility admission: estimated cost (cached
+        plan task count x the tenant's observed seconds-per-task rate)
+        against the time left to the deadline. Either side unknown ->
+        fail OPEN — a cold service must not reject its first requests."""
+        ctl = self.overload
+        if (
+            ctl is None
+            or ctl.level < L2_SHED_LOAD
+            or req.deadline_epoch is None
+        ):
+            return
+        num_tasks = self._plan_task_count(req)
+        est = self.estimator.estimate_s(req.tenant, num_tasks)
+        if est is None:
+            return
+        remaining = req.deadline_epoch - time.time()
+        if est <= remaining:
+            return
+        with self._lock:
+            depth = sum(len(q) for q in self._queues.values())
+        retry = ctl.retry_after_s(depth)
+        self._note_shed(
+            req.tenant, reason="deadline_infeasible",
+            request=req.request_id, estimated_s=round(est, 3),
+            remaining_s=round(remaining, 3),
+            retry_after_s=round(retry, 3),
+        )
+        raise DeadlineInfeasibleError(
+            f"request {req.request_id} is deadline-infeasible: "
+            f"~{est:.1f}s of estimated work against {remaining:.1f}s to "
+            "its deadline — shed at admission instead of running to a "
+            f"guaranteed SLO miss; retry after {retry:.1f}s",
+            retry_after_s=retry,
+        )
+
     @staticmethod
     def _is_resource_failure(exc: BaseException) -> bool:
         from ..runtime.memory import MemoryGuardExceededError
@@ -1274,6 +1534,11 @@ class ComputeService:
                     "coalesced": s.coalesced,
                     "plan_cache_hits": s.plan_cache_hits,
                     "result_cache_hits": s.result_cache_hits,
+                    "shed": s.shed,
+                    "breaker": (
+                        self._breakers[name].snapshot()
+                        if name in self._breakers else None
+                    ),
                     # cumulative cost accounting — the sampler turns these
                     # into the tenant_cost_* series (/metrics), and the
                     # cubed_tpu.top COST panel renders them
@@ -1283,13 +1548,32 @@ class ComputeService:
                         "bytes_written": s.cost_bytes_written,
                         "peer_bytes": s.cost_peer_bytes,
                         "retries": s.cost_retries,
+                        "tasks": s.cost_tasks,
                     },
                 }
             queue_depth = sum(len(q) for q in self._queues.values())
             running = len(self._running)
+            breakers = dict(self._breakers)
+        open_breakers = sorted(
+            t for t, b in breakers.items() if b.is_open
+        )
         reg.gauge("service_queue_depth").set(queue_depth)
         reg.gauge("service_running").set(running)
+        reg.gauge("tenant_breakers_open").set(len(open_breakers))
+        overload = {
+            "enabled": self.overload is not None,
+            "requests_shed": int(reg.counter("requests_shed").value),
+            "breakers_open": open_breakers,
+        }
+        if self.overload is not None:
+            overload.update(self.overload.snapshot())
+        else:
+            overload.update(
+                {"level": 0, "name": "disabled", "transitions": 0,
+                 "miss_rate": 0.0}
+            )
         return {
+            "overload": overload,
             "tenants": tenants,
             "queue_depth": queue_depth,
             "running": running,

@@ -196,6 +196,20 @@ def render(snapshot: dict, width: int = 100) -> str:
     # -- tenants (multi-tenant service front door) ---------------------
     service = snapshot.get("service") or {}
     tenants = service.get("tenants") or {}
+    overload = service.get("overload") or {}
+    if overload.get("enabled") or overload.get("breakers_open"):
+        level = overload.get("level", 0)
+        name = overload.get("name", "normal")
+        breakers = overload.get("breakers_open") or []
+        out.append(
+            f"OVERLOAD  L{level} ({name})  "
+            f"shed {overload.get('requests_shed', 0)}  "
+            f"transitions {overload.get('transitions', 0)}  "
+            f"miss-rate {overload.get('miss_rate', 0.0):.0%}  "
+            "breakers open "
+            f"{','.join(breakers) if breakers else '-'}"
+        )
+        out.append("")
     if tenants:
         throttle = " THROTTLING" if service.get("throttling") else ""
         out.append(

@@ -127,6 +127,7 @@ def test_default_rules_cover_the_documented_shapes():
         "retry_budget_burn", "fleet_memory_pressure", "straggler_rate",
         "queue_depth_stall", "peer_fetch_fallback_spike",
         "tenant_starvation", "store_brownout", "dispatch_saturation",
+        "overload_shedding", "tenant_breaker_open",
     }
 
 

@@ -1,0 +1,181 @@
+"""Poison-request quarantine, unit level: the ``task_fatal`` injector
+knobs (a chunk whose task hard-kills its worker on EVERY attempt), the
+worker-fatal strike counting in ``map_unordered``, and the
+``PoisonTaskError`` verdict's pickling + fail-fast classification.
+
+The live-fleet proof (seeded poison chunk on a real 2-worker fleet under
+a 2x flood) lives in ``tests/service/test_overload.py``.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import pickle
+import time
+
+import pytest
+
+from cubed_tpu.observability.collect import decisions_since
+from cubed_tpu.observability.metrics import get_registry
+from cubed_tpu.runtime import faults
+from cubed_tpu.runtime.executors.python_async import map_unordered
+from cubed_tpu.runtime.resilience import (
+    Classification,
+    PoisonTaskError,
+    RetryPolicy,
+)
+
+
+# -- the task_fatal injector knobs ---------------------------------------
+
+
+def test_task_fatal_is_deterministic_and_pinned_to_occurrence_zero():
+    """The fatal verdict is a pure function of (seed, chunk_key) — the
+    SAME chunk re-kills on every attempt (no occurrence advance), which
+    is exactly the poison shape the quarantine must end."""
+    inj = faults.FaultInjector(
+        faults.FaultConfig(seed=7, task_fatal_rate=0.3)
+    )
+    verdicts = {k: inj.task_fatal(k) for k in (f"('a', {i})" for i in range(40))}
+    assert any(verdicts.values()) and not all(verdicts.values())
+    # re-asking never changes the answer: retries of a poison chunk
+    # re-kill, retries of a clean chunk stay clean
+    for _ in range(3):
+        for k, v in verdicts.items():
+            assert inj.task_fatal(k) is v
+    # a fresh injector with the same seed replays identically...
+    inj2 = faults.FaultInjector(
+        faults.FaultConfig(seed=7, task_fatal_rate=0.3)
+    )
+    assert {k: inj2.task_fatal(k) for k in verdicts} == verdicts
+    # ...and a different seed picks different victims
+    inj3 = faults.FaultInjector(
+        faults.FaultConfig(seed=8, task_fatal_rate=0.3)
+    )
+    assert {k: inj3.task_fatal(k) for k in verdicts} != verdicts
+
+
+def test_task_fatal_explicit_chunk_keys_and_counting():
+    """An explicitly named chunk key is fatal regardless of rate, every
+    hit is counted (faults_injected + faults_injected_task_fatal), and
+    an unarmed injector never fires."""
+    before = get_registry().snapshot()
+    inj = faults.FaultInjector(
+        faults.FaultConfig(seed=1, task_fatal_chunk_keys=("('x', 0, 0)",))
+    )
+    assert inj.task_fatal("('x', 0, 0)") is True
+    assert inj.task_fatal("('x', 0, 1)") is False
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("faults_injected", 0) == 1
+    assert delta.get("faults_injected_task_fatal", 0) == 1
+    # both knobs at zero: no rolls, no counting
+    off = faults.FaultInjector(faults.FaultConfig(seed=1))
+    assert off.task_fatal("('x', 0, 0)") is False
+
+
+# -- the PoisonTaskError verdict -----------------------------------------
+
+
+def test_poison_task_error_pickles_and_classifies_fail_fast():
+    err = PoisonTaskError("op-add-000000003", "('array-x', 1, 2)", 4)
+    assert "op-add-000000003" in str(err) and "('array-x', 1, 2)" in str(err)
+    rt = pickle.loads(pickle.dumps(err))
+    assert (rt.op, rt.chunk, rt.attempts) == (err.op, err.chunk, err.attempts)
+    policy = RetryPolicy()
+    assert policy.classify(err) is Classification.FAIL_FAST
+    # the verdict crossing the fleet wire by type NAME classifies the same
+    remote = RuntimeError("remote poison")
+    remote.remote_type = "PoisonTaskError"
+    assert policy.classify(remote) is Classification.FAIL_FAST
+
+
+# -- quarantine in map_unordered -----------------------------------------
+
+
+def _worker_lost(kind="abrupt"):
+    from cubed_tpu.runtime.distributed import (
+        WorkerDrainedError,
+        WorkerLostError,
+    )
+
+    if kind == "drained":
+        return WorkerDrainedError("worker w0 drained (preemption notice)")
+    return WorkerLostError("worker w0 died abruptly (exitcode 137)")
+
+
+def test_map_unordered_quarantines_abrupt_worker_fatal_strikes():
+    """One input whose task takes out its worker on every attempt: after
+    max_requeues + 1 abrupt losses the quarantine convicts THAT input
+    with a PoisonTaskError naming it, instead of requeueing forever."""
+    calls = {"poison": 0}
+
+    def work(i, config=None):
+        if i == 3:
+            calls["poison"] += 1
+            raise _worker_lost("abrupt")
+        return i
+
+    before = get_registry().snapshot()
+    t0 = time.time()
+    policy = RetryPolicy(retries=2, backoff_base=0.01, max_requeues=2)
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+        with pytest.raises(PoisonTaskError) as exc_info:
+            map_unordered(
+                pool, work, list(range(8)), retry_policy=policy
+            )
+    err = exc_info.value
+    # K = max_requeues + 1 consecutive worker-fatal attempts convicts
+    assert err.attempts == policy.max_requeues + 1 == calls["poison"]
+    assert err.chunk == "3"
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("poison_quarantined", 0) == 1
+    quarantines = [
+        d for d in decisions_since(t0) if d["kind"] == "poison_quarantine"
+    ]
+    assert quarantines and quarantines[0]["chunk"] == "3"
+    assert quarantines[0]["attempts"] == err.attempts
+
+
+def test_clean_worker_drains_never_count_as_poison_strikes():
+    """A drain/preemption is the INFRASTRUCTURE's announced exit, not
+    evidence about the task: the same number of consecutive losses that
+    would convict a poison task requeues for free and completes."""
+    failures = {"n": 0}
+
+    def work(i, config=None):
+        if i == 3 and failures["n"] < 3:  # 3 would convict if abrupt
+            failures["n"] += 1
+            raise _worker_lost("drained")
+        return i
+
+    before = get_registry().snapshot()
+    policy = RetryPolicy(retries=2, backoff_base=0.01, max_requeues=3)
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+        map_unordered(pool, work, list(range(8)), retry_policy=policy)
+    delta = get_registry().snapshot_delta(before)
+    assert delta.get("poison_quarantined", 0) == 0
+    assert delta.get("worker_loss_requeues", 0) >= 3
+
+
+def test_quarantine_cancels_pending_work_for_the_request():
+    """The conviction ends the WHOLE request promptly: siblings that
+    never ran are cancelled rather than executed after the verdict."""
+    started = set()
+
+    def work(i, config=None):
+        started.add(i)
+        if i == 0:
+            raise _worker_lost("abrupt")
+        time.sleep(0.3)  # siblings outlive the ~2 instant poison strikes
+        return i
+
+    policy = RetryPolicy(retries=1, backoff_base=0.01, max_requeues=1)
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as pool:
+        with pytest.raises(PoisonTaskError):
+            map_unordered(
+                pool, work, list(range(16)), retry_policy=policy,
+                batch_size=4,
+            )
+    # the verdict lands inside the first batch: later batches are never
+    # pulled, so the tail of the input list never starts
+    assert started <= set(range(8)) and len(started) < 16
