@@ -15,6 +15,7 @@ import logging
 import os
 import shutil
 import tempfile
+import time
 import uuid
 from functools import lru_cache
 from typing import Any, Callable, Optional, Sequence
@@ -323,6 +324,28 @@ class Plan:
             # AND bundles should attach one FlightRecorder and export from
             # it (observability/flightrecorder.py)
             all_callbacks.append(FlightRecorder(bundle_dir=recorder_dir))
+        # durable run-history archive (observability/runhistory.py): a
+        # compact record per compute — fingerprint, wall clock, analyze()
+        # buckets, outcome — appended at completion. The bucket
+        # decomposition needs the merged task spans, so arming run_history
+        # attaches a TraceCollector when the caller (or the flight
+        # recorder above) didn't already bring one; an existing collector
+        # is reused, never doubled (same single-collector rule as the
+        # operator flight recorder)
+        run_history_dir = getattr(spec, "run_history", None)
+        run_collector = None
+        if run_history_dir:
+            run_collector = next(
+                (
+                    cb for cb in all_callbacks
+                    if isinstance(cb, TraceCollector)
+                ),
+                None,
+            )
+            if run_collector is None:
+                run_collector = TraceCollector()
+                all_callbacks.append(run_collector)
+        run_started_at = time.monotonic()
         metrics_before = get_registry().snapshot()
 
         callbacks_on(
@@ -439,6 +462,24 @@ class Plan:
                     error=compute_error,
                 ),
             )
+            if run_history_dir:
+                # after on_compute_end so the collector's trace is sealed;
+                # the append itself never raises (archive discipline)
+                from ..observability import runhistory
+
+                # fingerprint the PRE-finalize dag: finalized lazy targets
+                # carry per-build store paths that defeat the structural
+                # masking, and the service fingerprints pre-finalize too —
+                # archive records and plan-cache keys must agree
+                runhistory.record_compute(
+                    run_history_dir,
+                    compute_id=compute_id,
+                    dag=self.dag,
+                    error=compute_error,
+                    stats=stats,
+                    collector=run_collector,
+                    wall_clock_s=time.monotonic() - run_started_at,
+                )
 
     # -- introspection -----------------------------------------------------
 

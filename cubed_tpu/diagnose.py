@@ -190,6 +190,37 @@ def render_report(bundle: dict, timeline_limit: int = 20) -> str:
                 f"  +{(a.get('ts', 0) - t0):8.3f}s {format_alert_row(a)}"
             )
 
+    # per-tenant SLO posture at bundle time, from the bundled time-series
+    # dump (the sampler publishes slo_* series whenever a service with SLO
+    # specs is live) — last point per series, grouped by tenant
+    slo_rows: dict = {}
+    for s in m.get("timeseries") or []:
+        name = s.get("name") or ""
+        tenant = (s.get("labels") or {}).get("tenant")
+        points = s.get("points") or []
+        if name.startswith("slo_") and tenant and points:
+            slo_rows.setdefault(tenant, {})[name] = points[-1][1]
+    if slo_rows:
+        out.append(_section("SLOs (at bundle time)"))
+        for tenant, row in sorted(slo_rows.items()):
+            budget = row.get("slo_budget_remaining")
+            out.append(
+                f"  {tenant:<20} budget "
+                + (f"{budget:>6.0%}" if isinstance(budget, (int, float))
+                   else "     -")
+                + "  burn "
+                + " ".join(
+                    f"{w}={row[f'slo_burn_{w}']:.1f}"
+                    for w in ("5m", "1h", "6h", "3d")
+                    if isinstance(row.get(f"slo_burn_{w}"), (int, float))
+                )
+                + (
+                    f"  p99 {_fmt_s(row.get('slo_request_latency_p99'))}"
+                    if row.get("slo_request_latency_p99") is not None
+                    else ""
+                )
+            )
+
     stragglers = m.get("stragglers") or []
     if stragglers:
         out.append(_section("top stragglers"))
@@ -340,6 +371,12 @@ def main(argv: Optional[list] = None) -> int:
         "path + wall-clock attribution (kernel/storage/peer/queue/retry/"
         "straggler buckets) from the bundle's trace",
     )
+    parser.add_argument(
+        "--history", default=None,
+        help="run-history directory (runs.jsonl): append the REGRESSION "
+        "section diffing this bundle's compute against its archived "
+        "baseline (same plan fingerprint)",
+    )
     args = parser.parse_args(argv)
     try:
         bundle = load_bundle(args.bundle)
@@ -357,6 +394,41 @@ def main(argv: Optional[list] = None) -> int:
             # an old/partial bundle (no trace.json, no task spans) still
             # renders the base report — analysis degrades with a note
             sys.stdout.write(f"analysis unavailable: {e}\n")
+    if args.history:
+        from .observability.analytics import regression_diff, render_regression
+        from .observability.runhistory import find_baseline, load_runs
+
+        sys.stdout.write(_section("regression") + "\n")
+        records, _bad = load_runs(args.history)
+        compute_id = (bundle.get("manifest") or {}).get("compute_id")
+        current = next(
+            (
+                r for r in reversed(records)
+                if r.get("kind") == "compute"
+                and r.get("compute_id") == compute_id
+            ),
+            None,
+        )
+        baseline = find_baseline(
+            records,
+            current.get("fingerprint") if current else None,
+            before_ts=current.get("ts") if current else None,
+            exclude_compute_id=compute_id,
+        ) if current else None
+        if current is None or not current.get("buckets"):
+            sys.stdout.write(
+                f"no diffable archive record for {compute_id!r} under "
+                f"{args.history!r}\n"
+            )
+        elif baseline is None:
+            sys.stdout.write(
+                "no comparable baseline in the archive (same fingerprint, "
+                "earlier, OK, with a decomposition)\n"
+            )
+        else:
+            sys.stdout.write(render_regression(
+                regression_diff(baseline, current)
+            ))
     return 0
 
 

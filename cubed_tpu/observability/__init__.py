@@ -39,6 +39,15 @@ in ``runtime/types.py``); this package turns that stream into
   and the dispatch-saturation flight deck (``dispatch_utilization`` /
   ``dispatch_capacity_estimate`` gauges, the ``dispatch_saturation``
   alert, the ``top`` DISPATCH panel) (``dispatchprofile``);
+- **SLOs & run history**: a durable, bounded, torn-line-tolerant run
+  archive (``runs.jsonl`` via ``Spec(run_history=...)`` / the service's
+  ``service_dir``) records every compute/request outcome with its
+  ``analyze()`` bucket decomposition (``runhistory``); per-tenant
+  :class:`SloSpec` objectives are evaluated into error budgets that
+  survive restarts and multi-window burn rates (``slo``), alerted by
+  ``slo_fast_burn`` / ``slo_slow_burn``, and cross-run regressions are
+  attributed bucket-by-bucket by ``python -m cubed_tpu.regress`` /
+  ``analyze(baseline=...)``;
 - **compute analytics**: :func:`explain` / ``plan.explain()`` renders the
   finalized plan's predictions pre-execution (task counts, projected vs
   allowed memory, predicted IO, fusion + scheduler/barrier decisions;
@@ -63,6 +72,8 @@ from .analytics import (  # noqa: F401
     ExplainReport,
     analyze,
     explain,
+    regression_diff,
+    render_regression,
 )
 from .callback import TracingCallback  # noqa: F401
 from .collect import (  # noqa: F401
@@ -75,9 +86,19 @@ from .alerts import (  # noqa: F401
     AlertRule,
     BurnRateRule,
     DispatchSaturationRule,
+    SloBurnRateRule,
     StallRule,
     ThresholdRule,
     default_rules,
+)
+from .runhistory import (  # noqa: F401
+    RunHistory,
+    find_baseline,
+    load_runs,
+)
+from .slo import (  # noqa: F401
+    SloBoard,
+    SloSpec,
 )
 from .dispatchprofile import (  # noqa: F401
     DispatchProfiler,

@@ -294,6 +294,65 @@ class DispatchSaturationRule(AlertRule):
         }
 
 
+class SloBurnRateRule(AlertRule):
+    """Multi-window multi-burn-rate SLO alert (the SRE-workbook shape).
+
+    The service's :class:`~cubed_tpu.observability.slo.SloBoard`
+    publishes each tenant's burn rate over four windows as
+    ``slo_burn_{5m,1h,6h,3d}{tenant=...}`` series (burn 1.0 = spending
+    the error budget exactly as fast as the objective tolerates). A
+    rule pairs a LONG window (the page signal: enough evidence that the
+    budget is truly bleeding) with a SHORT window (the reset signal:
+    the alert clears quickly once the bleeding stops) and fires for any
+    tenant whose burn exceeds ``threshold`` on BOTH.
+
+    Two instances ship in :func:`default_rules`: ``slo_fast_burn``
+    (5m + 1h at 14.4x — page-grade, that pace empties a 3-day budget in
+    ~5 hours) and ``slo_slow_burn`` (6h + 3d at 1x — warn-grade, a
+    sustained slow leak). Stale series (a closed service) are no-data,
+    not a firing."""
+
+    STALE_AFTER_S = 10.0
+
+    def __init__(
+        self, name: str, long_window: str, short_window: str,
+        threshold: float, description: str = "",
+        severity: str = "warning",
+    ):
+        super().__init__(name, description, severity)
+        self.long_series = f"slo_burn_{long_window}"
+        self.short_series = f"slo_burn_{short_window}"
+        self.threshold = float(threshold)
+
+    def evaluate(self, store, now: float) -> Optional[dict]:
+        burning = []
+        worst = 0.0
+        for sname, labels, _latest in store.latest_series():
+            if sname != self.long_series or "tenant" not in labels:
+                continue
+            long_pt = store.latest_point(self.long_series, labels=labels)
+            short_pt = store.latest_point(self.short_series, labels=labels)
+            ok = True
+            for pt in (long_pt, short_pt):
+                if pt is None or now - pt[0] > self.STALE_AFTER_S:
+                    ok = False  # a frozen board must not page forever
+                    break
+            if not ok:
+                continue
+            if long_pt[1] >= self.threshold and short_pt[1] >= self.threshold:
+                burning.append(labels["tenant"])
+                worst = max(worst, float(long_pt[1]), float(short_pt[1]))
+        if not burning:
+            return None
+        return {
+            "metric": self.long_series,
+            "value": round(worst, 4),
+            "threshold": self.threshold,
+            "tenants": sorted(burning),
+            "short_window": self.short_series,
+        }
+
+
 def default_rules(retry_budget_hint: float = 50.0) -> list:
     """The standing rule set, covering the runtime's known failure shapes.
 
@@ -375,6 +434,25 @@ def default_rules(retry_budget_hint: float = 50.0) -> list:
             "that tenant's submits are rejected until a half-open probe "
             "succeeds — check its tenant_breaker decisions and whether "
             "a poison request (poison_quarantine) is the root cause",
+        ),
+        SloBurnRateRule(
+            "slo_fast_burn", long_window="1h", short_window="5m",
+            threshold=14.4, severity="critical",
+            description="a tenant's SLO error budget is burning >=14.4x "
+            "faster than its objective tolerates on BOTH the 1h and 5m "
+            "windows — at this pace a 3-day budget empties in ~5 hours; "
+            "page-grade: check the top SLO panel, the tenant's "
+            "slo_request_latency quantiles, and run "
+            "python -m cubed_tpu.regress to name the regressed bucket",
+        ),
+        SloBurnRateRule(
+            "slo_slow_burn", long_window="3d", short_window="6h",
+            threshold=1.0, severity="warning",
+            description="a tenant's SLO error budget is being spent "
+            "faster than it accrues on BOTH the 3d and 6h windows — a "
+            "sustained slow leak that will exhaust the budget before "
+            "the compliance window rolls; warn-grade: schedule the "
+            "regression hunt before it becomes a page",
         ),
     ]
 

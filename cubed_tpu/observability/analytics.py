@@ -903,6 +903,7 @@ def analyze(
     bundle_dir: Optional[str] = None,
     explain_report: Optional[ExplainReport] = None,
     top_k: int = 5,
+    baseline=None,
 ) -> AnalysisReport:
     """ANALYZE a finished compute: critical path + wall-clock attribution.
 
@@ -913,6 +914,13 @@ def analyze(
     :class:`~cubed_tpu.observability.collect.TraceCollector` /
     ``FlightRecorder``. Pass the plan's :class:`ExplainReport` as
     ``explain_report`` to also diff predicted bytes against measured.
+
+    ``baseline`` (a run-history compute record from
+    :func:`~cubed_tpu.observability.runhistory.load_runs` /
+    ``find_baseline``, or a prior :class:`AnalysisReport` / its data
+    dict) adds a ``regression`` section: the bucket-by-bucket and per-op
+    diff against that earlier run of the same plan
+    (:func:`regression_diff`).
     """
     bundle = _resolve_target(target, bundle_dir)
     manifest = bundle.get("manifest") or {}
@@ -964,7 +972,212 @@ def analyze(
         "stragglers": manifest.get("stragglers") or [],
         "tasks_analyzed": len(tasks),
     }
+    if baseline is not None:
+        data["regression"] = regression_diff(baseline, data)
     return AnalysisReport(data)
+
+
+# ----------------------------------------------------------------------
+# cross-run regression attribution
+# ----------------------------------------------------------------------
+
+#: a run is only called regressed when it is at least this much slower
+#: than its baseline — sub-10% wall-clock wiggle is scheduling noise on
+#: small computes, not a regression worth naming
+REGRESSION_RATIO = 1.10
+
+
+def _normalize_run(obj) -> Dict[str, Any]:
+    """One shape for both comparands: ``{compute_id, ts, wall_clock_s,
+    buckets, per_op}``. Accepts a run-history compute record (``buckets``
+    / ``per_op`` keys), an :class:`AnalysisReport`, or its data dict
+    (``attribution`` / ``per_op`` keys)."""
+    if isinstance(obj, AnalysisReport):
+        obj = obj.to_dict()
+    if not isinstance(obj, dict):
+        raise TypeError(
+            "regression comparand must be a run-history record, an "
+            f"AnalysisReport, or its data dict — got {type(obj).__name__}"
+        )
+    buckets = obj.get("buckets")
+    if buckets is None:
+        buckets = obj.get("attribution") or {}
+    per_op = {}
+    for name, row in (obj.get("per_op") or {}).items():
+        if isinstance(row, dict):
+            per_op[name] = {
+                "busy_s": float(row.get("busy_s") or 0.0),
+                "buckets": {
+                    k: float(v)
+                    for k, v in (row.get("buckets") or {}).items()
+                    if isinstance(v, (int, float))
+                },
+            }
+    return {
+        "compute_id": obj.get("compute_id"),
+        "ts": obj.get("ts"),
+        "wall_clock_s": obj.get("wall_clock_s"),
+        "buckets": {
+            k: float(v) for k, v in buckets.items()
+            if isinstance(v, (int, float))
+        },
+        "per_op": per_op,
+        "stragglers": obj.get("stragglers") or [],
+    }
+
+
+def regression_diff(baseline, current) -> Dict[str, Any]:
+    """Name what got slower: the bucket-by-bucket / per-op diff between
+    two runs of the same plan.
+
+    Both arguments go through :func:`_normalize_run` (archive records
+    and live ``analyze()`` data are interchangeable). Each bucket/op row
+    carries its absolute delta and its share of the total slowdown;
+    ``culprits`` ranks the buckets that account for the wall-clock
+    growth, and worker names ride along from the current run's straggler
+    digest so "which bucket" can often be narrowed to "which worker"."""
+    base = _normalize_run(baseline)
+    cur = _normalize_run(current)
+    base_wall = base.get("wall_clock_s")
+    cur_wall = cur.get("wall_clock_s")
+    delta_wall = (
+        cur_wall - base_wall
+        if isinstance(base_wall, (int, float))
+        and isinstance(cur_wall, (int, float)) else None
+    )
+    ratio = (
+        cur_wall / base_wall
+        if isinstance(delta_wall, (int, float)) and base_wall else None
+    )
+
+    bucket_rows = []
+    names = [b for b in BUCKETS if b in base["buckets"] or b in cur["buckets"]]
+    names += sorted(
+        (set(base["buckets"]) | set(cur["buckets"])) - set(names)
+    )
+    slowdown = delta_wall if isinstance(delta_wall, (int, float)) else None
+    for name in names:
+        b = base["buckets"].get(name, 0.0)
+        c = cur["buckets"].get(name, 0.0)
+        d = c - b
+        row = {
+            "bucket": name,
+            "baseline_s": round(b, 6),
+            "current_s": round(c, 6),
+            "delta_s": round(d, 6),
+        }
+        if slowdown and slowdown > 0 and d > 0:
+            row["share_of_slowdown"] = round(min(d / slowdown, 1.0), 4)
+        bucket_rows.append(row)
+    bucket_rows.sort(key=lambda r: -r["delta_s"])
+
+    op_rows = []
+    for name in set(base["per_op"]) | set(cur["per_op"]):
+        b = base["per_op"].get(name, {"busy_s": 0.0, "buckets": {}})
+        c = cur["per_op"].get(name, {"busy_s": 0.0, "buckets": {}})
+        d = c["busy_s"] - b["busy_s"]
+        deltas = {
+            k: c["buckets"].get(k, 0.0) - b["buckets"].get(k, 0.0)
+            for k in set(b["buckets"]) | set(c["buckets"])
+        }
+        grew = max(deltas.items(), key=lambda kv: kv[1])[0] if deltas else None
+        op_rows.append({
+            "op": name,
+            "baseline_busy_s": round(b["busy_s"], 6),
+            "current_busy_s": round(c["busy_s"], 6),
+            "delta_s": round(d, 6),
+            "grew_bucket": grew if deltas and deltas[grew] > 1e-6 else None,
+        })
+    op_rows.sort(key=lambda r: -r["delta_s"])
+
+    culprits = [
+        r["bucket"] for r in bucket_rows
+        if r["delta_s"] > 1e-6 and (
+            slowdown is None or slowdown <= 0
+            or r["delta_s"] >= 0.05 * slowdown
+        )
+    ][:3]
+    workers = sorted({
+        s.get("worker") for s in cur["stragglers"]
+        if isinstance(s, dict) and s.get("worker")
+    })
+    return {
+        "baseline_compute_id": base.get("compute_id"),
+        "baseline_ts": base.get("ts"),
+        "current_compute_id": cur.get("compute_id"),
+        "wall_clock": {
+            "baseline_s": base_wall,
+            "current_s": cur_wall,
+            "delta_s": (
+                round(delta_wall, 6)
+                if isinstance(delta_wall, (int, float)) else None
+            ),
+            "ratio": round(ratio, 4) if ratio is not None else None,
+        },
+        "regressed": bool(ratio is not None and ratio >= REGRESSION_RATIO),
+        "buckets": bucket_rows,
+        "ops": op_rows,
+        "culprits": culprits,
+        "straggler_workers": workers,
+    }
+
+
+def render_regression(reg: dict) -> str:
+    """The human regression view (``python -m cubed_tpu.regress`` and
+    ``diagnose --analyze`` print this)."""
+    out: List[str] = []
+    wc = reg.get("wall_clock") or {}
+    ratio = wc.get("ratio")
+    verdict = (
+        "REGRESSED" if reg.get("regressed")
+        else "no regression" if ratio is not None else "incomparable"
+    )
+    out.append(
+        f"REGRESSION  {reg.get('current_compute_id')} vs baseline "
+        f"{reg.get('baseline_compute_id')}  [{verdict}]"
+    )
+    b, c = wc.get("baseline_s"), wc.get("current_s")
+    if isinstance(b, (int, float)) and isinstance(c, (int, float)):
+        out.append(
+            f"  wall clock {b:.3f}s -> {c:.3f}s  "
+            f"({'+' if c >= b else ''}{c - b:.3f}s, "
+            f"{ratio:.2f}x)" if ratio is not None
+            else f"  wall clock {b:.3f}s -> {c:.3f}s"
+        )
+    rows = [
+        r for r in (reg.get("buckets") or []) if abs(r["delta_s"]) > 1e-6
+    ]
+    if rows:
+        out.append("  bucket deltas (current - baseline):")
+        for r in rows[:8]:
+            share = r.get("share_of_slowdown")
+            share_s = f"  {share:>5.0%} of slowdown" if share else ""
+            out.append(
+                f"    {r['bucket']:<18}{r['baseline_s']:>9.3f}s ->"
+                f"{r['current_s']:>9.3f}s  "
+                f"{'+' if r['delta_s'] >= 0 else ''}"
+                f"{r['delta_s']:.3f}s{share_s}"
+            )
+    culprits = reg.get("culprits") or []
+    if culprits:
+        out.append(f"  culprit bucket(s): {', '.join(culprits)}")
+    ops = [
+        r for r in (reg.get("ops") or []) if abs(r["delta_s"]) > 1e-6
+    ]
+    if ops:
+        out.append("  op deltas (busy time):")
+        for r in ops[:6]:
+            grew = f"  [{r['grew_bucket']}]" if r.get("grew_bucket") else ""
+            out.append(
+                f"    {r['op']:<28}{r['baseline_busy_s']:>9.3f}s ->"
+                f"{r['current_busy_s']:>9.3f}s  "
+                f"{'+' if r['delta_s'] >= 0 else ''}"
+                f"{r['delta_s']:.3f}s{grew}"
+            )
+    workers = reg.get("straggler_workers") or []
+    if workers:
+        out.append(f"  straggling worker(s): {', '.join(map(str, workers))}")
+    return "\n".join(out) + "\n"
 
 
 def render_analysis(data: dict, path_limit: int = 12) -> str:
@@ -1043,4 +1256,8 @@ def render_analysis(data: dict, path_limit: int = 12) -> str:
         out.append("projected-vs-measured divergences:")
         for d in divergences:
             out.append(f"  [{d.get('kind')}] {d.get('op')}: {d.get('note')}")
+    reg = data.get("regression")
+    if reg:
+        out.append("")
+        out.append(render_regression(reg).rstrip("\n"))
     return "\n".join(out) + "\n"

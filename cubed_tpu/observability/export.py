@@ -202,13 +202,43 @@ def prometheus_text(
             lines.append(f"{full}_count {_fmt_value(summary.get('count', 0))}")
             lines.append(f"{full}_sum {_fmt_value(summary.get('sum', 0.0))}")
 
-    # labelled fleet/compute series whose name has no registry family:
-    # one gauge family each, latest sample per label set
-    for name in sorted(labelled_by_name):
+    # labelled quantile mirrors (the sampler's <base>_p50/_p95/_p99
+    # series, e.g. the per-tenant slo_request_latency family): regroup
+    # into ONE summary-convention family per base name with
+    # {quantile="..."} labels — the shape Prometheus tooling expects for
+    # estimated quantiles — instead of three disjoint gauge families.
+    # Only when the base name has no registry family of its own (a
+    # registry histogram already exports its summary above).
+    quantile_suffixes = (("_p50", "0.5"), ("_p95", "0.95"), ("_p99", "0.99"))
+    summary_groups: dict = {}
+    plain_labelled: dict = {}
+    for name, samples in labelled_by_name.items():
+        base = None
+        q = None
+        for sfx, qv in quantile_suffixes:
+            if name.endswith(sfx) and name[: -len(sfx)]:
+                base, q = name[: -len(sfx)], qv
+                break
+        if base is None or base in kinds or base in labelled_by_name:
+            plain_labelled[name] = samples
+            continue
+        group = summary_groups.setdefault(base, [])
+        for labels, v in samples:
+            group.append((dict(labels or {}, quantile=q), v))
+    for base in sorted(summary_groups):
+        emit(
+            base, "summary",
+            f"cubed_tpu telemetry series {base} "
+            "(estimated quantiles, latest samples)",
+            [("", labels, v) for labels, v in summary_groups[base]],
+        )
+    # remaining labelled fleet/compute series whose name has no registry
+    # family: one gauge family each, latest sample per label set
+    for name in sorted(plain_labelled):
         emit(
             name, "gauge",
             f"cubed_tpu telemetry series {name} (latest sample)",
-            [("", labels, v) for labels, v in labelled_by_name[name]],
+            [("", labels, v) for labels, v in plain_labelled[name]],
         )
     # unlabelled store-only series: the sampler-derived fleet aggregates
     for name in sorted(store_only):

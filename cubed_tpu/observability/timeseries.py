@@ -296,17 +296,39 @@ def service_view() -> Optional[dict]:
         return None
     if len(views) == 1:
         return views[0]
+    # 2+ live services: the tenant/queue aggregates still merge (the
+    # TENANTS panel reads one table), but the per-service identity —
+    # service_dir, cache stats, SLO board — must NOT be nulled away the
+    # moment a second service starts: each view keeps its own row under
+    # "services", and the slo boards merge per tenant (tenant names are
+    # already the services' own namespaces)
     merged = {
         "tenants": {}, "queue_depth": 0, "running": 0, "slots": 0,
         "throttling": any(v.get("throttling") for v in views),
         "durable": any(v.get("durable") for v in views),
-        "service_dir": None, "plan_cache": None, "result_cache": None,
+        "slo": {},
+        "services": [
+            {
+                "service_dir": v.get("service_dir"),
+                "durable": v.get("durable"),
+                "plan_cache": v.get("plan_cache"),
+                "result_cache": v.get("result_cache"),
+                "queue_depth": v.get("queue_depth"),
+                "running": v.get("running"),
+                "slots": v.get("slots"),
+                "throttling": v.get("throttling"),
+            }
+            for v in views
+        ],
     }
     for v in views:
         merged["tenants"].update(v.get("tenants") or {})
         merged["queue_depth"] += v.get("queue_depth") or 0
         merged["running"] += v.get("running") or 0
         merged["slots"] += v.get("slots") or 0
+        merged["slo"].update(v.get("slo") or {})
+    if not merged["slo"]:
+        merged["slo"] = None
     return merged
 
 
@@ -637,6 +659,35 @@ class TelemetrySampler:
                     "tenant_cost_retries", cost.get("retries"),
                     ts=now, labels=labels,
                 )
+            # the slo_* family: per-tenant board rows (burn rate per
+            # window, budget remaining, SLI counts, latency quantiles) —
+            # what the slo_fast_burn / slo_slow_burn rules watch and the
+            # summary-convention /metrics quantile export reads
+            for tenant, row in (snap.get("slo") or {}).items():
+                labels = {"tenant": tenant}
+                burn = row.get("burn") or {}
+                for wlabel in ("5m", "1h", "6h", "3d"):
+                    self.store.record(
+                        f"slo_burn_{wlabel}", burn.get(wlabel), ts=now,
+                        labels=labels,
+                    )
+                self.store.record(
+                    "slo_budget_remaining", row.get("budget_remaining"),
+                    ts=now, labels=labels,
+                )
+                self.store.record(
+                    "slo_events_total", row.get("events"), ts=now,
+                    labels=labels,
+                )
+                self.store.record(
+                    "slo_bad_total", row.get("bad"), ts=now, labels=labels,
+                )
+                lat = row.get("latency") or {}
+                for q in ("p50", "p95", "p99"):
+                    self.store.record(
+                        f"slo_request_latency_{q}", lat.get(f"{q}_s"),
+                        ts=now, labels=labels,
+                    )
 
     def _sample_computes(self, now: float) -> None:
         for row in compute_progress():

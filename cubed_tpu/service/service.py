@@ -47,7 +47,7 @@ import threading
 import time
 import uuid
 from collections import OrderedDict, deque
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -161,6 +161,7 @@ class ServiceConfig:
         overload_policy: Optional[OverloadPolicy] = None,
         breaker_threshold: int = 3,
         breaker_cooldown_s: float = 10.0,
+        slos: Optional[Dict[str, Any]] = None,
     ):
         self.tenants = dict(tenants or {})
         self.default_weight = float(default_weight)
@@ -181,6 +182,19 @@ class ServiceConfig:
         self.overload_policy = overload_policy
         self.breaker_threshold = int(breaker_threshold)
         self.breaker_cooldown_s = float(breaker_cooldown_s)
+        #: per-tenant SLO specs (tenant -> SloSpec or dict of its
+        #: fields): what the SloBoard evaluates burn rates against —
+        #: validated eagerly so a typo fails at construction, not at
+        #: the first request (observability/slo.py)
+        if slos:
+            from ..observability.slo import SloSpec
+
+            self.slos: Optional[Dict[str, Any]] = {
+                tenant: SloSpec.from_value(tenant, value)
+                for tenant, value in slos.items()
+            }
+        else:
+            self.slos = None
 
     @classmethod
     def resolve(
@@ -205,6 +219,7 @@ class ServiceConfig:
                 overload_policy=spec_cfg.overload_policy,
                 breaker_threshold=spec_cfg.breaker_threshold,
                 breaker_cooldown_s=spec_cfg.breaker_cooldown_s,
+                slos=spec_cfg.slos,
             )
         elif isinstance(spec_cfg, dict):
             base.update(spec_cfg)
@@ -223,6 +238,7 @@ class ServiceConfig:
                 overload_policy=config.overload_policy,
                 breaker_threshold=config.breaker_threshold,
                 breaker_cooldown_s=config.breaker_cooldown_s,
+                slos=config.slos,
             )
         base.update({k: v for k, v in overrides.items() if v is not None})
         resolved = cls(**base)
@@ -559,6 +575,12 @@ class ComputeService:
         )
         self.estimator = CostEstimator()
         self._breakers: Dict[str, TenantBreaker] = {}
+        #: per-tenant SLO board (None when no SLOs are configured via
+        #: config/Spec or CUBED_TPU_SERVICE_SLOS); seeded from the run
+        #: archive on start() so error budgets survive restarts
+        from ..observability.slo import SloBoard
+
+        self.slo_board = SloBoard.resolve(self.config.slos)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -576,6 +598,26 @@ class ComputeService:
                 # recovery is additive: a corrupt journal degrades to
                 # re-submission, it must not keep the service down
                 logger.exception("service recovery failed; starting empty")
+        if self.slo_board is not None and self.config.service_dir:
+            # durable error budgets: re-fold every archived request
+            # outcome so a restart (or SIGKILL) resumes the compliance
+            # window where it left off instead of resetting burned
+            # budget to zero. An interrupted request never wrote a
+            # completion record, so it is neither counted here nor
+            # double-counted when recovery re-runs it.
+            try:
+                from ..observability.runhistory import load_runs
+
+                records, bad = load_runs(self.config.service_dir)
+                folded = self.slo_board.fold(records)
+                record_decision(
+                    "slo_budget_folded", folded=folded, bad_lines=bad,
+                    service_dir=self.config.service_dir,
+                )
+            except Exception:
+                logger.exception(
+                    "SLO archive fold failed; budgets start empty"
+                )
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="service-dispatch", daemon=True,
         )
@@ -1302,6 +1344,7 @@ class ComputeService:
                 logger.exception(
                     "failed to seal request %s", req.request_id
                 )
+        self._record_run(req, state)
         req.event.set()
 
     def _cancel(self, req: _Request) -> bool:
@@ -1411,6 +1454,86 @@ class ComputeService:
         record_decision(
             "request_shed", tenant=tenant, reason=reason, **extra
         )
+        if self.config.service_dir and "request" not in extra:
+            # admission-time sheds never reach _finish (the submit
+            # raised before a request existed) — archive them here so
+            # the run history shows the whole shed story. A shed that
+            # DOES carry a request id (the feasibility gate) finishes
+            # through _record_run, which writes its record.
+            # SLO-ineligible either way (see _record_run).
+            try:
+                from ..observability.runhistory import record_request
+
+                record_request(
+                    self.config.service_dir,
+                    request_id=f"shed-{reason}",
+                    tenant=tenant,
+                    status="shed",
+                    error=reason,
+                    shed=True,
+                )
+            except Exception:
+                logger.exception("shed archive record failed")
+
+    def _record_run(self, req: _Request, state: str) -> None:
+        """One completion's SLI event + durable archive record.
+
+        Runs on every ``_finish`` path. Outcome classification: DONE ->
+        ``completed``; FAILED with a shed-typed error (the overload
+        ladder / breaker / feasibility gate declined) -> ``shed``; other
+        FAILED -> ``failed``; CANCELLED -> ``cancelled``. Only
+        completed/failed are SLI-eligible — a shed is the service's
+        decision and a cancel is the client's, neither is evidence about
+        the tenant's promise (both still land in the archive for the
+        record). Never raises: observability must not fail the request
+        path."""
+        try:
+            from ..runtime.cancellation import ComputeDeadlineExceededError
+
+            if state == DONE:
+                status = "completed"
+            elif state == CANCELLED:
+                status = "cancelled"
+            elif isinstance(req.error, ServiceOverloadedError):
+                status = "shed"
+            else:
+                status = "failed"
+            deadline_missed = isinstance(
+                req.error, ComputeDeadlineExceededError
+            ) and not req.cancel_requested
+            latency = None
+            if req.ended_at is not None:
+                latency = max(0.0, req.ended_at - req.submitted_at)
+            if self.config.service_dir:
+                from ..observability.runhistory import record_request
+
+                record_request(
+                    self.config.service_dir,
+                    request_id=req.request_id,
+                    tenant=req.tenant,
+                    status=status,
+                    latency_s=latency,
+                    fingerprint=req.fingerprint,
+                    compute_id=req.compute_id,
+                    error=(
+                        type(req.error).__name__
+                        if req.error is not None else None
+                    ),
+                    deadline_missed=deadline_missed,
+                    shed=status == "shed",
+                    request_class=req.request_class,
+                )
+            if self.slo_board is not None and status in (
+                "completed", "failed",
+            ):
+                self.slo_board.record(
+                    req.tenant, ok=status == "completed",
+                    latency_s=latency, ts=req.ended_at,
+                )
+        except Exception:
+            logger.exception(
+                "run record failed for request %s", req.request_id
+            )
 
     def _note_outcome(
         self, req: _Request, ok: bool, deadline_missed: bool = False,
@@ -1581,6 +1704,14 @@ class ComputeService:
             "throttling": self.admission.throttling,
             "durable": bool(self.config.service_dir),
             "service_dir": self.config.service_dir,
+            # per-tenant SLO board rows (None when no SLOs configured):
+            # burn rates per window, budget remaining, latency quantiles
+            # — the sampler turns these into the slo_* series and the
+            # top SLO panel renders them
+            "slo": (
+                self.slo_board.status()
+                if self.slo_board is not None else None
+            ),
             "plan_cache": (
                 {"entries": len(self.plan_cache)}
                 if self.plan_cache is not None else None

@@ -35,6 +35,7 @@ class Spec:
         memory_guard: Optional[str] = None,
         scheduler: Optional[str] = None,
         journal: Optional[str] = None,
+        run_history: Optional[str] = None,
         peer_transfer: Optional[bool] = None,
         telemetry_port: Optional[int] = None,
         service: Optional[Any] = None,
@@ -86,6 +87,12 @@ class Spec:
                 f"{type(journal).__name__}"
             )
         self._journal = journal
+        if run_history is not None and not isinstance(run_history, str):
+            raise ValueError(
+                f"run_history must be a directory path (str) or None, got "
+                f"{type(run_history).__name__}"
+            )
+        self._run_history = run_history
         self._peer_transfer = (
             None if peer_transfer is None else bool(peer_transfer)
         )
@@ -209,6 +216,20 @@ class Spec:
         resume_compute`` rebuild coordinator state from after a client
         crash. ``None`` (the default) journals nothing."""
         return self._journal
+
+    @property
+    def run_history(self) -> Optional[str]:
+        """Directory of the durable run-history archive
+        (``runs.jsonl``: append-only, fsync'd, size-rotated, torn-line
+        tolerant). When set, every ``Plan.execute`` appends one compact
+        record at completion — compute id, plan structural fingerprint,
+        wall clock, the ``analyze()`` bucket decomposition, metrics
+        highlights, and the error outcome — the cross-run memory that
+        ``python -m cubed_tpu.regress`` diffs against and per-tenant
+        SLO error budgets are folded from
+        (observability/runhistory.py). ``None`` (the default) archives
+        nothing."""
+        return self._run_history
 
     @property
     def peer_transfer(self) -> Optional[bool]:

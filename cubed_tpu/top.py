@@ -264,6 +264,55 @@ def render(snapshot: dict, width: int = 100) -> str:
                 )
             out.append("")
 
+    # -- per-tenant SLOs (error budget + multi-window burn rates) ------
+    slo = service.get("slo") or {}
+    if slo:
+        out.append("SLO  (error budget + burn rates; burn 1.0 = on pace)")
+        out.append(
+            f"{'TENANT':<16}{'OBJECTIVE':>16}{'P99':>9}{'GOOD%':>8}"
+            f"{'BUDGET':>8}{'5m':>7}{'1h':>7}{'6h':>7}{'3d':>7}  STATE"
+        )
+        for name in sorted(slo):
+            row = slo[name]
+            spec_row = row.get("spec") or {}
+            if spec_row.get("latency_s") is not None:
+                objective = (
+                    f"p{spec_row.get('latency_objective', 0) * 100:.0f}"
+                    f"<{spec_row['latency_s']:g}s"
+                )
+            elif spec_row.get("availability_objective") is not None:
+                objective = (
+                    f"avail{spec_row['availability_objective'] * 100:g}%"
+                )
+            else:
+                objective = "-"
+            lat = row.get("latency") or {}
+            p99 = lat.get("p99_s")
+            p99_s = f"{p99:.3f}s" if isinstance(p99, (int, float)) else "-"
+            good = row.get("good_fraction")
+            good_s = f"{good:.1%}" if isinstance(good, (int, float)) else "-"
+            budget = row.get("budget_remaining")
+            budget_s = (
+                f"{budget:.0%}" if isinstance(budget, (int, float)) else "-"
+            )
+            burn = row.get("burn") or {}
+
+            def _b(k):
+                v = burn.get(k)
+                return f"{v:.1f}" if isinstance(v, (int, float)) else "-"
+
+            state = "OK"
+            if row.get("fast_burn"):
+                state = "FAST BURN"
+            elif row.get("slow_burn"):
+                state = "SLOW BURN"
+            out.append(
+                f"{name:<16}{objective:>16}{p99_s:>9}{good_s:>8}"
+                f"{budget_s:>8}{_b('5m'):>7}{_b('1h'):>7}{_b('6h'):>7}"
+                f"{_b('3d'):>7}  {state}"
+            )
+        out.append("")
+
     # -- compute progress ----------------------------------------------
     out.append("COMPUTES")
     computes = snapshot.get("computes") or []

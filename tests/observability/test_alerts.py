@@ -128,6 +128,7 @@ def test_default_rules_cover_the_documented_shapes():
         "queue_depth_stall", "peer_fetch_fallback_spike",
         "tenant_starvation", "store_brownout", "dispatch_saturation",
         "overload_shedding", "tenant_breaker_open",
+        "slo_fast_burn", "slo_slow_burn",
     }
 
 

@@ -1,0 +1,382 @@
+"""Run-history archive tests: append/rotate/torn-line units, the
+``Plan.execute`` record hook (``Spec(run_history=...)``), baseline
+selection, and the cross-run regression attribution — including the
+chaos proof that a seeded straggler campaign is attributed to the right
+buckets by ``python -m cubed_tpu.regress`` against a clean baseline from
+the archive."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+from cubed_tpu.observability.analytics import (
+    analyze,
+    regression_diff,
+    render_regression,
+)
+from cubed_tpu.observability.runhistory import (
+    RunHistory,
+    archive_path,
+    find_baseline,
+    load_runs,
+    record_request,
+)
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+from cubed_tpu.runtime.faults import FaultConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+
+# ---------------------------------------------------------------------------
+# archive units
+# ---------------------------------------------------------------------------
+
+
+def test_append_and_load_round_trip(tmp_path):
+    h = RunHistory(str(tmp_path))
+    assert h.append({"kind": "request", "tenant": "a", "ok": True})
+    assert h.append({"kind": "compute", "compute_id": "c-1", "ok": False})
+    h.close()
+    records, bad = load_runs(str(tmp_path))
+    assert bad == 0
+    assert [r["kind"] for r in records] == ["request", "compute"]
+    assert all(isinstance(r.get("ts"), float) for r in records)
+
+
+def test_loader_tolerates_torn_and_garbage_lines(tmp_path):
+    h = RunHistory(str(tmp_path))
+    h.append({"kind": "request", "tenant": "a", "ok": True})
+    h.close()
+    with open(archive_path(str(tmp_path)), "ab") as f:
+        f.write(b"not json at all\n")
+        f.write(b'{"kind": "request", "tenant": "b", "ok": false}\n')
+        f.write(b'{"kind": "request", "torn...')  # crash mid-append
+    records, bad = load_runs(str(tmp_path))
+    assert bad == 2  # the garbage line and the torn tail
+    assert [r["tenant"] for r in records] == ["a", "b"]
+
+
+def test_append_never_raises_on_unserializable_record(tmp_path):
+    h = RunHistory(str(tmp_path))
+    # default=str in the encoder makes most things serializable; a
+    # self-referential structure is not — the append reports False
+    loop: dict = {}
+    loop["self"] = loop
+    assert h.append({"kind": "compute", "bad": loop}) is False
+    assert h.append({"kind": "compute", "ok": True}) is True
+    h.close()
+
+
+def test_rotation_bounds_the_archive_and_keeps_history_contiguous(tmp_path):
+    h = RunHistory(str(tmp_path), max_bytes=4096)
+    for i in range(300):
+        h.append({"kind": "request", "tenant": "a", "seq": i}, fsync=False)
+    h.close()
+    active = archive_path(str(tmp_path))
+    rotated = active + ".1"
+    assert os.path.exists(rotated), "rotation never happened"
+    # bounded: active stays under the limit, total under ~2x
+    assert os.path.getsize(active) <= 4096
+    assert os.path.getsize(active) + os.path.getsize(rotated) <= 2 * 4096
+    records, bad = load_runs(str(tmp_path))
+    assert bad == 0
+    seqs = [r["seq"] for r in records]
+    # contiguous across the rotation boundary: strictly increasing run
+    # ending at the newest record (older ones legitimately fell off)
+    assert seqs == sorted(seqs)
+    assert seqs[-1] == 299
+    assert len(seqs) > 50
+
+
+def test_max_bytes_env_override(tmp_path, monkeypatch):
+    from cubed_tpu.observability import runhistory
+
+    monkeypatch.setenv(runhistory.MAX_BYTES_ENV_VAR, "9999")
+    h = RunHistory(str(tmp_path))
+    assert h.max_bytes == 9999
+    h.close()
+    monkeypatch.setenv(runhistory.MAX_BYTES_ENV_VAR, "not-a-number")
+    h = RunHistory(str(tmp_path))
+    assert h.max_bytes == runhistory.DEFAULT_MAX_ARCHIVE_BYTES
+    h.close()
+
+
+# ---------------------------------------------------------------------------
+# the Plan.execute record hook
+# ---------------------------------------------------------------------------
+
+
+def _compute(work_dir, hist, faults=None, k=1.0):
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    spec = ct.Spec(
+        work_dir=str(work_dir), allowed_mem="500MB",
+        run_history=str(hist), fault_injection=faults,
+    )
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    r = ct.map_blocks(lambda x, _k=k: x + _k, a, dtype=np.float64)
+    val = r.compute(executor=AsyncPythonDagExecutor())
+    assert (np.asarray(val) == an + k).all()
+
+
+def test_plan_execute_appends_a_diffable_record(tmp_path):
+    hist = tmp_path / "hist"
+    _compute(tmp_path, hist)
+    records, bad = load_runs(str(hist))
+    assert bad == 0 and len(records) == 1
+    rec = records[0]
+    assert rec["kind"] == "compute" and rec["ok"] is True
+    assert rec["compute_id"].startswith("c-")
+    assert isinstance(rec["fingerprint"], str) and len(rec["fingerprint"]) == 64
+    assert rec["wall_clock_s"] > 0
+    # the analyze() decomposition rode along: buckets + per-op digest
+    assert rec["buckets"] and "kernel" in rec["buckets"]
+    assert rec["per_op"]
+    assert rec["metrics"]["tasks_completed"] >= 4
+
+
+def test_same_query_fingerprints_equal_across_builds(tmp_path):
+    hist = tmp_path / "hist"
+    _compute(tmp_path, hist, k=1.0)
+    _compute(tmp_path, hist, k=1.0)
+    records, _ = load_runs(str(hist))
+    assert len(records) == 2
+    assert records[0]["fingerprint"] == records[1]["fingerprint"]
+    assert records[0]["compute_id"] != records[1]["compute_id"]
+
+
+def test_failed_compute_is_archived_with_its_error(tmp_path):
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    spec = ct.Spec(
+        work_dir=str(tmp_path), allowed_mem="500MB",
+        run_history=str(tmp_path / "hist"),
+    )
+
+    def boom(x):
+        raise ValueError("seeded kernel failure")
+
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    r = ct.map_blocks(boom, a, dtype=np.float64)
+    with pytest.raises(ValueError):
+        r.compute(executor=AsyncPythonDagExecutor())
+    records, _ = load_runs(str(tmp_path / "hist"))
+    assert len(records) == 1
+    assert records[0]["ok"] is False
+    assert records[0]["error"] == "ValueError"
+
+
+# ---------------------------------------------------------------------------
+# baseline selection
+# ---------------------------------------------------------------------------
+
+
+def _rec(cid, fp="f1", ts=1.0, ok=True, buckets=None):
+    return {
+        "kind": "compute", "compute_id": cid, "fingerprint": fp, "ts": ts,
+        "ok": ok,
+        "buckets": {"kernel": 1.0} if buckets is None else buckets,
+    }
+
+
+def test_find_baseline_picks_latest_matching_ok_run():
+    records = [
+        _rec("c-old", ts=1.0),
+        _rec("c-failed", ts=2.0, ok=False),
+        _rec("c-otherplan", ts=3.0, fp="f2"),
+        _rec("c-nodecomp", ts=4.0, buckets={}),
+        _rec("c-best", ts=5.0),
+        _rec("c-later", ts=9.0),
+        {"kind": "request", "tenant": "a", "ts": 6.0},
+    ]
+    best = find_baseline(records, "f1", before_ts=8.0)
+    assert best["compute_id"] == "c-best"
+    assert find_baseline(records, "f9") is None
+    # exclusion keeps a run from being its own baseline
+    assert find_baseline(
+        records, "f1", exclude_compute_id="c-later"
+    )["compute_id"] == "c-best"
+
+
+# ---------------------------------------------------------------------------
+# regression_diff + analyze(baseline=...)
+# ---------------------------------------------------------------------------
+
+
+def test_regression_diff_names_the_grown_bucket():
+    baseline = {
+        "compute_id": "c-base", "ts": 1.0, "wall_clock_s": 1.0,
+        "buckets": {"kernel": 0.8, "storage_read": 0.2},
+        "per_op": {"op-a": {"busy_s": 0.8, "buckets": {"kernel": 0.8}}},
+    }
+    current = {
+        "compute_id": "c-cur", "ts": 2.0, "wall_clock_s": 2.0,
+        "buckets": {"kernel": 0.8, "storage_read": 0.2, "throttle_wait": 1.0},
+        "per_op": {
+            "op-a": {"busy_s": 1.8,
+                     "buckets": {"kernel": 0.8, "throttle_wait": 1.0}},
+        },
+        "stragglers": [{"op": "op-a", "worker": "w3", "factor": 4.0}],
+    }
+    reg = regression_diff(baseline, current)
+    assert reg["regressed"] is True
+    assert reg["wall_clock"]["ratio"] == 2.0
+    assert reg["culprits"][0] == "throttle_wait"
+    top = reg["buckets"][0]
+    assert top["bucket"] == "throttle_wait"
+    assert top["share_of_slowdown"] == 1.0
+    op = next(r for r in reg["ops"] if r["op"] == "op-a")
+    assert op["grew_bucket"] == "throttle_wait"
+    assert reg["straggler_workers"] == ["w3"]
+    text = render_regression(reg)
+    assert "REGRESSED" in text and "throttle_wait" in text and "w3" in text
+
+
+def test_regression_diff_flat_run_is_not_regressed():
+    rec = _rec("c-1", ts=1.0)
+    rec["wall_clock_s"] = 1.0
+    cur = dict(rec, compute_id="c-2", ts=2.0, wall_clock_s=1.05)
+    reg = regression_diff(rec, cur)
+    assert reg["regressed"] is False
+    assert "no regression" in render_regression(reg)
+
+
+def test_analyze_baseline_attaches_regression_section(tmp_path):
+    hist = tmp_path / "hist"
+    _compute(tmp_path, hist)
+    baseline = load_runs(str(hist))[0][0]
+
+    from cubed_tpu.observability.collect import TraceCollector
+
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+    a = ct.from_array(an, chunks=(4, 4), spec=spec)
+    r = ct.map_blocks(lambda x: x + 1.0, a, dtype=np.float64)
+    coll = TraceCollector()
+    r.compute(executor=AsyncPythonDagExecutor(), callbacks=[coll])
+    report = analyze(coll, baseline=baseline)
+    reg = report.to_dict()["regression"]
+    assert reg["baseline_compute_id"] == baseline["compute_id"]
+    assert any(r["bucket"] == "kernel" for r in reg["buckets"])
+    assert "REGRESSION" in report.render()
+
+
+# ---------------------------------------------------------------------------
+# the regress CLI — including the chaos proof
+# ---------------------------------------------------------------------------
+
+
+def _run_regress(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "cubed_tpu.regress", *args],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+
+
+def test_regress_cli_errors_cleanly_without_an_archive(tmp_path):
+    out = _run_regress("--history", str(tmp_path / "nothere"))
+    assert out.returncode == 2
+    assert "no archive records" in out.stderr
+
+
+def test_regress_cli_errors_cleanly_without_a_baseline(tmp_path):
+    hist = tmp_path / "hist"
+    _compute(tmp_path, hist)  # one run: nothing to diff against
+    out = _run_regress("--history", str(hist))
+    assert out.returncode == 2
+    assert "no comparable baseline" in out.stderr
+
+
+@pytest.mark.chaos
+def test_chaos_regress_attributes_seeded_stragglers(tmp_path):
+    """The end-to-end proof: a clean run then a seeded straggler
+    campaign of the SAME query; ``python -m cubed_tpu.regress`` finds
+    the clean baseline by fingerprint and attributes the slowdown to the
+    wait/uninstrumented buckets the injected sleeps actually land in —
+    NOT to kernel/storage."""
+    hist = tmp_path / "hist"
+    _compute(tmp_path, hist)  # clean baseline
+    _compute(
+        tmp_path, hist,
+        faults=FaultConfig(seed=7, straggler_rate=1.0, straggler_delay_s=0.3),
+    )
+    out = _run_regress("--history", str(hist), "--json")
+    assert out.returncode == 1, out.stderr  # regressed: the gate exit code
+    reg = json.loads(out.stdout)
+    assert reg["regressed"] is True
+    assert reg["wall_clock"]["ratio"] > 1.5
+    # the injected sleep lands in the task's pre-kernel window: the
+    # wait-side buckets must own the slowdown, compute/IO must not
+    culprits = set(reg["culprits"])
+    assert culprits & {"queue_wait", "uninstrumented", "straggler_excess"}
+    assert "kernel" not in culprits and "storage_read" not in culprits
+    # human report round-trip
+    human = _run_regress("--history", str(hist))
+    assert human.returncode == 1
+    assert "REGRESSED" in human.stdout
+
+
+def test_diagnose_history_flag_appends_regression_section(tmp_path):
+    """``diagnose <bundle> --history <dir>`` diffs the bundle's compute
+    against its archived baseline."""
+    from cubed_tpu.observability.flightrecorder import FlightRecorder
+
+    hist = tmp_path / "hist"
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+
+    def bump(x):
+        return x + 1.0
+
+    def build():
+        spec = ct.Spec(
+            work_dir=str(tmp_path), allowed_mem="500MB",
+            run_history=str(hist),
+        )
+        a = ct.from_array(an, chunks=(4, 4), spec=spec)
+        return ct.map_blocks(bump, a, dtype=np.float64)
+
+    # identical query twice: first is the baseline, second gets a bundle
+    build().compute(executor=AsyncPythonDagExecutor())
+    rec = FlightRecorder(str(tmp_path / "bundles"), always=True)
+    build().compute(executor=AsyncPythonDagExecutor(), callbacks=[rec])
+    bundles = os.listdir(tmp_path / "bundles")
+    assert len(bundles) == 1
+
+    from cubed_tpu.diagnose import main as diagnose_main
+
+    out_path = tmp_path / "out.txt"
+    import contextlib
+
+    with open(out_path, "w") as f, contextlib.redirect_stdout(f):
+        rc = diagnose_main([
+            str(tmp_path / "bundles" / bundles[0]),
+            "--history", str(hist),
+        ])
+    text = out_path.read_text()
+    assert rc == 0
+    assert "== regression" in text
+    assert "REGRESSION" in text
+    assert "no comparable baseline" not in text
+
+
+def test_record_request_shapes(tmp_path):
+    record_request(
+        str(tmp_path), request_id="r-1", tenant="a", status="completed",
+        latency_s=0.5, fingerprint="f" * 64, compute_id="c-1",
+    )
+    record_request(
+        str(tmp_path), request_id="shed-overload", tenant="b",
+        status="shed", error="overload", shed=True,
+    )
+    records, _ = load_runs(str(tmp_path))
+    assert records[0]["ok"] is True and records[0]["latency_s"] == 0.5
+    assert records[1]["ok"] is False and records[1]["shed"] is True

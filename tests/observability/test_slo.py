@@ -1,0 +1,476 @@
+"""Per-tenant SLO tests: spec/board units, burn-rate math, the live
+service integration (SLI recording, sampler series, burn-rate alerts,
+top panel, summary-convention ``/metrics`` export), and the chaos proof
+that a SIGKILLed service folds its error budget back from the durable
+run archive — no reset, no double-count."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import cubed_tpu as ct
+from cubed_tpu.observability.alerts import (
+    AlertEngine,
+    SloBurnRateRule,
+    default_rules,
+)
+from cubed_tpu.observability.export import prometheus_text
+from cubed_tpu.observability.runhistory import load_runs
+from cubed_tpu.observability.slo import (
+    BURN_WINDOWS,
+    FAST_BURN_THRESHOLD,
+    SloBoard,
+    SloSpec,
+    parse_slos_env,
+)
+from cubed_tpu.observability.timeseries import (
+    TelemetrySampler,
+    TimeSeriesStore,
+    service_view,
+)
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+from cubed_tpu.service import ComputeService
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+
+# ---------------------------------------------------------------------------
+# spec + env parsing units
+# ---------------------------------------------------------------------------
+
+
+def test_spec_requires_at_least_one_objective():
+    with pytest.raises(ValueError, match="latency_s and/or"):
+        SloSpec("a")
+    SloSpec("a", latency_s=2.0)
+    SloSpec("a", availability_objective=0.999)
+
+
+def test_spec_validates_objective_bounds():
+    with pytest.raises(ValueError, match="must be in"):
+        SloSpec("a", latency_s=2.0, latency_objective=1.0)
+    with pytest.raises(ValueError, match="must be in"):
+        SloSpec("a", availability_objective=0.0)
+
+
+def test_spec_from_value_rejects_unknown_fields():
+    with pytest.raises(ValueError, match="unknown SLO field"):
+        SloSpec.from_value("a", {"latency_s": 2.0, "typo_field": 1})
+    spec = SloSpec.from_value("a", {"latency_s": 2.0})
+    assert spec.latency_s == 2.0
+    assert SloSpec.from_value("a", spec) is spec
+
+
+def test_parse_slos_env(monkeypatch):
+    from cubed_tpu.observability.slo import SLOS_ENV_VAR
+
+    monkeypatch.delenv(SLOS_ENV_VAR, raising=False)
+    assert parse_slos_env() is None
+    monkeypatch.setenv(SLOS_ENV_VAR, '{"t": {"latency_s": 2.0}}')
+    assert parse_slos_env()["t"]["latency_s"] == 2.0
+    # malformed values are logged and ignored, never fatal
+    monkeypatch.setenv(SLOS_ENV_VAR, "{not json")
+    assert parse_slos_env() is None
+    monkeypatch.setenv(SLOS_ENV_VAR, '{"t": {"bogus": 1}}')
+    assert parse_slos_env() is None
+
+
+def test_board_resolve_env_wins_per_tenant(monkeypatch):
+    from cubed_tpu.observability.slo import SLOS_ENV_VAR
+
+    monkeypatch.setenv(SLOS_ENV_VAR, '{"a": {"latency_s": 9.0}}')
+    board = SloBoard.resolve({
+        "a": {"latency_s": 1.0}, "b": {"latency_s": 2.0},
+    })
+    assert board.spec_for("a").latency_s == 9.0  # env override
+    assert board.spec_for("b").latency_s == 2.0
+    monkeypatch.delenv(SLOS_ENV_VAR)
+    assert SloBoard.resolve(None) is None
+
+
+# ---------------------------------------------------------------------------
+# SLI / burn-rate math
+# ---------------------------------------------------------------------------
+
+
+def _board(**fields):
+    fields = fields or {"latency_s": 1.0, "availability_objective": 0.99}
+    return SloBoard({"t": SloSpec("t", **fields)})
+
+
+def test_empty_window_is_healthy_not_paging():
+    board = _board()
+    row = board.status(now=1000.0)["t"]
+    assert row["events"] == 0
+    assert row["budget_remaining"] == 1.0
+    assert all(v == 0.0 for v in row["burn"].values())
+    assert not row["fast_burn"] and not row["slow_burn"]
+
+
+def test_all_good_traffic_burns_nothing():
+    board = _board()
+    for i in range(50):
+        board.record("t", ok=True, latency_s=0.1, ts=1000.0 + i)
+    row = board.status(now=1100.0)["t"]
+    assert row["burn"]["5m"] == 0.0
+    assert row["budget_remaining"] == 1.0
+    assert row["good_fraction"] == 1.0
+
+
+def test_latency_misses_and_failures_both_burn_latency_budget():
+    board = _board()
+    board.record("t", ok=True, latency_s=5.0, ts=1000.0)   # too slow
+    board.record("t", ok=False, latency_s=0.1, ts=1001.0)  # failed
+    board.record("t", ok=True, latency_s=0.1, ts=1002.0)   # good
+    row = board.status(now=1003.0)["t"]
+    assert row["events"] == 3
+    assert row["latency_bad"] == 2
+    assert row["availability_bad"] == 1
+    # bad_frac 2/3 over a 1% latency budget: burn ~66x on every window
+    assert row["burn"]["5m"] == pytest.approx((2 / 3) / 0.01, rel=1e-3)
+    assert row["budget_remaining"] == 0.0
+
+
+def test_burn_1x_means_spending_exactly_the_budget():
+    # availability objective 0.99: 1 bad in 100 is burn exactly 1.0
+    board = _board(availability_objective=0.99)
+    for i in range(99):
+        board.record("t", ok=True, ts=1000.0 + i)
+    board.record("t", ok=False, ts=1099.0)
+    row = board.status(now=1100.0)["t"]
+    assert row["burn"]["5m"] == pytest.approx(1.0, rel=1e-6)
+    assert row["budget_remaining"] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_windows_forget_old_badness():
+    board = _board(availability_objective=0.99)
+    board.record("t", ok=False, ts=1000.0)  # ancient failure
+    for i in range(10):
+        board.record("t", ok=True, ts=5000.0 + i)
+    now = 5000.0 + BURN_WINDOWS["5m"]
+    row = board.status(now=now)["t"]
+    assert row["burn"]["5m"] == 0.0  # the 5m window no longer sees it
+    assert row["burn"]["3d"] > 0.0   # the compliance window still does
+
+
+def test_record_for_unconfigured_tenant_is_ignored():
+    board = _board()
+    board.record("stranger", ok=False, latency_s=9.0, ts=1000.0)
+    assert "stranger" not in board.status(now=1001.0)
+    assert board.status(now=1001.0)["t"]["events"] == 0
+
+
+def test_fold_skips_ineligible_and_malformed_records():
+    board = _board()
+    folded = board.fold([
+        {"kind": "request", "tenant": "t", "status": "completed",
+         "ok": True, "latency_s": 0.1, "ts": 1000.0},
+        {"kind": "request", "tenant": "t", "status": "failed",
+         "ok": False, "ts": 1001.0},
+        {"kind": "request", "tenant": "t", "status": "shed", "ts": 1002.0},
+        {"kind": "request", "tenant": "t", "status": "cancelled",
+         "ts": 1003.0},
+        {"kind": "request", "tenant": "other", "status": "completed",
+         "ok": True, "ts": 1004.0},
+        {"kind": "compute", "tenant": "t", "ts": 1005.0},
+        {"kind": "request", "tenant": "t", "status": "completed",
+         "ok": True},  # no ts: unplaceable in any window
+    ])
+    assert folded == 2  # the completed + the failed only
+    row = board.status(now=1010.0)["t"]
+    assert row["events"] == 2 and row["availability_bad"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the burn-rate alert rule
+# ---------------------------------------------------------------------------
+
+
+def _store_with_burns(now, fast=20.0, slow=20.0):
+    store = TimeSeriesStore()
+    labels = {"tenant": "t"}
+    store.record("slo_burn_5m", fast, ts=now, labels=labels)
+    store.record("slo_burn_1h", fast, ts=now, labels=labels)
+    store.record("slo_burn_6h", slow, ts=now, labels=labels)
+    store.record("slo_burn_3d", slow, ts=now, labels=labels)
+    return store
+
+
+def test_slo_burn_rule_requires_both_windows():
+    now = 1000.0
+    rule = SloBurnRateRule("fast", "1h", "5m", FAST_BURN_THRESHOLD)
+    details = rule.evaluate(_store_with_burns(now), now)
+    assert details is not None
+    assert details["tenants"] == ["t"]
+    # long window hot but short window recovered: no page (quick reset)
+    store = TimeSeriesStore()
+    store.record("slo_burn_1h", 20.0, ts=now, labels={"tenant": "t"})
+    store.record("slo_burn_5m", 0.0, ts=now, labels={"tenant": "t"})
+    assert rule.evaluate(store, now) is None
+
+
+def test_slo_burn_rule_ignores_stale_series():
+    now = 1000.0
+    rule = SloBurnRateRule("fast", "1h", "5m", FAST_BURN_THRESHOLD)
+    store = _store_with_burns(now - 60.0)  # a closed service's last word
+    assert rule.evaluate(store, now) is None
+
+
+def test_default_rules_ship_both_slo_burn_rules():
+    rules = {r.name: r for r in default_rules()}
+    assert rules["slo_fast_burn"].severity == "critical"
+    assert rules["slo_slow_burn"].severity == "warning"
+    assert rules["slo_fast_burn"].threshold == FAST_BURN_THRESHOLD
+
+
+# ---------------------------------------------------------------------------
+# live service integration
+# ---------------------------------------------------------------------------
+
+
+def _service_with_bad_slo(tmp_path, n_requests=6):
+    """A service whose tenant can never meet its (microsecond) latency
+    objective: every completed request burns budget."""
+    an = np.arange(64, dtype=np.float64).reshape(8, 8)
+    spec = ct.Spec(work_dir=str(tmp_path), allowed_mem="500MB")
+    svc = ComputeService(
+        executor=AsyncPythonDagExecutor(), spec=spec,
+        service_dir=str(tmp_path / "svc"), result_cache=False,
+        slos={"alpha": {"latency_s": 1e-6,
+                        "availability_objective": 0.999}},
+    ).start()
+    for i in range(n_requests):
+        a = ct.from_array(an, chunks=(4, 4), spec=spec)
+        r = ct.map_blocks(lambda x, _k=float(i): x + _k, a, dtype=np.float64)
+        svc.submit(r, tenant="alpha").result(timeout=600)
+    return svc
+
+
+def test_service_snapshot_and_archive_carry_slo_state(tmp_path):
+    svc = _service_with_bad_slo(tmp_path)
+    try:
+        row = svc.stats_snapshot()["slo"]["alpha"]
+        assert row["events"] == 6
+        assert row["latency_bad"] == 6
+        assert row["budget_remaining"] == 0.0
+        assert row["fast_burn"] and row["slow_burn"]
+        assert row["latency"]["p99_s"] > 0
+        records, bad = load_runs(str(tmp_path / "svc"))
+        reqs = [r for r in records if r["kind"] == "request"]
+        assert bad == 0 and len(reqs) == 6
+        assert all(r["status"] == "completed" for r in reqs)
+        assert all(r["tenant"] == "alpha" for r in reqs)
+    finally:
+        svc.close()
+
+
+def test_sampler_series_fire_both_burn_alerts(tmp_path):
+    """The wiring proof: board -> sampler slo_* series -> default rules
+    -> firings, on the first engine tick."""
+    svc = _service_with_bad_slo(tmp_path)
+    try:
+        store = TimeSeriesStore()
+        TelemetrySampler(store).sample_once()
+        names = {name for name, labels, _v in store.latest_series()
+                 if labels.get("tenant") == "alpha"}
+        for expected in (
+            "slo_burn_5m", "slo_burn_1h", "slo_burn_6h", "slo_burn_3d",
+            "slo_budget_remaining", "slo_events_total", "slo_bad_total",
+            "slo_request_latency_p50", "slo_request_latency_p99",
+        ):
+            assert expected in names, expected
+        engine = AlertEngine(store)
+        fired = {f["rule"] for f in engine.tick()}
+        assert {"slo_fast_burn", "slo_slow_burn"} <= fired
+    finally:
+        svc.close()
+
+
+def test_metrics_export_regroups_latency_quantiles_as_summary(tmp_path):
+    svc = _service_with_bad_slo(tmp_path, n_requests=2)
+    try:
+        store = TimeSeriesStore()
+        TelemetrySampler(store).sample_once()
+        text = prometheus_text(store=store)
+        assert "# TYPE cubed_tpu_slo_request_latency summary" in text
+        assert 'quantile="0.99"' in text and 'tenant="alpha"' in text
+        # the regrouped family must not also appear as per-suffix gauges
+        assert "slo_request_latency_p99{" not in text
+    finally:
+        svc.close()
+
+
+def test_top_panel_renders_slo_rows(tmp_path):
+    from cubed_tpu import top as top_mod
+
+    svc = _service_with_bad_slo(tmp_path, n_requests=2)
+    try:
+        rendered = top_mod.render(
+            {"ts": time.time(), "metrics": {}, "service": service_view()}
+        )
+        assert "SLO" in rendered
+        assert "alpha" in rendered
+        assert "FAST BURN" in rendered
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL-restart: the budget folds back from the archive
+# ---------------------------------------------------------------------------
+
+
+_KILL_SCRIPT = r"""
+import json, sys, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+import cubed_tpu as ct
+from cubed_tpu.runtime.executors.python_async import AsyncPythonDagExecutor
+from cubed_tpu.service import ComputeService
+
+mode = sys.argv[1]
+work_dir = {work_dir!r}
+sdir = {sdir!r}
+N = {n_requests!r}
+
+AN = np.arange(64, dtype=np.float64).reshape(8, 8)
+spec = ct.Spec(work_dir=work_dir, allowed_mem="500MB")
+SLOS = {{"alpha": {{"latency_s": 1e-6,
+                    "availability_objective": 0.999}}}}
+
+
+def build(k, delay=0.05):
+    def kernel(x, _k=float(k), _d=delay):
+        time.sleep(_d)
+        return x + _k
+
+    a = ct.from_array(AN, chunks=(4, 4), spec=spec)  # 4 tasks
+    return ct.map_blocks(kernel, a, dtype=np.float64)
+
+
+if mode == "run":
+    svc = ComputeService(
+        executor=AsyncPythonDagExecutor(), max_concurrent=1,
+        service_dir=sdir, recover=False, spec=spec,
+        plan_cache=False, result_cache=False, slos=SLOS,
+    ).start()
+    for i in range(N):
+        svc.submit(build(i), tenant="alpha")
+    svc.wait_idle(timeout=600)  # parent SIGKILLs us mid-flood
+else:
+    svc = ComputeService(
+        executor=AsyncPythonDagExecutor(), max_concurrent=1,
+        service_dir=sdir, spec=spec,
+        plan_cache=False, result_cache=False, slos=SLOS,
+    ).start()
+    try:
+        folded_at_start = svc.stats_snapshot()["slo"]["alpha"]["events"]
+        svc.wait_idle(timeout=300)  # recovery re-runs interrupted work
+        row = svc.stats_snapshot()["slo"]["alpha"]
+        print(json.dumps({{
+            "folded_at_start": folded_at_start,
+            "events": row["events"],
+            "latency_bad": row["latency_bad"],
+            "budget_remaining": row["budget_remaining"],
+        }}), flush=True)
+    finally:
+        svc.close()
+"""
+
+
+@pytest.mark.chaos
+def test_chaos_sigkill_budget_folds_durably_from_archive(tmp_path):
+    """SIGKILL the service mid-flood with a tenant that burns budget on
+    every request: the restarted service seeds its board from
+    ``runs.jsonl`` (no reset), recovery re-runs only the interrupted
+    requests (no double-count), and the final event count equals the
+    archive's — one completion record per request, exactly."""
+    n_requests = 6
+    sdir = str(tmp_path / "svc")
+    runs_path = os.path.join(sdir, "runs.jsonl")
+    script = _KILL_SCRIPT.format(
+        repo=REPO, work_dir=str(tmp_path), sdir=sdir, n_requests=n_requests,
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def completed_records():
+        try:
+            with open(runs_path, "rb") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return []
+        out = []
+        for raw in lines:
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if rec.get("kind") == "request" and rec.get("status") in (
+                "completed", "failed",
+            ):
+                out.append(rec)
+        return out
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script, "run"], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
+    killed = False
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline and proc.poll() is None:
+            done = len(completed_records())
+            if 1 <= done < n_requests:
+                os.killpg(proc.pid, signal.SIGKILL)
+                killed = True
+                break
+            time.sleep(0.02)
+        proc.wait(timeout=30)
+        assert killed, (
+            f"flood drained before the kill landed (rc={proc.returncode}): "
+            f"{proc.stderr.read()[-2000:]}"
+        )
+    finally:
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait(timeout=30)
+
+    pre_kill = len(completed_records())
+    assert 1 <= pre_kill < n_requests
+
+    out = subprocess.run(
+        [sys.executable, "-c", script, "recover"], env=env,
+        capture_output=True, text=True, timeout=400,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+
+    # the budget survived the SIGKILL: the board was seeded from the
+    # archive BEFORE any recovered request re-ran
+    assert report["folded_at_start"] == pre_kill
+    # no reset: with a microsecond objective every folded event is bad
+    assert report["budget_remaining"] == 0.0
+    assert report["latency_bad"] == report["events"]
+    # no double-count: every request contributed exactly one completion
+    # record — the interrupted one wrote nothing pre-kill and exactly one
+    # on its recovery re-run
+    final_records = completed_records()
+    assert report["events"] == len(final_records)
+    assert len(final_records) == n_requests
+    ids = [r["request_id"] for r in final_records]
+    assert len(ids) == len(set(ids)), f"duplicate completion records: {ids}"
